@@ -1,0 +1,17 @@
+"""lightgbm_tpu: a TPU-native gradient-boosted decision tree framework.
+
+Feature-complete re-design of LightGBM (reference: Luo-Liang/LightGBM v2.2.4)
+for TPU: histogram GBDT/DART/GOSS/RF training where the compute core is
+JAX/XLA/Pallas (bin matrix in HBM, fused histogram+split+partition tree
+growth under jit, distributed learners as XLA collectives over a device mesh)
+instead of C++/OpenMP/OpenCL/sockets.
+"""
+
+from .config import Config
+from .core.dataset import TpuDataset
+from .utils.log import LightGBMError, register_log_callback, set_verbosity
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "TpuDataset", "LightGBMError", "register_log_callback",
+           "set_verbosity", "__version__"]

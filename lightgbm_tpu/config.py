@@ -1,0 +1,368 @@
+"""Config / flag system.
+
+Single source of truth for every training parameter: the ``_PARAMS`` registry
+below declares name, type, default and aliases; the alias table and setters the
+reference generates from ``config.h`` doc comments via
+``helpers/parameter_generator.py`` (reference: include/LightGBM/config.h:52-561,
+src/io/config_auto.cpp:10-285) are instead derived at import time from this one
+table.  Parsing accepts ``key=value`` strings (CLI / config file) and Python
+dicts, resolves aliases, coerces types, and cross-validates conflicting
+parameters (reference: src/io/config.cpp:318-433 ``CheckParamConflict``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from .utils.log import log_warning
+
+
+class _P:
+    """One parameter spec: (default, aliases)."""
+
+    __slots__ = ("default", "aliases", "ptype")
+
+    def __init__(self, default, aliases=(), ptype=None):
+        self.default = default
+        self.aliases = tuple(aliases)
+        self.ptype = ptype if ptype is not None else type(default)
+
+
+# The full parameter registry.  Matches the reference's Config::parameter_set
+# (src/io/config_auto.cpp:172-285) and alias_table (config_auto.cpp:10-170).
+_PARAMS: Dict[str, _P] = {
+    # -- core --
+    "config": _P("", ["config_file"]),
+    "task": _P("train", ["task_type"]),
+    "objective": _P("regression", ["objective_type", "app", "application"]),
+    "boosting": _P("gbdt", ["boosting_type", "boost"]),
+    "data": _P("", ["train", "train_data", "train_data_file", "data_filename"]),
+    "valid": _P([], ["test", "valid_data", "valid_data_file", "test_data",
+                     "test_data_file", "valid_filenames"], ptype=list),
+    "num_iterations": _P(100, ["num_iteration", "n_iter", "num_tree", "num_trees",
+                               "num_round", "num_rounds", "num_boost_round",
+                               "n_estimators"]),
+    "learning_rate": _P(0.1, ["shrinkage_rate", "eta"]),
+    "num_leaves": _P(31, ["num_leaf", "max_leaves", "max_leaf"]),
+    "tree_learner": _P("serial", ["tree", "tree_type", "tree_learner_type"]),
+    "num_threads": _P(0, ["num_thread", "nthread", "nthreads", "n_jobs"]),
+    "device_type": _P("tpu", ["device"]),
+    "seed": _P(0, ["random_seed", "random_state"]),
+    # -- learning control --
+    "max_depth": _P(-1),
+    "min_data_in_leaf": _P(20, ["min_data_per_leaf", "min_data", "min_child_samples"]),
+    "min_sum_hessian_in_leaf": _P(1e-3, ["min_sum_hessian_per_leaf", "min_sum_hessian",
+                                         "min_hessian", "min_child_weight"]),
+    "bagging_fraction": _P(1.0, ["sub_row", "subsample", "bagging"]),
+    "pos_bagging_fraction": _P(1.0, ["pos_sub_row", "pos_subsample", "pos_bagging"]),
+    "neg_bagging_fraction": _P(1.0, ["neg_sub_row", "neg_subsample", "neg_bagging"]),
+    "bagging_freq": _P(0, ["subsample_freq"]),
+    "bagging_seed": _P(3, ["bagging_fraction_seed"]),
+    "feature_fraction": _P(1.0, ["sub_feature", "colsample_bytree"]),
+    "feature_fraction_bynode": _P(1.0, ["sub_feature_bynode", "colsample_bynode"]),
+    "feature_fraction_seed": _P(2),
+    "early_stopping_round": _P(0, ["early_stopping_rounds", "early_stopping"]),
+    "first_metric_only": _P(False),
+    "max_delta_step": _P(0.0, ["max_tree_output", "max_leaf_output"]),
+    "lambda_l1": _P(0.0, ["reg_alpha"]),
+    "lambda_l2": _P(0.0, ["reg_lambda", "lambda"]),
+    "min_gain_to_split": _P(0.0, ["min_split_gain"]),
+    "drop_rate": _P(0.1, ["rate_drop"]),
+    "max_drop": _P(50),
+    "skip_drop": _P(0.5),
+    "xgboost_dart_mode": _P(False),
+    "uniform_drop": _P(False),
+    "drop_seed": _P(4),
+    "top_rate": _P(0.2),
+    "other_rate": _P(0.1),
+    "min_data_per_group": _P(100),
+    "max_cat_threshold": _P(32),
+    "cat_l2": _P(10.0),
+    "cat_smooth": _P(10.0),
+    "max_cat_to_onehot": _P(4),
+    "top_k": _P(20, ["topk"]),
+    "monotone_constraints": _P([], ["mc", "monotone_constraint"], ptype=list),
+    "feature_contri": _P([], ["feature_contrib", "fc", "fp", "feature_penalty"],
+                         ptype=list),
+    "forcedsplits_filename": _P("", ["fs", "forced_splits_filename",
+                                     "forced_splits_file", "forced_splits"]),
+    "refit_decay_rate": _P(0.9),
+    "cegb_tradeoff": _P(1.0),
+    "cegb_penalty_split": _P(0.0),
+    "cegb_penalty_feature_lazy": _P([], ptype=list),
+    "cegb_penalty_feature_coupled": _P([], ptype=list),
+    # -- IO --
+    "verbosity": _P(1, ["verbose"]),
+    "max_bin": _P(255),
+    "max_bin_by_feature": _P([], ptype=list),
+    "min_data_in_bin": _P(3),
+    "bin_construct_sample_cnt": _P(200000, ["subsample_for_bin"]),
+    "histogram_pool_size": _P(-1.0, ["hist_pool_size"]),
+    "data_random_seed": _P(1, ["data_seed"]),
+    "output_model": _P("LightGBM_model.txt", ["model_output", "model_out"]),
+    "snapshot_freq": _P(-1, ["save_period"]),
+    "input_model": _P("", ["model_input", "model_in"]),
+    "output_result": _P("LightGBM_predict_result.txt",
+                        ["predict_result", "prediction_result", "predict_name",
+                         "prediction_name", "pred_name", "name_pred"]),
+    "initscore_filename": _P("", ["init_score_filename", "init_score_file",
+                                  "init_score", "input_init_score"]),
+    "valid_data_initscores": _P([], ["valid_data_init_scores", "valid_init_score_file",
+                                     "valid_init_score"], ptype=list),
+    "pre_partition": _P(False, ["is_pre_partition"]),
+    "enable_bundle": _P(True, ["is_enable_bundle", "bundle"]),
+    "max_conflict_rate": _P(0.0),
+    "is_enable_sparse": _P(True, ["is_sparse", "enable_sparse", "sparse"]),
+    "sparse_threshold": _P(0.8),
+    "use_missing": _P(True),
+    "zero_as_missing": _P(False),
+    "two_round": _P(False, ["two_round_loading", "use_two_round_loading"]),
+    "save_binary": _P(False, ["is_save_binary", "is_save_binary_file"]),
+    "header": _P(False, ["has_header"]),
+    "label_column": _P("", ["label"]),
+    "weight_column": _P("", ["weight"]),
+    "group_column": _P("", ["group", "group_id", "query_column", "query", "query_id"]),
+    "ignore_column": _P("", ["ignore_feature", "blacklist"]),
+    "categorical_feature": _P("", ["cat_feature", "categorical_column", "cat_column"]),
+    "predict_raw_score": _P(False, ["is_predict_raw_score", "predict_rawscore",
+                                    "raw_score"]),
+    "predict_leaf_index": _P(False, ["is_predict_leaf_index", "leaf_index"]),
+    "predict_contrib": _P(False, ["is_predict_contrib", "contrib"]),
+    "num_iteration_predict": _P(-1),
+    "pred_early_stop": _P(False),
+    "pred_early_stop_freq": _P(10),
+    "pred_early_stop_margin": _P(10.0),
+    "convert_model_language": _P(""),
+    "convert_model": _P("gbdt_prediction.cpp", ["convert_model_file"]),
+    # -- objective --
+    "num_class": _P(1, ["num_classes"]),
+    "is_unbalance": _P(False, ["unbalance", "unbalanced_sets"]),
+    "scale_pos_weight": _P(1.0),
+    "sigmoid": _P(1.0),
+    "boost_from_average": _P(True),
+    "reg_sqrt": _P(False),
+    "alpha": _P(0.9),
+    "fair_c": _P(1.0),
+    "poisson_max_delta_step": _P(0.7),
+    "tweedie_variance_power": _P(1.5),
+    "max_position": _P(20),
+    "lambdamart_norm": _P(True),
+    "label_gain": _P([], ptype=list),
+    # -- metric --
+    "metric": _P([], ["metrics", "metric_types"], ptype=list),
+    "metric_freq": _P(1, ["output_freq"]),
+    "is_provide_training_metric": _P(False, ["training_metric", "is_training_metric",
+                                             "train_metric"]),
+    "eval_at": _P([1, 2, 3, 4, 5], ["ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at"],
+                  ptype=list),
+    "multi_error_top_k": _P(1),
+    # -- network (reference: socket/MPI machine list; here: JAX mesh over ICI/DCN) --
+    "num_machines": _P(1, ["num_machine"]),
+    "local_listen_port": _P(12400, ["local_port", "port"]),
+    "time_out": _P(120),
+    "machine_list_filename": _P("", ["machine_list_file", "machine_list", "mlist"]),
+    "machines": _P("", ["workers", "nodes"]),
+    # -- device --
+    "gpu_platform_id": _P(-1),
+    "gpu_device_id": _P(-1),
+    "gpu_use_dp": _P(False),
+    # -- tpu-specific (new in this framework) --
+    "tpu_histogram_backend": _P("auto"),   # auto | onehot | pallas
+    "tpu_row_chunk": _P(0),                # 0 = auto-pick row chunk for histogram scan
+    "tpu_double_precision": _P(False),     # accumulate histograms in f64-equivalent
+}
+
+# alias -> canonical name
+ALIAS_TABLE: Dict[str, str] = {}
+for _name, _spec in _PARAMS.items():
+    for _a in _spec.aliases:
+        ALIAS_TABLE[_a] = _name
+
+PARAMETER_SET = frozenset(_PARAMS)
+
+_TRUE_SET = {"1", "t", "true", "y", "yes", "on"}
+_FALSE_SET = {"0", "f", "false", "n", "no", "off"}
+
+# objective alias strings (reference: docs in config.h:184-214 and
+# ObjectiveFunction::CreateObjectiveFunction src/objective/objective_function.cpp:15)
+OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression",
+    "l2_root": "regression", "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+
+def _coerce(name: str, value: Any, ptype: type) -> Any:
+    """Coerce a raw (usually string) value to the parameter's type."""
+    if ptype is list:
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        if isinstance(value, str):
+            if not value:
+                return []
+            return [_maybe_num(v) for v in value.replace(";", ",").split(",")]
+        return [value]
+    if ptype is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, (int, float)):
+            return bool(value)
+        s = str(value).strip().lower()
+        if s in _TRUE_SET:
+            return True
+        if s in _FALSE_SET:
+            return False
+        raise ValueError(f"cannot parse bool parameter {name}={value!r}")
+    if ptype is int:
+        return int(float(value))
+    if ptype is float:
+        return float(value)
+    return str(value)
+
+
+def _maybe_num(s: str) -> Any:
+    s = s.strip()
+    try:
+        f = float(s)
+        return int(f) if f == int(f) and "." not in s and "e" not in s.lower() else f
+    except ValueError:
+        return s
+
+
+def resolve_alias(key: str) -> str:
+    k = key.strip().lower()
+    return ALIAS_TABLE.get(k, k)
+
+
+def str2map(parameters: str) -> Dict[str, str]:
+    """Parse whitespace-separated ``key=value`` pairs (reference Config::Str2Map)."""
+    out: Dict[str, str] = {}
+    for tok in parameters.split():
+        kv2map(out, tok)
+    return out
+
+
+def kv2map(params: Dict[str, str], kv: str) -> None:
+    kv = kv.strip()
+    if not kv or kv.startswith("#"):
+        return
+    if "=" not in kv:
+        log_warning(f"Unknown parameter {kv}")
+        return
+    k, v = kv.split("=", 1)
+    k = k.strip()
+    v = v.split("#", 1)[0].strip()
+    if k in params and params[k] != v:
+        log_warning(f"{k} is set with {params[k]}, will be overridden by {v}")
+    params[k] = v
+
+
+@dataclasses.dataclass
+class Config:
+    """Resolved training configuration.
+
+    Construct with :meth:`from_params` from a dict of possibly-aliased keys.
+    Unknown keys warn (matching the reference's tolerance of unknown params).
+    """
+
+    def __init__(self, **kwargs):
+        for name, spec in _PARAMS.items():
+            v = spec.default
+            object.__setattr__(self, name,
+                               list(v) if isinstance(v, list) else v)
+        self.raw: Dict[str, Any] = {}
+        self.update(kwargs)
+
+    # -- construction --
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]] = None,
+                    **kwargs) -> "Config":
+        merged = dict(params or {})
+        merged.update(kwargs)
+        return cls(**merged)
+
+    @classmethod
+    def from_string(cls, parameters: str) -> "Config":
+        return cls(**str2map(parameters))
+
+    def update(self, params: Dict[str, Any]) -> None:
+        resolved: Dict[str, Any] = {}
+        for k, v in params.items():
+            name = resolve_alias(k)
+            if name in resolved and resolved[name] != v:
+                log_warning(f"{name} is set with {resolved[name]}, "
+                            f"will be overridden by {v}")
+            resolved[name] = v
+        for name, v in resolved.items():
+            if name not in _PARAMS:
+                log_warning(f"Unknown parameter: {name}")
+                self.raw[name] = v
+                continue
+            setattr(self, name, _coerce(name, v, _PARAMS[name].ptype))
+            self.raw[name] = v
+        self._post_process()
+
+    # -- validation (reference Config::CheckParamConflict, config.cpp:318+) --
+    def _post_process(self) -> None:
+        self.objective = OBJECTIVE_ALIASES.get(
+            str(self.objective).strip().lower(), self.objective)
+        if isinstance(self.metric, str):
+            self.metric = _coerce("metric", self.metric, list)
+        self.metric = [str(m).strip().lower() for m in self.metric if str(m).strip()]
+        if self.num_leaves < 2:
+            log_warning("num_leaves must be >= 2; setting to 2")
+            self.num_leaves = 2
+        if self.max_bin < 2:
+            raise ValueError("max_bin should be >= 2")
+        if self.bagging_freq > 0 and not (0.0 < self.bagging_fraction <= 1.0):
+            raise ValueError("bagging_fraction must be in (0, 1]")
+        if not (0.0 < self.feature_fraction <= 1.0):
+            raise ValueError("feature_fraction must be in (0, 1]")
+        if self.objective in ("multiclass", "multiclassova") and self.num_class <= 1:
+            raise ValueError("num_class must be > 1 for multiclass objectives")
+        if (self.objective not in ("multiclass", "multiclassova", "none")
+                and self.num_class != 1):
+            raise ValueError("num_class must be 1 for non-multiclass objectives")
+        if self.is_unbalance and self.scale_pos_weight != 1.0:
+            raise ValueError(
+                "is_unbalance and scale_pos_weight cannot both be set")
+        # distributed learner implies a parallel tree learner choice stays valid
+        tl = str(self.tree_learner).strip().lower()
+        if tl in ("serial",):
+            pass
+        elif tl in ("feature", "feature_parallel", "data", "data_parallel",
+                    "voting", "voting_parallel", "benchmark"):
+            pass
+        else:
+            raise ValueError(f"Unknown tree learner type {self.tree_learner}")
+        self.tree_learner = tl
+
+    # -- accessors --
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in _PARAMS}
+
+    def __repr__(self) -> str:
+        diffs = {n: getattr(self, n) for n, s in _PARAMS.items()
+                 if getattr(self, n) != s.default}
+        return f"Config({diffs})"
+
+
+def default_params() -> Dict[str, Any]:
+    return {n: (list(s.default) if isinstance(s.default, list) else s.default)
+            for n, s in _PARAMS.items()}

@@ -1,0 +1,5 @@
+from .binning import BinMapper
+from .dataset import TpuDataset
+from .metadata import Metadata
+
+__all__ = ["BinMapper", "TpuDataset", "Metadata"]

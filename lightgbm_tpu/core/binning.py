@@ -1,0 +1,421 @@
+"""Feature quantization: value -> integer bin mapping.
+
+Reimplements the reference's BinMapper behavior (include/LightGBM/bin.h:78-246,
+src/io/bin.cpp:25-410) in numpy: greedy equal-ish-frequency bin-bound finding
+(``GreedyFindBin`` bin.cpp:74), the zero-aware split of the value range
+(``FindBinWithZeroAsOneBin`` bin.cpp:152), missing handling (None/Zero/NaN),
+and categorical bin mapping by descending count with a 99% mass cutoff
+(bin.cpp:310-375).  Bin *assignment* (``ValueToBin`` bin.h:496-549) is
+vectorized with ``np.searchsorted`` so full columns quantize in one shot.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.log import check, log_warning
+
+K_ZERO_THRESHOLD = 1e-35
+K_SPARSE_THRESHOLD = 0.8
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+MISSING_TYPE_NAMES = {MISSING_NONE: "none", MISSING_ZERO: "zero",
+                      MISSING_NAN: "nan"}
+MISSING_TYPE_FROM_NAME = {v: k for k, v in MISSING_TYPE_NAMES.items()}
+
+BIN_TYPE_NUMERICAL = 0
+BIN_TYPE_CATEGORICAL = 1
+
+
+def _next_after_up(a: float) -> float:
+    """std::nextafter(a, +inf) (reference Common::GetDoubleUpperBound)."""
+    return math.nextafter(a, math.inf)
+
+
+def _double_equal_ordered(a: float, b: float) -> bool:
+    """b <= nextafter(a, inf) for ordered a<=b (Common::CheckDoubleEqualOrdered)."""
+    return b <= _next_after_up(a)
+
+
+def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                    max_bin: int, total_cnt: int,
+                    min_data_in_bin: int) -> List[float]:
+    """Greedy equal-frequency-ish bin upper bounds (bin.cpp:74-150)."""
+    check(max_bin > 0, "max_bin must be positive")
+    num_distinct = len(distinct_values)
+    bounds: List[float] = []
+    if num_distinct <= max_bin:
+        cur_cnt = 0
+        for i in range(num_distinct - 1):
+            cur_cnt += int(counts[i])
+            if cur_cnt >= min_data_in_bin:
+                val = _next_after_up((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                if not bounds or not _double_equal_ordered(bounds[-1], val):
+                    bounds.append(val)
+                    cur_cnt = 0
+        bounds.append(math.inf)
+        return bounds
+
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+    # values with huge counts get their own bin
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt = max_bin - int(is_big.sum())
+    rest_sample_cnt = total_cnt - int(counts[is_big].sum())
+    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+
+    upper_bounds = [math.inf] * max_bin
+    lower_bounds = [math.inf] * max_bin
+    bin_cnt = 0
+    lower_bounds[0] = float(distinct_values[0])
+    cur_cnt = 0
+    for i in range(num_distinct - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= int(counts[i])
+        cur_cnt += int(counts[i])
+        if (is_big[i] or cur_cnt >= mean_bin_size or
+                (is_big[i + 1] and cur_cnt >= max(1.0, mean_bin_size * 0.5))):
+            upper_bounds[bin_cnt] = float(distinct_values[i])
+            bin_cnt += 1
+            lower_bounds[bin_cnt] = float(distinct_values[i + 1])
+            if bin_cnt >= max_bin - 1:
+                break
+            cur_cnt = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    bin_cnt += 1
+    for i in range(bin_cnt - 1):
+        val = _next_after_up((upper_bounds[i] + lower_bounds[i + 1]) / 2.0)
+        if not bounds or not _double_equal_ordered(bounds[-1], val):
+            bounds.append(val)
+    bounds.append(math.inf)
+    return bounds
+
+
+def find_bin_with_zero_as_one_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                                  max_bin: int, total_sample_cnt: int,
+                                  min_data_in_bin: int) -> List[float]:
+    """Split the range at zero so one bin holds exactly zero (bin.cpp:152-208)."""
+    left_mask = distinct_values <= -K_ZERO_THRESHOLD
+    right_mask = distinct_values > K_ZERO_THRESHOLD
+    left_cnt_data = int(counts[left_mask].sum())
+    right_cnt_data = int(counts[right_mask].sum())
+    cnt_zero = int(total_sample_cnt) - left_cnt_data - right_cnt_data
+
+    nz = np.nonzero(distinct_values > -K_ZERO_THRESHOLD)[0]
+    left_cnt = int(nz[0]) if len(nz) else len(distinct_values)
+
+    bounds: List[float] = []
+    if left_cnt > 0 and max_bin > 1:
+        denom = max(total_sample_cnt - cnt_zero, 1)
+        left_max_bin = max(1, int(left_cnt_data / denom * (max_bin - 1)))
+        bounds = greedy_find_bin(distinct_values[:left_cnt], counts[:left_cnt],
+                                 left_max_bin, left_cnt_data, min_data_in_bin)
+        if bounds:
+            bounds[-1] = -K_ZERO_THRESHOLD
+
+    nz = np.nonzero(distinct_values[left_cnt:] > K_ZERO_THRESHOLD)[0]
+    right_start = left_cnt + int(nz[0]) if len(nz) else -1
+
+    right_max_bin = max_bin - 1 - len(bounds)
+    if right_start >= 0 and right_max_bin > 0:
+        right_bounds = greedy_find_bin(distinct_values[right_start:],
+                                       counts[right_start:], right_max_bin,
+                                       right_cnt_data, min_data_in_bin)
+        bounds.append(K_ZERO_THRESHOLD)
+        bounds.extend(right_bounds)
+    else:
+        bounds.append(math.inf)
+    check(len(bounds) <= max_bin, "bin bound count exceeds max_bin")
+    return bounds
+
+
+def _distinct_with_zero(values_sorted: np.ndarray, zero_cnt: int):
+    """Distinct values/counts from a sorted sample, zero block spliced in at its
+    ordered position (bin.cpp:236-270).  Adjacent float-equal values merge,
+    keeping the larger value."""
+    distinct: List[float] = []
+    counts: List[int] = []
+    n = len(values_sorted)
+    if n == 0 or (values_sorted[0] > 0.0 and zero_cnt > 0):
+        distinct.append(0.0)
+        counts.append(zero_cnt)
+    if n > 0:
+        distinct.append(float(values_sorted[0]))
+        counts.append(1)
+    for i in range(1, n):
+        prev, cur = float(values_sorted[i - 1]), float(values_sorted[i])
+        if not _double_equal_ordered(prev, cur):
+            if prev < 0.0 and cur > 0.0:
+                distinct.append(0.0)
+                counts.append(zero_cnt)
+            distinct.append(cur)
+            counts.append(1)
+        else:
+            distinct[-1] = cur  # keep the larger of float-equal values
+            counts[-1] += 1
+    if n > 0 and values_sorted[n - 1] < 0.0 and zero_cnt > 0:
+        distinct.append(0.0)
+        counts.append(zero_cnt)
+    return np.asarray(distinct, dtype=np.float64), np.asarray(counts, dtype=np.int64)
+
+
+def _need_filter(cnt_in_bin: Sequence[int], total_cnt: int, filter_cnt: int,
+                 bin_type: int) -> bool:
+    """True when no split of this feature can satisfy min-data (bin.cpp:40-72)."""
+    if bin_type == BIN_TYPE_NUMERICAL:
+        left = 0
+        for i in range(len(cnt_in_bin) - 1):
+            left += int(cnt_in_bin[i])
+            if left >= filter_cnt and total_cnt - left >= filter_cnt:
+                return False
+        return True
+    # categorical: one-vs-rest viability
+    if len(cnt_in_bin) <= 2:
+        for i in range(len(cnt_in_bin) - 1):
+            left = int(cnt_in_bin[i])
+            if left >= filter_cnt and total_cnt - left >= filter_cnt:
+                return False
+        return True
+    return False
+
+
+class BinMapper:
+    """Per-feature value->bin quantizer (reference BinMapper, bin.h:78-246)."""
+
+    def __init__(self):
+        self.num_bin: int = 1
+        self.missing_type: int = MISSING_NONE
+        self.bin_type: int = BIN_TYPE_NUMERICAL
+        self.is_trivial: bool = True
+        self.sparse_rate: float = 1.0
+        self.bin_upper_bound: np.ndarray = np.array([np.inf])
+        self.bin_2_categorical: List[int] = []
+        self.categorical_2_bin: Dict[int, int] = {}
+        self.min_val: float = 0.0
+        self.max_val: float = 0.0
+        self.default_bin: int = 0
+
+    # ------------------------------------------------------------------ fit
+    def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
+                 min_data_in_bin: int = 3, min_split_data: int = 20,
+                 bin_type: int = BIN_TYPE_NUMERICAL, use_missing: bool = True,
+                 zero_as_missing: bool = False) -> "BinMapper":
+        """Fit bin bounds from a (possibly subsampled) value sample.
+
+        ``total_sample_cnt - len(values)`` values are implicitly zero: sparse
+        columns pass only their non-zero entries (bin.cpp:210-235).
+        """
+        values = np.asarray(values, dtype=np.float64)
+        num_sample_values = len(values)
+        nan_mask = np.isnan(values)
+        values = values[~nan_mask]
+        na_cnt = int(nan_mask.sum())
+
+        if not use_missing:
+            self.missing_type = MISSING_NONE
+        elif zero_as_missing:
+            self.missing_type = MISSING_ZERO
+        else:
+            self.missing_type = MISSING_NONE if na_cnt == 0 else MISSING_NAN
+        if not use_missing:
+            na_cnt = 0  # NaNs already dropped; they simply vanish from the sample
+
+        self.bin_type = bin_type
+        self.default_bin = 0
+        zero_cnt = int(total_sample_cnt - len(values) - na_cnt)
+        values_sorted = np.sort(values, kind="stable")
+        distinct, counts = _distinct_with_zero(values_sorted, zero_cnt)
+        if len(distinct) == 0:
+            self.is_trivial = True
+            return self
+        self.min_val = float(distinct[0])
+        self.max_val = float(distinct[-1])
+
+        cnt_in_bin: List[int] = []
+        if bin_type == BIN_TYPE_NUMERICAL:
+            if self.missing_type == MISSING_ZERO:
+                bounds = find_bin_with_zero_as_one_bin(
+                    distinct, counts, max_bin, total_sample_cnt, min_data_in_bin)
+                if len(bounds) == 2:
+                    self.missing_type = MISSING_NONE
+            elif self.missing_type == MISSING_NONE:
+                bounds = find_bin_with_zero_as_one_bin(
+                    distinct, counts, max_bin, total_sample_cnt, min_data_in_bin)
+            else:
+                bounds = find_bin_with_zero_as_one_bin(
+                    distinct, counts, max_bin - 1, total_sample_cnt - na_cnt,
+                    min_data_in_bin)
+                bounds.append(math.nan)  # trailing NaN bin
+            self.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
+            self.num_bin = len(bounds)
+            # count per bin for trivial-feature filtering
+            cnt_in_bin = [0] * self.num_bin
+            i_bin = 0
+            for v, c in zip(distinct, counts):
+                while v > self.bin_upper_bound[i_bin]:
+                    i_bin += 1
+                cnt_in_bin[i_bin] += int(c)
+            if self.missing_type == MISSING_NAN:
+                cnt_in_bin[self.num_bin - 1] = na_cnt
+            check(self.num_bin <= max_bin, "num_bin exceeds max_bin")
+        else:
+            cnt_in_bin = self._find_bin_categorical(
+                distinct, counts, max_bin, min_data_in_bin, total_sample_cnt,
+                na_cnt)
+
+        self.is_trivial = self.num_bin <= 1
+        if not self.is_trivial and _need_filter(
+                cnt_in_bin, int(total_sample_cnt), min_split_data, bin_type):
+            self.is_trivial = True
+        if not self.is_trivial:
+            self.default_bin = int(self.value_to_bin(np.array([0.0]))[0])
+            if bin_type == BIN_TYPE_CATEGORICAL:
+                check(self.default_bin > 0, "categorical default_bin must be > 0")
+            self.sparse_rate = cnt_in_bin[self.default_bin] / max(total_sample_cnt, 1)
+        else:
+            self.sparse_rate = 1.0
+        return self
+
+    def _find_bin_categorical(self, distinct: np.ndarray, counts: np.ndarray,
+                              max_bin: int, min_data_in_bin: int,
+                              total_sample_cnt: int, na_cnt: int) -> List[int]:
+        """Categorical mapping: by descending count, 99% mass cutoff
+        (bin.cpp:310-375)."""
+        ints: List[int] = []
+        int_counts: List[int] = []
+        for v, c in zip(distinct, counts):
+            iv = int(v)
+            if iv < 0:
+                na_cnt += int(c)
+                log_warning("Met negative value in categorical features, "
+                            "will convert it to NaN")
+            elif ints and iv == ints[-1]:
+                int_counts[-1] += int(c)
+            else:
+                ints.append(iv)
+                int_counts.append(int(c))
+        self.num_bin = 0
+        rest_cnt = int(total_sample_cnt) - na_cnt
+        cnt_in_bin: List[int] = []
+        if rest_cnt > 0:
+            if ints and ints[-1] // 100 > len(ints):
+                log_warning("Met categorical feature which contains sparse values. "
+                            "Consider renumbering to consecutive integers "
+                            "started from zero")
+            order = sorted(range(len(ints)), key=lambda i: (-int_counts[i], ints[i]))
+            ints = [ints[i] for i in order]
+            int_counts = [int_counts[i] for i in order]
+            # avoid first bin being category 0 (bin 0 is the "default"/other bin)
+            if ints and ints[0] == 0:
+                if len(ints) == 1:
+                    ints.append(ints[0] + 1)
+                    int_counts.append(0)
+                ints[0], ints[1] = ints[1], ints[0]
+                int_counts[0], int_counts[1] = int_counts[1], int_counts[0]
+            cut_cnt = int((total_sample_cnt - na_cnt) * 0.99)
+            used_cnt = 0
+            eff_max_bin = min(len(ints), max_bin)
+            self.bin_2_categorical = []
+            self.categorical_2_bin = {}
+            cur = 0
+            while cur < len(ints) and (used_cnt < cut_cnt or self.num_bin < eff_max_bin):
+                if int_counts[cur] < min_data_in_bin and cur > 1:
+                    break
+                self.bin_2_categorical.append(ints[cur])
+                self.categorical_2_bin[ints[cur]] = self.num_bin
+                used_cnt += int_counts[cur]
+                cnt_in_bin.append(int_counts[cur])
+                self.num_bin += 1
+                cur += 1
+            if cur == len(ints) and na_cnt > 0:
+                self.bin_2_categorical.append(-1)
+                self.categorical_2_bin[-1] = self.num_bin
+                cnt_in_bin.append(0)
+                self.num_bin += 1
+            self.missing_type = (MISSING_NONE if cur == len(ints) and na_cnt == 0
+                                 else MISSING_NAN)
+            if cnt_in_bin:
+                # the last bin absorbs any leftover mass (reference adds
+                # total - used to the final bin's count for filtering purposes)
+                cnt_in_bin[-1] += int(total_sample_cnt) - used_cnt
+        return cnt_in_bin
+
+    # ---------------------------------------------------------------- apply
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized ValueToBin (bin.h:496-549)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == BIN_TYPE_NUMERICAL:
+            nan_mask = np.isnan(values)
+            v = np.where(nan_mask, 0.0, values)
+            ub = self.bin_upper_bound
+            n_search = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
+            # first bin whose upper bound >= value  (value <= ub[bin])
+            bins = np.searchsorted(ub[:max(n_search - 1, 0)], v, side="left")
+            if self.missing_type == MISSING_NAN:
+                bins = np.where(nan_mask, self.num_bin - 1, bins)
+            return bins.astype(np.int32)
+        # categorical
+        out = np.full(values.shape, self.num_bin - 1, dtype=np.int32)
+        nan_mask = ~np.isfinite(values)
+        iv = np.where(nan_mask, -1, values).astype(np.int64)
+        if self.categorical_2_bin:
+            cats = np.fromiter(self.categorical_2_bin.keys(), dtype=np.int64)
+            bins_ = np.fromiter(self.categorical_2_bin.values(), dtype=np.int64)
+            order = np.argsort(cats)
+            cats, bins_ = cats[order], bins_[order]
+            pos = np.searchsorted(cats, iv)
+            pos = np.clip(pos, 0, len(cats) - 1)
+            hit = (cats[pos] == iv) & (iv >= 0)
+            out = np.where(hit, bins_[pos], out).astype(np.int32)
+        return out
+
+    def bin_to_value(self, bin_idx: int) -> float:
+        """Representative real value of a bin (used for threshold realization;
+        reference BinMapper::BinToValue)."""
+        if self.bin_type == BIN_TYPE_NUMERICAL:
+            return float(self.bin_upper_bound[bin_idx])
+        return float(self.bin_2_categorical[bin_idx])
+
+    @property
+    def is_categorical(self) -> bool:
+        return self.bin_type == BIN_TYPE_CATEGORICAL
+
+    # ------------------------------------------------------------ serialization
+    def to_dict(self) -> dict:
+        return {
+            "num_bin": self.num_bin,
+            "missing_type": self.missing_type,
+            "bin_type": self.bin_type,
+            "is_trivial": self.is_trivial,
+            "sparse_rate": self.sparse_rate,
+            "bin_upper_bound": [float(x) for x in self.bin_upper_bound],
+            "bin_2_categorical": list(self.bin_2_categorical),
+            "min_val": self.min_val,
+            "max_val": self.max_val,
+            "default_bin": self.default_bin,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BinMapper":
+        m = cls()
+        m.num_bin = int(d["num_bin"])
+        m.missing_type = int(d["missing_type"])
+        m.bin_type = int(d["bin_type"])
+        m.is_trivial = bool(d["is_trivial"])
+        m.sparse_rate = float(d["sparse_rate"])
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
+        m.bin_2_categorical = [int(x) for x in d["bin_2_categorical"]]
+        m.categorical_2_bin = {c: i for i, c in enumerate(m.bin_2_categorical)}
+        m.min_val = float(d["min_val"])
+        m.max_val = float(d["max_val"])
+        m.default_bin = int(d["default_bin"])
+        return m

@@ -1,0 +1,276 @@
+"""The binned training Dataset.
+
+Reference: include/LightGBM/dataset.h:283-637 + src/io/dataset.cpp (Dataset,
+FeatureGroup, bin storage) and src/io/dataset_loader.cpp (construction from
+raw data: sample -> FindBin -> quantize all rows).
+
+TPU-first design departure (SURVEY.md §7): instead of per-group
+dense/sparse/4-bit bin storage classes with OpenMP push pipelines
+(src/io/dense_bin.hpp:48, sparse_bin.hpp:73), the dataset is ONE dense
+HBM-resident bin matrix ``[num_data, num_used_features]`` of uint8/uint16.
+Everything downstream (histograms, partitions) is a vectorized XLA/Pallas op
+over this matrix.  Sparse features stay dense here: bins compress the value
+range to <=max_bin levels, so a column is 1-2 bytes/row regardless of sparsity
+— EFB-style bundling becomes a pure memory optimization (later round) rather
+than a correctness requirement.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import check, log_fatal, log_info, log_warning
+from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
+                      MISSING_NAN, MISSING_NONE, MISSING_ZERO)
+from .metadata import Metadata
+
+_BINARY_MAGIC = b"lightgbm_tpu.dataset.v1\x00"
+
+
+class FeatureInfo:
+    """Per-used-feature metadata consumed by the tree learner."""
+
+    __slots__ = ("num_bin", "missing_type", "default_bin", "is_categorical",
+                 "monotone", "penalty")
+
+    def __init__(self, num_bin, missing_type, default_bin, is_categorical,
+                 monotone=0, penalty=1.0):
+        self.num_bin = num_bin
+        self.missing_type = missing_type
+        self.default_bin = default_bin
+        self.is_categorical = is_categorical
+        self.monotone = monotone
+        self.penalty = penalty
+
+
+class TpuDataset:
+    """Binned dataset: dense uint8/16 matrix + per-feature BinMappers + Metadata."""
+
+    def __init__(self):
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.bin_mappers: List[BinMapper] = []       # one per original feature
+        self.used_feature_indices: np.ndarray = np.array([], dtype=np.int32)
+        self.binned: Optional[np.ndarray] = None     # [N, F_used] uint8/uint16
+        self.metadata = Metadata()
+        self.feature_names: List[str] = []
+        self.max_num_bin: int = 0
+        self.monotone_constraints: Optional[List[int]] = None
+        self.feature_penalty: Optional[List[float]] = None
+        self._device_binned = None
+
+    # ------------------------------------------------------------ construction
+    @classmethod
+    def from_numpy(cls, data: np.ndarray, label: Optional[np.ndarray] = None,
+                   config: Optional[Config] = None,
+                   weights: Optional[np.ndarray] = None,
+                   group: Optional[np.ndarray] = None,
+                   init_score: Optional[np.ndarray] = None,
+                   categorical_features: Sequence[int] = (),
+                   feature_names: Optional[List[str]] = None,
+                   reference: Optional["TpuDataset"] = None) -> "TpuDataset":
+        """Build a dataset from a raw [N, F] float matrix.
+
+        Mirrors DatasetLoader::CostructFromSampleData (dataset_loader.cpp:553):
+        sample rows -> per-feature BinMapper::FindBin -> quantize every row.
+        When ``reference`` is given, its bin mappers are reused so validation
+        data aligns with training bins (Dataset::CreateValid, dataset.cpp:435).
+        """
+        cfg = config or Config()
+        data = np.asarray(data)
+        if data.ndim != 2:
+            raise ValueError("data must be 2-dimensional [num_data, num_features]")
+        n, num_features = data.shape
+        ds = cls()
+        ds.num_data = n
+        ds.num_total_features = num_features
+        ds.feature_names = (list(feature_names) if feature_names
+                            else [f"Column_{i}" for i in range(num_features)])
+
+        if reference is not None:
+            check(reference.num_total_features == num_features,
+                  "validation data has a different number of features")
+            ds.bin_mappers = reference.bin_mappers
+            ds.used_feature_indices = reference.used_feature_indices
+            ds.max_num_bin = reference.max_num_bin
+            ds.monotone_constraints = reference.monotone_constraints
+            ds.feature_penalty = reference.feature_penalty
+            ds.feature_names = list(reference.feature_names)
+        else:
+            ds._fit_bin_mappers(data, cfg, set(int(c) for c in categorical_features))
+
+        ds._quantize(data)
+        ds.metadata.init(n)
+        if label is not None:
+            ds.metadata.set_label(label)
+        if weights is not None:
+            ds.metadata.set_weights(weights)
+        if group is not None:
+            ds.metadata.set_query(group)
+        if init_score is not None:
+            ds.metadata.set_init_score(init_score)
+        return ds
+
+    def _fit_bin_mappers(self, data: np.ndarray, cfg: Config,
+                         categorical: set) -> None:
+        n = data.shape[0]
+        rng = np.random.RandomState(cfg.data_random_seed)
+        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+        sample_idx = (np.arange(n) if sample_cnt >= n
+                      else rng.choice(n, sample_cnt, replace=False))
+        max_bin_by_feature = list(cfg.max_bin_by_feature or [])
+        self.bin_mappers = []
+        for f in range(data.shape[1]):
+            col = np.asarray(data[sample_idx, f], dtype=np.float64)
+            bt = BIN_TYPE_CATEGORICAL if f in categorical else BIN_TYPE_NUMERICAL
+            mb = (max_bin_by_feature[f] if f < len(max_bin_by_feature)
+                  else cfg.max_bin)
+            m = BinMapper().find_bin(
+                col, total_sample_cnt=len(col), max_bin=mb,
+                min_data_in_bin=cfg.min_data_in_bin,
+                min_split_data=cfg.min_data_in_leaf,
+                bin_type=bt, use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing)
+            self.bin_mappers.append(m)
+        used = [f for f, m in enumerate(self.bin_mappers) if not m.is_trivial]
+        if not used:
+            log_warning("There are no meaningful features, as all feature "
+                        "values are constant.")
+        self.used_feature_indices = np.asarray(used, dtype=np.int32)
+        self.max_num_bin = max((self.bin_mappers[f].num_bin for f in used),
+                               default=1)
+        if cfg.monotone_constraints:
+            mc = list(cfg.monotone_constraints)
+            check(len(mc) == self.num_total_features,
+                  "monotone_constraints length must equal number of features")
+            self.monotone_constraints = [int(x) for x in mc]
+        if cfg.feature_contri:
+            fc = list(cfg.feature_contri)
+            check(len(fc) == self.num_total_features,
+                  "feature_contri length must equal number of features")
+            self.feature_penalty = [float(x) for x in fc]
+
+    def _quantize(self, data: np.ndarray) -> None:
+        used = self.used_feature_indices
+        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+        out = np.empty((data.shape[0], len(used)), dtype=dtype)
+        for j, f in enumerate(used):
+            out[:, j] = self.bin_mappers[f].value_to_bin(
+                np.asarray(data[:, f], dtype=np.float64)).astype(dtype)
+        self.binned = out
+        self._device_binned = None
+
+    # ---------------------------------------------------------------- accessors
+    @property
+    def num_used_features(self) -> int:
+        return len(self.used_feature_indices)
+
+    def feature_infos(self) -> List[FeatureInfo]:
+        infos = []
+        for f in self.used_feature_indices:
+            m = self.bin_mappers[f]
+            mono = 0
+            if self.monotone_constraints is not None:
+                mono = self.monotone_constraints[f]
+            pen = 1.0
+            if self.feature_penalty is not None:
+                pen = self.feature_penalty[f]
+            infos.append(FeatureInfo(m.num_bin, m.missing_type, m.default_bin,
+                                     m.is_categorical, mono, pen))
+        return infos
+
+    def real_threshold(self, used_feature: int, bin_threshold: int) -> float:
+        """Bin threshold -> real-valued threshold for the saved model
+        (reference Dataset::RealThreshold)."""
+        f = int(self.used_feature_indices[used_feature])
+        return self.bin_mappers[f].bin_to_value(int(bin_threshold))
+
+    def inner_feature_index(self, real_feature: int) -> int:
+        hits = np.nonzero(self.used_feature_indices == real_feature)[0]
+        return int(hits[0]) if len(hits) else -1
+
+    def device_binned(self):
+        """The bin matrix as a device array (uploaded once, cached)."""
+        import jax.numpy as jnp
+        if self._device_binned is None:
+            self._device_binned = jnp.asarray(self.binned)
+        return self._device_binned
+
+    def create_valid(self, data: np.ndarray, label: Optional[np.ndarray] = None,
+                     **kwargs) -> "TpuDataset":
+        return TpuDataset.from_numpy(data, label=label, reference=self, **kwargs)
+
+    # ----------------------------------------------------------- binary cache
+    def save_binary(self, filename: str) -> None:
+        """Binary dataset cache (reference Dataset::SaveBinaryFile,
+        dataset.cpp:624; format is ours, token-checked the same way)."""
+        import json
+        meta = {
+            "num_data": self.num_data,
+            "num_total_features": self.num_total_features,
+            "feature_names": self.feature_names,
+            "used_feature_indices": self.used_feature_indices.tolist(),
+            "max_num_bin": self.max_num_bin,
+            "bin_mappers": [m.to_dict() for m in self.bin_mappers],
+            "has_weights": self.metadata.weights is not None,
+            "has_query": self.metadata.query_boundaries is not None,
+            "has_init_score": self.metadata.init_score is not None,
+            "binned_dtype": str(self.binned.dtype),
+        }
+        blob = json.dumps(meta).encode()
+        with open(filename, "wb") as fh:
+            fh.write(_BINARY_MAGIC)
+            fh.write(struct.pack("<q", len(blob)))
+            fh.write(blob)
+            fh.write(self.binned.tobytes())
+            fh.write(self.metadata.label.astype(np.float32).tobytes())
+            if self.metadata.weights is not None:
+                fh.write(self.metadata.weights.astype(np.float32).tobytes())
+            if self.metadata.query_boundaries is not None:
+                fh.write(struct.pack("<q", len(self.metadata.query_boundaries)))
+                fh.write(self.metadata.query_boundaries.astype(np.int32).tobytes())
+            if self.metadata.init_score is not None:
+                fh.write(struct.pack("<q", len(self.metadata.init_score)))
+                fh.write(self.metadata.init_score.astype(np.float64).tobytes())
+        log_info(f"Saved binary dataset to {filename}")
+
+    @classmethod
+    def load_binary(cls, filename: str) -> "TpuDataset":
+        import json
+        with open(filename, "rb") as fh:
+            magic = fh.read(len(_BINARY_MAGIC))
+            if magic != _BINARY_MAGIC:
+                log_fatal(f"{filename} is not a lightgbm_tpu binary dataset")
+            (blob_len,) = struct.unpack("<q", fh.read(8))
+            meta = json.loads(fh.read(blob_len).decode())
+            ds = cls()
+            ds.num_data = meta["num_data"]
+            ds.num_total_features = meta["num_total_features"]
+            ds.feature_names = meta["feature_names"]
+            ds.used_feature_indices = np.asarray(meta["used_feature_indices"],
+                                                 dtype=np.int32)
+            ds.max_num_bin = meta["max_num_bin"]
+            ds.bin_mappers = [BinMapper.from_dict(d) for d in meta["bin_mappers"]]
+            dtype = np.dtype(meta["binned_dtype"])
+            nbytes = ds.num_data * len(ds.used_feature_indices) * dtype.itemsize
+            ds.binned = np.frombuffer(fh.read(nbytes), dtype=dtype).reshape(
+                ds.num_data, len(ds.used_feature_indices)).copy()
+            ds.metadata.init(ds.num_data)
+            ds.metadata.label = np.frombuffer(
+                fh.read(4 * ds.num_data), dtype=np.float32).copy()
+            if meta["has_weights"]:
+                ds.metadata.weights = np.frombuffer(
+                    fh.read(4 * ds.num_data), dtype=np.float32).copy()
+            if meta["has_query"]:
+                (qlen,) = struct.unpack("<q", fh.read(8))
+                ds.metadata.query_boundaries = np.frombuffer(
+                    fh.read(4 * qlen), dtype=np.int32).copy()
+            if meta["has_init_score"]:
+                (slen,) = struct.unpack("<q", fh.read(8))
+                ds.metadata.init_score = np.frombuffer(
+                    fh.read(8 * slen), dtype=np.float64).copy()
+        return ds

@@ -1,0 +1,5 @@
+from .log import (LightGBMError, Timer, check, log_debug, log_fatal, log_info,
+                  log_warning, register_log_callback, set_verbosity)
+
+__all__ = ["LightGBMError", "Timer", "check", "log_debug", "log_fatal",
+           "log_info", "log_warning", "register_log_callback", "set_verbosity"]

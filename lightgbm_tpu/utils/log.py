@@ -1,0 +1,86 @@
+"""Logging with LightGBM-style levels gated by verbosity.
+
+Reference: include/LightGBM/utils/log.h:30-120 (`Log` static class with
+Fatal/Warning/Info/Debug and a redirectable callback).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, Optional
+
+_FATAL, _WARNING, _INFO, _DEBUG = -1, 0, 1, 2
+
+_verbosity = 1
+_callback: Optional[Callable[[str], None]] = None
+
+
+class LightGBMError(Exception):
+    """Raised on fatal errors (reference Log::Fatal throws std::runtime_error)."""
+
+
+def set_verbosity(level: int) -> None:
+    global _verbosity
+    _verbosity = level
+
+
+def get_verbosity() -> int:
+    return _verbosity
+
+
+def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
+    global _callback
+    _callback = cb
+
+
+def _write(level_str: str, msg: str) -> None:
+    line = f"[LightGBM-TPU] [{level_str}] {msg}\n"
+    if _callback is not None:
+        _callback(line)
+    else:
+        sys.stdout.write(line)
+        sys.stdout.flush()
+
+
+def log_debug(msg: str) -> None:
+    if _verbosity >= _DEBUG:
+        _write("Debug", msg)
+
+
+def log_info(msg: str) -> None:
+    if _verbosity >= _INFO:
+        _write("Info", msg)
+
+
+def log_warning(msg: str) -> None:
+    if _verbosity >= _WARNING:
+        _write("Warning", msg)
+
+
+def log_fatal(msg: str) -> None:
+    raise LightGBMError(msg)
+
+
+def check(cond: bool, msg: str = "check failed") -> None:
+    if not cond:
+        log_fatal(msg)
+
+
+class Timer:
+    """Scoped wall-clock timer (reference: Common::Timer, utils/common.h:32-60)."""
+
+    def __init__(self, name: str = "", print_on_exit: bool = False):
+        self.name = name
+        self.print_on_exit = print_on_exit
+        self.start = 0.0
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "Timer":
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self.start
+        if self.print_on_exit:
+            log_info(f"{self.name}: {self.elapsed:.3f}s")

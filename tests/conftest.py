@@ -1,0 +1,23 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+The reference has no single-process distributed test seam (SURVEY.md §4); we
+get one for free by forcing the CPU platform with 8 virtual devices so the
+data-/feature-parallel learners run their real collective paths in-process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,147 @@
+import math
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.core.binning import (BIN_TYPE_CATEGORICAL, BinMapper,
+                                       MISSING_NAN, MISSING_NONE, MISSING_ZERO,
+                                       greedy_find_bin)
+
+
+def test_greedy_find_bin_few_distinct():
+    vals = np.array([1.0, 2.0, 3.0], dtype=np.float64)
+    cnts = np.array([10, 10, 10])
+    bounds = greedy_find_bin(vals, cnts, max_bin=255, total_cnt=30,
+                             min_data_in_bin=3)
+    # one bound between each pair of distinct values, then +inf
+    assert len(bounds) == 3
+    assert bounds[0] == pytest.approx(1.5)
+    assert bounds[1] == pytest.approx(2.5)
+    assert math.isinf(bounds[2])
+
+
+def test_greedy_find_bin_min_data_in_bin():
+    vals = np.array([1.0, 2.0, 3.0], dtype=np.float64)
+    cnts = np.array([2, 2, 30])
+    bounds = greedy_find_bin(vals, cnts, max_bin=255, total_cnt=34,
+                             min_data_in_bin=3)
+    # 1.0 alone can't fill a bin (2 < 3); it merges with 2.0, then the
+    # accumulated 4 >= 3 places one bound between 2.0 and 3.0
+    assert len(bounds) == 2
+    assert bounds[0] == pytest.approx(2.5)
+
+
+def test_uniform_binning_partitions_evenly():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(size=10000)
+    m = BinMapper().find_bin(x, total_sample_cnt=len(x), max_bin=16,
+                             min_data_in_bin=3)
+    assert m.num_bin <= 16
+    assert not m.is_trivial
+    bins = m.value_to_bin(x)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    # equal-frequency-ish: no bin is more than 3x the mean
+    assert counts.max() < 3 * len(x) / m.num_bin
+
+
+def test_value_to_bin_monotone():
+    rng = np.random.RandomState(1)
+    x = rng.normal(size=5000)
+    m = BinMapper().find_bin(x, len(x), max_bin=63)
+    xs = np.sort(rng.normal(size=1000))
+    b = m.value_to_bin(xs)
+    assert (np.diff(b) >= 0).all()
+    assert b.min() >= 0 and b.max() < m.num_bin
+
+
+def test_trivial_constant_feature():
+    x = np.full(100, 7.0)
+    m = BinMapper().find_bin(x, len(x), max_bin=255)
+    assert m.is_trivial
+
+
+def test_missing_nan_gets_own_bin():
+    rng = np.random.RandomState(2)
+    x = rng.normal(size=1000)
+    x[::10] = np.nan
+    m = BinMapper().find_bin(x, len(x), max_bin=255)
+    assert m.missing_type == MISSING_NAN
+    b = m.value_to_bin(x)
+    assert (b[::10] == m.num_bin - 1).all()
+    assert (b[1::10] < m.num_bin - 1).all()
+
+
+def test_no_missing():
+    x = np.linspace(-1, 1, 1000)
+    m = BinMapper().find_bin(x, len(x), max_bin=255)
+    assert m.missing_type == MISSING_NONE
+
+
+def test_zero_as_missing():
+    rng = np.random.RandomState(3)
+    x = rng.normal(size=1000)
+    x[:500] = 0.0
+    m = BinMapper().find_bin(x, len(x), max_bin=63, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    # zero maps to its own bin == default_bin
+    zb = m.value_to_bin(np.array([0.0]))[0]
+    assert zb == m.default_bin
+
+
+def test_zero_bin_boundary():
+    # values on both sides of zero: zero gets a dedicated bin
+    x = np.concatenate([np.linspace(-5, -1, 400), np.zeros(200),
+                        np.linspace(1, 5, 400)])
+    m = BinMapper().find_bin(x, len(x), max_bin=63)
+    zb = int(m.value_to_bin(np.array([0.0]))[0])
+    nb = int(m.value_to_bin(np.array([-1.0]))[0])
+    pb = int(m.value_to_bin(np.array([1.0]))[0])
+    assert nb < zb < pb
+
+
+def test_categorical_binning():
+    rng = np.random.RandomState(4)
+    # category frequencies: 0 is most common but must not land in bin 0
+    x = rng.choice([0, 1, 2, 3, 4], p=[0.5, 0.2, 0.15, 0.1, 0.05],
+                   size=2000).astype(np.float64)
+    m = BinMapper().find_bin(x, len(x), max_bin=255,
+                             bin_type=BIN_TYPE_CATEGORICAL)
+    assert m.is_categorical
+    assert not m.is_trivial
+    assert m.default_bin > 0  # category 0 never in bin 0
+    b = m.value_to_bin(x)
+    # same category -> same bin, distinct categories -> distinct bins
+    for cat in [0, 1, 2, 3, 4]:
+        bb = b[x == cat]
+        assert (bb == bb[0]).all()
+    assert len(np.unique(b)) == 5
+
+
+def test_categorical_unseen_goes_to_last_bin():
+    x = np.array([1, 1, 2, 2, 3, 3] * 20, dtype=np.float64)
+    m = BinMapper().find_bin(x, len(x), max_bin=255,
+                             bin_type=BIN_TYPE_CATEGORICAL)
+    b = m.value_to_bin(np.array([99.0]))
+    assert b[0] == m.num_bin - 1
+
+
+def test_sparse_column_implicit_zeros():
+    # only non-zero entries passed; total count includes implicit zeros
+    nonzero = np.array([1.0, 2.0, 3.0] * 10)
+    m = BinMapper().find_bin(nonzero, total_sample_cnt=1000, max_bin=63)
+    assert not m.is_trivial
+    assert m.sparse_rate > 0.9
+    zb = int(m.value_to_bin(np.array([0.0]))[0])
+    assert zb == m.default_bin
+
+
+def test_roundtrip_serialization():
+    rng = np.random.RandomState(5)
+    x = rng.normal(size=1000)
+    x[::7] = np.nan
+    m = BinMapper().find_bin(x, len(x), max_bin=63)
+    m2 = BinMapper.from_dict(m.to_dict())
+    xs = rng.normal(size=100)
+    np.testing.assert_array_equal(m.value_to_bin(xs), m2.value_to_bin(xs))
+    assert m2.num_bin == m.num_bin
+    assert m2.missing_type == m.missing_type

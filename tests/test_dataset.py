@@ -1,0 +1,76 @@
+import os
+
+import numpy as np
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.dataset import TpuDataset
+
+
+def _make(rng, n=500, f=5):
+    X = rng.normal(size=(n, f))
+    y = rng.normal(size=n)
+    return X, y
+
+
+def test_from_numpy_basic(rng):
+    X, y = _make(rng)
+    ds = TpuDataset.from_numpy(X, y, config=Config(max_bin=63))
+    assert ds.num_data == 500
+    assert ds.num_used_features == 5
+    assert ds.binned.shape == (500, 5)
+    assert ds.binned.dtype == np.uint8
+    assert ds.max_num_bin <= 64
+    np.testing.assert_allclose(ds.metadata.label, y.astype(np.float32))
+
+
+def test_trivial_feature_dropped(rng):
+    X, y = _make(rng)
+    X[:, 2] = 1.5  # constant
+    ds = TpuDataset.from_numpy(X, y)
+    assert ds.num_used_features == 4
+    assert 2 not in ds.used_feature_indices
+
+
+def test_valid_aligns_with_train(rng):
+    X, y = _make(rng)
+    ds = TpuDataset.from_numpy(X, y, config=Config(max_bin=63))
+    Xv, yv = _make(rng, n=100)
+    vs = ds.create_valid(Xv, yv)
+    assert vs.bin_mappers is ds.bin_mappers
+    # same value -> same bin under both datasets
+    col = ds.bin_mappers[0].value_to_bin(Xv[:, 0])
+    np.testing.assert_array_equal(vs.binned[:, 0], col.astype(vs.binned.dtype))
+
+
+def test_categorical_feature(rng):
+    X, y = _make(rng)
+    X[:, 1] = rng.choice([0, 1, 2, 3], size=len(X))
+    ds = TpuDataset.from_numpy(X, y, categorical_features=[1])
+    infos = ds.feature_infos()
+    j = ds.inner_feature_index(1)
+    assert infos[j].is_categorical
+
+
+def test_weights_group_init_score(rng):
+    X, y = _make(rng, n=100)
+    w = rng.uniform(0.5, 2.0, size=100)
+    group = np.array([30, 30, 40])
+    ds = TpuDataset.from_numpy(X, y, weights=w, group=group)
+    assert ds.metadata.num_queries == 3
+    assert ds.metadata.query_boundaries[-1] == 100
+    assert ds.metadata.query_weights is not None
+
+
+def test_binary_roundtrip(tmp_path, rng):
+    X, y = _make(rng, n=200)
+    w = rng.uniform(size=200)
+    ds = TpuDataset.from_numpy(X, y, weights=w, config=Config(max_bin=31))
+    path = os.path.join(tmp_path, "ds.bin")
+    ds.save_binary(path)
+    ds2 = TpuDataset.load_binary(path)
+    np.testing.assert_array_equal(ds.binned, ds2.binned)
+    np.testing.assert_allclose(ds.metadata.label, ds2.metadata.label)
+    np.testing.assert_allclose(ds.metadata.weights, ds2.metadata.weights)
+    assert ds2.max_num_bin == ds.max_num_bin
+    assert [m.num_bin for m in ds2.bin_mappers] == \
+           [m.num_bin for m in ds.bin_mappers]
