@@ -1,0 +1,6 @@
+from .histogram import histogram_chunked, leaf_histogram
+from .split import (FeatureMeta, SplitInfo, SplitParams, best_split,
+                    leaf_gain, leaf_output)
+
+__all__ = ["histogram_chunked", "leaf_histogram", "FeatureMeta", "SplitInfo",
+           "SplitParams", "best_split", "leaf_gain", "leaf_output"]

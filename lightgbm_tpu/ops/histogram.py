@@ -1,0 +1,97 @@
+"""Histogram construction: the hottest op in histogram GBDT.
+
+Replaces the reference's three implementations — the 4-way unrolled CPU loop
+(src/io/dense_bin.hpp:69-193), the sparse/ordered bins, and the OpenCL
+local-atomic kernels (src/treelearner/ocl/histogram256.cl) — with a single
+TPU-idiomatic formulation: per row-chunk, a one-hot expansion of the bin ids
+contracted against the (grad, hess, count) weights on the MXU, accumulated
+across chunks with ``lax.scan``.  TPUs have no cheap atomic scatter-add, but
+bins <= 256 make ``one_hot(bin)^T @ weights`` an MXU-friendly matmul
+(SURVEY.md §7 "hard parts").  A Pallas kernel with the one-hot kept in VMEM
+slots in behind the same signature (ops/pallas_histogram.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pick_row_chunk(num_data: int, num_features: int, num_bins: int) -> int:
+    """Choose a row-chunk size keeping the transient one-hot under ~64MB."""
+    budget = 64 * 1024 * 1024 // 4
+    chunk = max(256, budget // max(num_features * num_bins, 1))
+    chunk = 1 << (chunk - 1).bit_length() if chunk & (chunk - 1) else chunk
+    return int(min(chunk, max(256, num_data)))
+
+
+def histogram_chunked(bins: jax.Array, weights: jax.Array, num_bins: int,
+                      row_chunk: int = 0) -> jax.Array:
+    """Accumulate per-feature histograms.
+
+    Args:
+      bins: ``[N, F]`` integer bin ids (uint8/uint16/int32).
+      weights: ``[K, N]`` float32 per-row weight channels — typically
+        ``[grad*m, hess*m, m]`` where ``m`` is the row's inclusion weight
+        (leaf membership x bagging).
+      num_bins: global bin budget B (max over features).
+      row_chunk: rows per accumulation step; 0 = auto.
+
+    Returns:
+      ``[F, B, K]`` float32 histogram.
+    """
+    n, f = bins.shape
+    k = weights.shape[0]
+    if row_chunk <= 0:
+        row_chunk = _pick_row_chunk(n, f, num_bins)
+    if row_chunk >= n:
+        return _hist_one_chunk(bins, weights, num_bins)
+
+    num_full = n // row_chunk
+    rem = n - num_full * row_chunk
+
+    def body(acc, args):
+        bc, wc = args
+        return acc + _hist_one_chunk(bc, wc, num_bins), None
+
+    bins_main = bins[: num_full * row_chunk].reshape(num_full, row_chunk, f)
+    w_main = (weights[:, : num_full * row_chunk]
+              .reshape(k, num_full, row_chunk).transpose(1, 0, 2))
+    init = jnp.zeros((f, num_bins, k), dtype=jnp.float32)
+    acc, _ = lax.scan(body, init, (bins_main, w_main))
+    if rem:
+        acc = acc + _hist_one_chunk(bins[num_full * row_chunk:],
+                                    weights[:, num_full * row_chunk:], num_bins)
+    return acc
+
+
+def _hist_one_chunk(bins: jax.Array, weights: jax.Array,
+                    num_bins: int) -> jax.Array:
+    """[R,F] bins x [K,R] weights -> [F,B,K] via one-hot matmul."""
+    onehot = jax.nn.one_hot(bins.astype(jnp.int32), num_bins,
+                            dtype=jnp.float32)          # [R, F, B]
+    # contract rows on the MXU; HIGHEST keeps f32 gradient mantissas intact
+    # (the reference accumulates in f64, gpu_use_dp toggles the same concern
+    # for the OpenCL kernels — gpu_tree_learner.cpp:677)
+    return jnp.einsum("rfb,kr->fbk", onehot, weights,
+                      precision=lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "row_chunk"))
+def leaf_histogram(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                   member: jax.Array, num_bins: int,
+                   row_chunk: int = 0) -> jax.Array:
+    """Histogram of (sum_grad, sum_hess, count) for one leaf.
+
+    ``member`` is a float mask/weight per row (0 outside the leaf; bagging
+    weights fold in here).  Equivalent to the reference's ordered-gradient
+    gather + per-group ConstructHistogram (src/io/dataset.cpp:778-946) but as
+    one dense masked pass.
+    """
+    weights = jnp.stack([grad * member, hess * member, member])
+    return histogram_chunked(bins, weights, num_bins, row_chunk)

@@ -1,0 +1,360 @@
+"""Best-split search over histograms, vectorized across (feature, threshold).
+
+Re-expresses the reference's sequential two-direction scans
+(FeatureHistogram::FindBestThresholdSequence,
+src/treelearner/feature_histogram.hpp:508-650) as cumulative sums over the
+bin axis with validity masks, so every (feature, threshold, direction)
+candidate is evaluated in parallel on the VPU and the winner picked by one
+argmax.  Gain math matches GetSplitGains / CalculateSplittedLeafOutput /
+GetLeafSplitGainGivenOutput (feature_histogram.hpp:451-506): L1 soft
+thresholding, L2, max_delta_step clamp, monotone-direction rejection.
+
+Missing-value semantics (feature_histogram.hpp:91-116):
+  * MissingType::None  — single right-to-left scan (missing impossible).
+  * MissingType::Zero  — the zero bin is excluded from both running sums and
+    from the candidate thresholds; its mass implicitly follows the default
+    direction (default_left = True for the right-to-left scan).
+  * MissingType::NaN   — the trailing NaN bin is excluded from the running
+    sums; two scans try NaN-left and NaN-right.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+K_EPSILON = 1e-15
+NEG_INF = -jnp.inf
+
+
+class FeatureMeta(NamedTuple):
+    """Per-used-feature metadata as device arrays [F]."""
+    num_bin: jax.Array       # i32
+    missing_type: jax.Array  # i32 (0 none / 1 zero / 2 nan)
+    default_bin: jax.Array   # i32
+    is_cat: jax.Array        # bool
+    monotone: jax.Array      # i32 (-1/0/+1)
+    penalty: jax.Array       # f32 (feature_contri)
+
+
+class SplitParams(NamedTuple):
+    """Static split hyper-parameters (python floats -> folded into jit)."""
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    max_delta_step: float = 0.0
+    min_data_in_leaf: float = 20.0
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+
+
+class SplitInfo(NamedTuple):
+    """Best split of one leaf — all scalars (reference SplitInfo,
+    src/treelearner/split_info.hpp:22)."""
+    gain: jax.Array
+    feature: jax.Array        # i32 index into used features; -1 = no split
+    threshold: jax.Array      # i32 bin threshold (numerical) or category bin set id
+    default_left: jax.Array   # bool
+    is_cat: jax.Array         # bool
+    cat_bitset: jax.Array     # u32[8] bitset of left-going bins (categorical)
+    left_g: jax.Array
+    left_h: jax.Array
+    left_c: jax.Array
+    right_g: jax.Array
+    right_h: jax.Array
+    right_c: jax.Array
+    left_out: jax.Array
+    right_out: jax.Array
+
+
+def threshold_l1(s, l1):
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def leaf_output(G, H, l1, l2, max_delta_step):
+    """-ThresholdL1(G)/(H+l2), clamped to max_delta_step
+    (CalculateSplittedLeafOutput, feature_histogram.hpp:453-460)."""
+    out = -threshold_l1(G, l1) / (H + l2 + K_EPSILON)
+    if max_delta_step > 0.0:
+        out = jnp.clip(out, -max_delta_step, max_delta_step)
+    return out
+
+
+def leaf_output_constrained(G, H, l1, l2, max_delta_step, lo, hi):
+    return jnp.clip(leaf_output(G, H, l1, l2, max_delta_step), lo, hi)
+
+
+def leaf_gain_given_output(G, H, l1, l2, out):
+    sg = threshold_l1(G, l1)
+    return -(2.0 * sg * out + (H + l2) * out * out)
+
+
+def leaf_gain(G, H, l1, l2, max_delta_step):
+    return leaf_gain_given_output(G, H, l1, l2,
+                                  leaf_output(G, H, l1, l2, max_delta_step))
+
+
+def _split_gain(Gl, Hl, Gr, Hr, p: SplitParams, mono, lo, hi,
+                extra_l2: float = 0.0):
+    l2 = p.lambda_l2 + extra_l2
+    out_l = jnp.clip(leaf_output(Gl, Hl, p.lambda_l1, l2, p.max_delta_step), lo, hi)
+    out_r = jnp.clip(leaf_output(Gr, Hr, p.lambda_l1, l2, p.max_delta_step), lo, hi)
+    gain = (leaf_gain_given_output(Gl, Hl, p.lambda_l1, l2, out_l)
+            + leaf_gain_given_output(Gr, Hr, p.lambda_l1, l2, out_r))
+    mono_bad = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
+    return jnp.where(mono_bad, 0.0, gain)
+
+
+def _numerical_candidates(hist, parent, fmeta: FeatureMeta, p: SplitParams,
+                          lo, hi):
+    """Gains for every (feature, threshold, direction) numerical candidate.
+
+    Returns (gain [F, T, 2], left [F, T, 2, 3]) with T = B-1 thresholds;
+    direction 0 = missing/default LEFT (the reference's dir=-1 scan),
+    direction 1 = missing RIGHT (dir=+1).
+    """
+    F, B, _ = hist.shape
+    b_idx = jnp.arange(B, dtype=jnp.int32)[None, :]              # [1, B]
+    nb = fmeta.num_bin[:, None]
+    mt = fmeta.missing_type[:, None]
+    nan_bin = jnp.where(mt == MISSING_NAN, nb - 1, -1)
+    zero_skip = jnp.where(mt == MISSING_ZERO, fmeta.default_bin[:, None], -1)
+    in_range = b_idx < nb
+    excluded = (b_idx == nan_bin) | (b_idx == zero_skip)
+    eff = hist * (in_range & ~excluded)[:, :, None].astype(hist.dtype)
+    cum = jnp.cumsum(eff, axis=1)                                 # [F, B, 3]
+    total_eff = cum[:, -1:, :]
+    cum_t = cum[:, :-1, :]                                        # [F, T, 3]
+
+    parent = parent[None, None, :]                                # [1, 1, 3]
+    # dir 0 (missing left): right side accumulated from the top, missing mass
+    # falls to the left as parent - right.
+    right0 = total_eff - cum_t
+    left0 = parent - right0
+    # dir 1 (missing right): left side accumulated from the bottom.
+    left1 = cum_t
+    right1 = parent - left1
+
+    left = jnp.stack([left0, left1], axis=2)                      # [F, T, 2, 3]
+    right = jnp.stack([right0, right1], axis=2)
+
+    Gl, Hl, Cl = left[..., 0], left[..., 1] + K_EPSILON, left[..., 2]
+    Gr, Hr, Cr = right[..., 0], right[..., 1] + K_EPSILON, right[..., 2]
+    mono = fmeta.monotone[:, None, None]
+    gain = _split_gain(Gl, Hl, Gr, Hr, p, mono, lo, hi)
+
+    t_idx = jnp.arange(B - 1, dtype=jnp.int32)[None, :, None]     # [1, T, 1]
+    nb3 = nb[:, :, None]
+    mt3 = mt[:, :, None]
+    dir_idx = jnp.arange(2, dtype=jnp.int32)[None, None, :]
+    valid = t_idx < nb3 - 1
+    # NaN bin cannot be a left-inclusive threshold when NaN defaults left
+    valid &= ~((mt3 == MISSING_NAN) & (dir_idx == 0) & (t_idx >= nb3 - 2))
+    # zero-type: the skipped zero bin is not a candidate threshold
+    valid &= ~((mt3 == MISSING_ZERO) & (t_idx == zero_skip[:, :, None]))
+    # second direction only scanned for missing-capable features with >2 bins
+    valid &= ~((dir_idx == 1) & ((mt3 == MISSING_NONE) | (nb3 <= 2)))
+    valid &= ~fmeta.is_cat[:, None, None]
+    valid &= (Cl >= p.min_data_in_leaf) & (Cr >= p.min_data_in_leaf)
+    valid &= (Hl >= p.min_sum_hessian_in_leaf) & (Hr >= p.min_sum_hessian_in_leaf)
+
+    gain = jnp.where(valid, gain, NEG_INF)
+    return gain, left
+
+
+def _categorical_onehot_candidates(hist, parent, fmeta: FeatureMeta,
+                                   p: SplitParams, lo, hi):
+    """One-hot categorical candidates: bin b alone goes left
+    (FindBestThresholdCategorical one-hot branch, feature_histogram.hpp:118+)."""
+    F, B, _ = hist.shape
+    left = hist                                                   # [F, B, 3]
+    right = parent[None, None, :] - left
+    Gl, Hl, Cl = left[..., 0], left[..., 1] + K_EPSILON, left[..., 2]
+    Gr, Hr, Cr = right[..., 0], right[..., 1] + K_EPSILON, right[..., 2]
+    mono = fmeta.monotone[:, None]
+    gain = _split_gain(Gl, Hl, Gr, Hr, p, mono, lo, hi, extra_l2=p.cat_l2)
+
+    b_idx = jnp.arange(B, dtype=jnp.int32)[None, :]
+    valid = fmeta.is_cat[:, None] & (b_idx < fmeta.num_bin[:, None])
+    valid &= (Cl >= p.min_data_in_leaf) & (Cr >= p.min_data_in_leaf)
+    valid &= (Hl >= p.min_sum_hessian_in_leaf) & (Hr >= p.min_sum_hessian_in_leaf)
+    gain = jnp.where(valid, gain, NEG_INF)
+    return gain, left
+
+
+def _categorical_sorted_candidates(hist, parent, fmeta: FeatureMeta,
+                                   p: SplitParams, lo, hi):
+    """Sorted-subset categorical scan: order bins by grad/hess ratio, take a
+    prefix or suffix of the order as the left set
+    (feature_histogram.hpp:118-300: sort by sum_gradients/(sum_hessians +
+    cat_smooth), scan both directions up to max_cat_threshold, cat_l2).
+
+    Returns (gain [F, B, 2], left [F, B, 2, 3], order [F, B]) where candidate
+    (f, k, d) means: order positions <= k go LEFT (d=0), or order positions
+    >= k go LEFT (d=1).
+    """
+    F, B, _ = hist.shape
+    b_idx = jnp.arange(B, dtype=jnp.int32)[None, :]
+    in_range = b_idx < fmeta.num_bin[:, None]
+    cnt = hist[..., 2]
+    # bins with no data are pushed to the end of the order and contribute 0
+    ratio = hist[..., 0] / (hist[..., 1] + p.cat_smooth)
+    ratio = jnp.where(in_range & (cnt > 0), ratio, jnp.inf)
+    order = jnp.argsort(ratio, axis=1).astype(jnp.int32)          # [F, B]
+    sorted_hist = jnp.take_along_axis(hist, order[:, :, None], axis=1)
+    sorted_valid = jnp.take_along_axis(
+        (in_range & (cnt > 0)), order, axis=1)
+    sorted_hist = sorted_hist * sorted_valid[:, :, None]
+
+    pre = jnp.cumsum(sorted_hist, axis=1)                         # prefix sums
+    total_eff = pre[:, -1:, :]
+    suf = total_eff - pre + sorted_hist                           # suffix sums
+    left = jnp.stack([pre, suf], axis=2)                          # [F, B, 2, 3]
+    right = parent[None, None, None, :] - left
+
+    Gl, Hl, Cl = left[..., 0], left[..., 1] + K_EPSILON, left[..., 2]
+    Gr, Hr, Cr = right[..., 0], right[..., 1] + K_EPSILON, right[..., 2]
+    mono = fmeta.monotone[:, None, None]
+    gain = _split_gain(Gl, Hl, Gr, Hr, p, mono, lo, hi, extra_l2=p.cat_l2)
+
+    num_valid = sorted_valid.sum(axis=1).astype(jnp.int32)[:, None, None]
+    k_idx = b_idx[:, :, None]
+    left_size = jnp.where(jnp.arange(2)[None, None, :] == 0,
+                          k_idx + 1, num_valid - k_idx)
+    valid = fmeta.is_cat[:, None, None] & sorted_valid[:, :, None]
+    # a strict non-empty subset, at most max_cat_threshold categories left
+    valid &= (left_size >= 1) & (left_size < num_valid)
+    valid &= left_size <= int(p.max_cat_threshold)
+    valid &= (Cl >= p.min_data_in_leaf) & (Cr >= p.min_data_in_leaf)
+    valid &= (Hl >= p.min_sum_hessian_in_leaf) & (Hr >= p.min_sum_hessian_in_leaf)
+    gain = jnp.where(valid, gain, NEG_INF)
+    return gain, left, order
+
+
+def build_cat_bitset(selected_bins_mask: jax.Array) -> jax.Array:
+    """[B] bool -> u32[8] bitset (supports max_bin <= 256)."""
+    B = selected_bins_mask.shape[0]
+    pad = (-B) % 32
+    m = jnp.pad(selected_bins_mask.astype(jnp.uint32), (0, pad)).reshape(-1, 32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, :]
+    words = (m * weights).sum(axis=1).astype(jnp.uint32)
+    out = jnp.zeros(8, dtype=jnp.uint32)
+    return out.at[: words.shape[0]].set(words[:8])
+
+
+def best_split(hist: jax.Array, parent_g, parent_h, parent_c,
+               fmeta: FeatureMeta, params: SplitParams,
+               feature_mask: jax.Array, mono_lo=None, mono_hi=None) -> SplitInfo:
+    """Find the best split of one leaf from its [F, B, 3] histogram.
+
+    Mirrors SerialTreeLearner::FindBestSplitsFromHistograms
+    (serial_tree_learner.cpp:549-640): per-feature best threshold, then the
+    per-leaf argmax over features with feature-fraction masking and penalty.
+    """
+    p = params
+    F, B, _ = hist.shape
+    parent = jnp.stack([parent_g, parent_h, parent_c]).astype(hist.dtype)
+    lo = -jnp.inf if mono_lo is None else mono_lo
+    hi = jnp.inf if mono_hi is None else mono_hi
+
+    gain_shift = leaf_gain(parent_g, parent_h + 2 * K_EPSILON,
+                           p.lambda_l1, p.lambda_l2, p.max_delta_step)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+
+    num_gain, num_left = _numerical_candidates(hist, parent, fmeta, p, lo, hi)
+    oh_gain, oh_left = _categorical_onehot_candidates(hist, parent, fmeta, p, lo, hi)
+    so_gain, so_left, so_order = _categorical_sorted_candidates(
+        hist, parent, fmeta, p, lo, hi)
+
+    # categorical one-hot only for small-arity features (max_cat_to_onehot)
+    use_onehot = (fmeta.num_bin[:, None] <= int(p.max_cat_to_onehot))
+    oh_gain = jnp.where(use_onehot, oh_gain, NEG_INF)
+    so_gain = jnp.where(use_onehot[:, :, None], NEG_INF, so_gain)
+
+    # per-feature winners of each family
+    def fam_best(gain_flat):
+        idx = jnp.argmax(gain_flat, axis=1)
+        return idx, jnp.take_along_axis(gain_flat, idx[:, None], axis=1)[:, 0]
+
+    ni, ng = fam_best(num_gain.reshape(F, -1))
+    oi, og = fam_best(oh_gain)
+    si, sg = fam_best(so_gain.reshape(F, -1))
+
+    fam_gains = jnp.stack([ng, og, sg], axis=1)                    # [F, 3]
+    fam = jnp.argmax(fam_gains, axis=1)
+    fgain = jnp.max(fam_gains, axis=1)
+
+    # min-gain check, feature mask, penalty (FindBestThreshold:83-90)
+    splittable = fgain > min_gain_shift
+    fgain_out = (fgain - min_gain_shift) * fmeta.penalty
+    fgain_out = jnp.where(splittable & (feature_mask > 0), fgain_out, NEG_INF)
+
+    best_f = jnp.argmax(fgain_out).astype(jnp.int32)
+    best_gain = fgain_out[best_f]
+    has_split = best_gain > NEG_INF
+
+    fam_f = fam[best_f]
+    T = B - 1
+    # decode winner coordinates
+    n_t = (ni[best_f] // 2).astype(jnp.int32)
+    n_dir = (ni[best_f] % 2).astype(jnp.int32)
+    left_num = num_left[best_f, n_t, n_dir]
+    left_oh = oh_left[best_f, oi[best_f]]
+    s_k = (si[best_f] // 2).astype(jnp.int32)
+    s_dir = (si[best_f] % 2).astype(jnp.int32)
+    left_so = so_left[best_f, s_k, s_dir]
+
+    left_stats = jnp.where(fam_f == 0, left_num,
+                           jnp.where(fam_f == 1, left_oh, left_so))
+    is_cat = fam_f > 0
+    threshold = jnp.where(fam_f == 0, n_t,
+                          jnp.where(fam_f == 1, oi[best_f], s_k)).astype(jnp.int32)
+    # default_left: numerical dir 0 = missing left; 2-bin NaN edge forces right
+    dl = (fam_f == 0) & (n_dir == 0)
+    nb_f = fmeta.num_bin[best_f]
+    mt_f = fmeta.missing_type[best_f]
+    dl = jnp.where((fam_f == 0) & (nb_f <= 2) & (mt_f == MISSING_NAN), False, dl)
+
+    # categorical bitset of left-going bins
+    b_idx = jnp.arange(B, dtype=jnp.int32)
+    onehot_mask = b_idx == threshold
+    order_f = so_order[best_f]
+    pos = jnp.arange(B, dtype=jnp.int32)
+    cnt_row = hist[best_f, :, 2]
+    valid_bins = (b_idx < nb_f) & (cnt_row > 0)
+    nvalid = valid_bins.sum().astype(jnp.int32)
+    sel_sorted = jnp.where(s_dir == 0, pos <= s_k, (pos >= s_k) & (pos < nvalid))
+    sorted_mask = jnp.zeros(B, dtype=bool).at[order_f].set(sel_sorted)
+    cat_mask = jnp.where(fam_f == 1, onehot_mask, sorted_mask & valid_bins)
+    cat_bitset = build_cat_bitset(jnp.where(is_cat, cat_mask, False))
+
+    Gl, Hl, Cl = left_stats[0], left_stats[1], left_stats[2]
+    Gr, Hr, Cr = parent[0] - Gl, parent[1] - Hl, parent[2] - Cl
+    extra_l2 = jnp.where(is_cat, p.cat_l2, 0.0)
+    out_l = jnp.clip(-threshold_l1(Gl, p.lambda_l1)
+                     / (Hl + p.lambda_l2 + extra_l2 + K_EPSILON), lo, hi)
+    out_r = jnp.clip(-threshold_l1(Gr, p.lambda_l1)
+                     / (Hr + p.lambda_l2 + extra_l2 + K_EPSILON), lo, hi)
+    if p.max_delta_step > 0.0:
+        out_l = jnp.clip(out_l, -p.max_delta_step, p.max_delta_step)
+        out_r = jnp.clip(out_r, -p.max_delta_step, p.max_delta_step)
+
+    return SplitInfo(
+        gain=jnp.where(has_split, best_gain, NEG_INF),
+        feature=jnp.where(has_split, best_f, -1).astype(jnp.int32),
+        threshold=threshold,
+        default_left=dl,
+        is_cat=is_cat,
+        cat_bitset=cat_bitset,
+        left_g=Gl, left_h=Hl, left_c=Cl,
+        right_g=Gr, right_h=Hr, right_c=Cr,
+        left_out=out_l, right_out=out_r,
+    )

@@ -7,12 +7,19 @@ data-/feature-parallel learners run their real collective paths in-process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before the first backend init.  The axon sitecustomize imports
+# jax at interpreter start with JAX_PLATFORMS=axon already captured, so the
+# env var alone is not enough — override through jax.config instead.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,235 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.core.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+from lightgbm_tpu.ops.histogram import leaf_histogram
+from lightgbm_tpu.ops.split import (FeatureMeta, SplitParams, best_split,
+                                    leaf_gain, leaf_output)
+
+
+def np_hist(bins, g, h, m, B):
+    F = bins.shape[1]
+    out = np.zeros((F, B, 3))
+    for f in range(F):
+        for b in range(B):
+            sel = (bins[:, f] == b)
+            out[f, b] = [(g * m)[sel].sum(), (h * m)[sel].sum(), m[sel].sum()]
+    return out
+
+
+def test_histogram_matches_bruteforce(rng):
+    n, f, B = 1000, 4, 16
+    bins = rng.randint(0, B, size=(n, f)).astype(np.uint8)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.uniform(0.5, 1.5, size=n).astype(np.float32)
+    m = (rng.uniform(size=n) < 0.7).astype(np.float32)
+    got = np.asarray(leaf_histogram(jnp.asarray(bins), jnp.asarray(g),
+                                    jnp.asarray(h), jnp.asarray(m), B))
+    want = np_hist(bins, g, h, m, B)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram_chunking_consistent(rng):
+    n, f, B = 5000, 3, 8
+    bins = rng.randint(0, B, size=(n, f)).astype(np.uint8)
+    g = rng.normal(size=n).astype(np.float32)
+    h = np.ones(n, dtype=np.float32)
+    m = np.ones(n, dtype=np.float32)
+    a = np.asarray(leaf_histogram(jnp.asarray(bins), jnp.asarray(g),
+                                  jnp.asarray(h), jnp.asarray(m), B,
+                                  row_chunk=512))
+    b = np.asarray(leaf_histogram(jnp.asarray(bins), jnp.asarray(g),
+                                  jnp.asarray(h), jnp.asarray(m), B,
+                                  row_chunk=0))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-3)
+
+
+def _meta(F, B, missing=MISSING_NONE, default_bin=0, is_cat=False, mono=0):
+    return FeatureMeta(
+        num_bin=jnp.full(F, B, dtype=jnp.int32),
+        missing_type=jnp.full(F, missing, dtype=jnp.int32),
+        default_bin=jnp.full(F, default_bin, dtype=jnp.int32),
+        is_cat=jnp.full(F, is_cat, dtype=bool),
+        monotone=jnp.full(F, mono, dtype=jnp.int32),
+        penalty=jnp.ones(F, dtype=jnp.float32),
+    )
+
+
+def np_best_split_simple(hist, G, H, C, l1, l2, min_data, min_hess):
+    """Brute-force numerical best split, missing=None, single feature set."""
+    F, B, _ = hist.shape
+    best = (-np.inf, -1, -1)
+
+    def out(G, H):
+        s = np.sign(G) * max(abs(G) - l1, 0)
+        return -s / (H + l2) if H + l2 > 0 else 0.0
+
+    def gain1(G, H):
+        o = out(G, H)
+        s = np.sign(G) * max(abs(G) - l1, 0)
+        return -(2 * s * o + (H + l2) * o * o)
+
+    shift = gain1(G, H)
+    for f in range(F):
+        for t in range(B - 1):
+            lg, lh, lc = hist[f, : t + 1].sum(axis=0)
+            rg, rh, rc = G - lg, H - lh, C - lc
+            if lc < min_data or rc < min_data or lh < min_hess or rh < min_hess:
+                continue
+            gain = gain1(lg, lh) + gain1(rg, rh)
+            if gain <= shift:
+                continue
+            if gain - shift > best[0]:
+                best = (gain - shift, f, t)
+    return best
+
+
+def test_best_split_matches_bruteforce(rng):
+    F, B = 5, 16
+    n = 2000
+    bins = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    # plant signal on feature 2: bins >= 8 have positive gradients
+    g = rng.normal(size=n).astype(np.float32) * 0.1
+    g += np.where(bins[:, 2] >= 8, 1.0, -1.0).astype(np.float32)
+    h = np.ones(n, dtype=np.float32)
+    m = np.ones(n, dtype=np.float32)
+    hist = np.asarray(leaf_histogram(jnp.asarray(bins), jnp.asarray(g),
+                                     jnp.asarray(h), jnp.asarray(m), B))
+    G, H, C = g.sum(), h.sum(), float(n)
+    p = SplitParams(min_data_in_leaf=20, min_sum_hessian_in_leaf=1e-3)
+    info = best_split(jnp.asarray(hist), G, H, C, _meta(F, B), p,
+                      jnp.ones(F))
+    want_gain, want_f, want_t = np_best_split_simple(
+        hist.astype(np.float64), G, H, C, 0.0, 0.0, 20, 1e-3)
+    assert int(info.feature) == want_f == 2
+    assert int(info.threshold) == want_t == 7
+    assert float(info.gain) == pytest.approx(want_gain, rel=1e-3)
+    # split stats consistency
+    assert float(info.left_c) + float(info.right_c) == pytest.approx(n)
+    assert float(info.left_g) + float(info.right_g) == pytest.approx(G, rel=1e-4)
+
+
+def test_best_split_respects_min_data(rng):
+    F, B, n = 1, 4, 100
+    bins = np.zeros((n, F), dtype=np.uint8)
+    bins[:5, 0] = 3  # only 5 rows on the right of any split
+    g = np.where(bins[:, 0] == 3, -1.0, 1.0).astype(np.float32)
+    h = np.ones(n, dtype=np.float32)
+    hist = leaf_histogram(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                          jnp.ones(n), B)
+    p = SplitParams(min_data_in_leaf=10)
+    info = best_split(hist, float(g.sum()), float(n), float(n),
+                      _meta(F, B), p, jnp.ones(F))
+    assert int(info.feature) == -1  # no valid split
+
+    p2 = SplitParams(min_data_in_leaf=2)
+    info2 = best_split(hist, float(g.sum()), float(n), float(n),
+                       _meta(F, B), p2, jnp.ones(F))
+    assert int(info2.feature) == 0
+
+
+def test_best_split_lambda_l2_shrinks_outputs(rng):
+    F, B, n = 1, 8, 500
+    bins = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    g = np.where(bins[:, 0] >= 4, 1.0, -1.0).astype(np.float32)
+    h = np.ones(n, dtype=np.float32)
+    hist = leaf_histogram(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                          jnp.ones(n), B)
+    i0 = best_split(hist, float(g.sum()), float(n), float(n), _meta(F, B),
+                    SplitParams(), jnp.ones(F))
+    i1 = best_split(hist, float(g.sum()), float(n), float(n), _meta(F, B),
+                    SplitParams(lambda_l2=100.0), jnp.ones(F))
+    assert abs(float(i1.left_out)) < abs(float(i0.left_out))
+    assert float(i1.gain) < float(i0.gain)
+
+
+def test_best_split_missing_nan_direction(rng):
+    # NaN rows (last bin) carry strong positive gradient -> NaN should go right
+    F, B, n = 1, 8, 1000
+    bins = rng.randint(0, B - 1, size=(n, F)).astype(np.uint8)
+    bins[:200, 0] = B - 1  # NaN bin
+    g = np.where(bins[:, 0] == B - 1, 2.0,
+                 np.where(bins[:, 0] >= 4, 0.5, -0.5)).astype(np.float32)
+    h = np.ones(n, dtype=np.float32)
+    hist = leaf_histogram(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                          jnp.ones(n), B)
+    meta = _meta(F, B, missing=MISSING_NAN)
+    info = best_split(hist, float(g.sum()), float(n), float(n), meta,
+                      SplitParams(min_data_in_leaf=1), jnp.ones(F))
+    assert int(info.feature) == 0
+    assert not bool(info.default_left)  # NaN goes right with the positives
+
+
+def test_best_split_feature_mask(rng):
+    F, B, n = 3, 8, 500
+    bins = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    g = np.where(bins[:, 0] >= 4, 1.0, -1.0).astype(np.float32)
+    h = np.ones(n, dtype=np.float32)
+    hist = leaf_histogram(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                          jnp.ones(n), B)
+    mask = jnp.asarray([0.0, 1.0, 1.0])  # best feature masked out
+    info = best_split(hist, float(g.sum()), float(n), float(n), _meta(F, B),
+                      SplitParams(), mask)
+    assert int(info.feature) != 0
+
+
+def test_best_split_categorical_onehot(rng):
+    F, B, n = 1, 4, 800
+    bins = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    g = np.where(bins[:, 0] == 2, 3.0, rng.normal(size=n) * 0.1).astype(np.float32)
+    h = np.ones(n, dtype=np.float32)
+    hist = leaf_histogram(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                          jnp.ones(n), B)
+    meta = _meta(F, B, is_cat=True)
+    info = best_split(hist, float(g.sum()), float(n), float(n), meta,
+                      SplitParams(max_cat_to_onehot=4), jnp.ones(F))
+    assert bool(info.is_cat)
+    assert int(info.threshold) == 2
+    # bitset has exactly bin 2 set
+    bitset = np.asarray(info.cat_bitset)
+    assert bitset[0] == (1 << 2)
+
+
+def test_best_split_categorical_sorted(rng):
+    F, B, n = 1, 12, 3000
+    bins = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    hot = np.isin(bins[:, 0], [1, 5, 7])
+    g = np.where(hot, 2.0, -0.5).astype(np.float32) + \
+        rng.normal(size=n).astype(np.float32) * 0.05
+    h = np.ones(n, dtype=np.float32)
+    hist = leaf_histogram(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                          jnp.ones(n), B)
+    meta = _meta(F, B, is_cat=True)
+    info = best_split(hist, float(g.sum()), float(n), float(n), meta,
+                      SplitParams(max_cat_to_onehot=4, min_data_in_leaf=5),
+                      jnp.ones(F))
+    assert bool(info.is_cat)
+    bitset = int(np.asarray(info.cat_bitset)[0])
+    left_set = {b for b in range(B) if bitset & (1 << b)}
+    # the split should separate {1,5,7} from the rest (either side)
+    assert left_set == {1, 5, 7} or left_set == set(range(B)) - {1, 5, 7}
+
+
+def test_monotone_constraint_blocks_increasing(rng):
+    F, B, n = 1, 8, 1000
+    bins = rng.randint(0, B, size=(n, F)).astype(np.uint8)
+    # signal: higher bins -> higher target (increasing relationship)
+    g = -(bins[:, 0].astype(np.float32) - B / 2)  # negative grad for high bins
+    h = np.ones(n, dtype=np.float32)
+    hist = leaf_histogram(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                          jnp.ones(n), B)
+    up = best_split(hist, float(g.sum()), float(n), float(n),
+                    _meta(F, B, mono=1), SplitParams(), jnp.ones(F))
+    down = best_split(hist, float(g.sum()), float(n), float(n),
+                      _meta(F, B, mono=-1), SplitParams(), jnp.ones(F))
+    assert int(up.feature) == 0       # increasing split allowed
+    assert int(down.feature) == -1    # decreasing constraint blocks it
+
+
+def test_leaf_output_gain_formulas():
+    # closed form: G=-10, H=20, l2=1 -> out = 10/21, gain = G^2/(H+l2)
+    out = float(leaf_output(-10.0, 20.0, 0.0, 1.0, 0.0))
+    assert out == pytest.approx(10.0 / 21.0, rel=1e-5)
+    g = float(leaf_gain(-10.0, 20.0, 0.0, 1.0, 0.0))
+    assert g == pytest.approx(100.0 / 21.0, rel=1e-5)
