@@ -4,14 +4,36 @@ Feature-complete re-design of LightGBM (reference: Luo-Liang/LightGBM v2.2.4)
 for TPU: histogram GBDT/DART/GOSS/RF training where the compute core is
 JAX/XLA/Pallas (bin matrix in HBM, fused histogram+split+partition tree
 growth under jit, distributed learners as XLA collectives over a device mesh)
-instead of C++/OpenMP/OpenCL/sockets.
+instead of C++/OpenMP/OpenCL/sockets.  The Python surface mirrors the
+reference python-package so existing LightGBM user code ports unchanged.
 """
 
+from . import callback
+from .basic import Booster, Dataset
 from .config import Config
 from .core.dataset import TpuDataset
+from .engine import CVBooster, cv, train
 from .utils.log import LightGBMError, register_log_callback, set_verbosity
 
 __version__ = "0.1.0"
 
-__all__ = ["Config", "TpuDataset", "LightGBMError", "register_log_callback",
+__all__ = ["Booster", "Dataset", "Config", "TpuDataset", "CVBooster", "cv",
+           "train", "callback", "LightGBMError", "register_log_callback",
            "set_verbosity", "__version__"]
+
+
+def __getattr__(name):
+    # lazy sklearn/plotting imports (mirrors lightgbm.sklearn availability)
+    try:
+        if name in ("LGBMModel", "LGBMClassifier", "LGBMRegressor",
+                    "LGBMRanker"):
+            from . import sklearn as _sk
+            return getattr(_sk, name)
+        if name in ("plot_importance", "plot_metric", "plot_tree",
+                    "create_tree_digraph"):
+            from . import plotting as _pl
+            return getattr(_pl, name)
+    except ImportError as e:
+        raise AttributeError(
+            f"'{name}' is unavailable: {e}") from e
+    raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name}")

@@ -1,0 +1,393 @@
+"""Public Dataset / Booster API.
+
+Mirrors the reference python-package surface (python-package/lightgbm/basic.py:
+``Dataset`` :664 with lazy construction, ``Booster`` :1612 with
+update/eval/predict/save) so user code written against LightGBM's Python API
+ports over unchanged.  Instead of crossing a ctypes boundary into
+lib_lightgbm.so, these classes drive the in-process TPU training stack
+directly (core.dataset.TpuDataset + models.GBDT).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .core.dataset import TpuDataset
+from .metric import default_metric_for_objective, metric_canonical_name
+from .models.gbdt import GBDT
+from .utils.log import LightGBMError, check, log_info, log_warning
+
+
+def _as_2d_float(data, num_features: Optional[int] = None) -> np.ndarray:
+    if hasattr(data, "values"):       # pandas
+        data = data.values
+    if hasattr(data, "toarray"):      # scipy sparse
+        data = data.toarray()
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        # a 1-D vector is a single ROW when its length matches the model's
+        # feature count (single-row predict), else a single column
+        if num_features is not None and len(arr) == num_features:
+            arr = arr[None, :]
+        else:
+            arr = arr[:, None]
+    return arr
+
+
+class Dataset:
+    """Lazily-constructed training dataset (reference basic.py:664)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List[int], List[str]] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._handle: Optional[TpuDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self._predictor = None
+
+    # --------------------------------------------------------- construction
+    def construct(self) -> "Dataset":
+        if self._handle is not None:
+            return self
+        if isinstance(self.data, str):
+            from .core.parser import load_file_to_dataset
+            cfg = Config.from_params(self.params)
+            self._handle = load_file_to_dataset(
+                self.data, cfg,
+                reference=(self.reference.construct()._handle
+                           if self.reference is not None else None))
+            return self
+        cfg = Config.from_params(self.params)
+        data = _as_2d_float(self.data)
+        feature_names = None
+        if isinstance(self.feature_name, (list, tuple)):
+            feature_names = list(self.feature_name)
+        elif hasattr(self.data, "columns"):
+            feature_names = [str(c) for c in self.data.columns]
+        cat_idx: List[int] = []
+        if isinstance(self.categorical_feature, (list, tuple)):
+            for c in self.categorical_feature:
+                if isinstance(c, str):
+                    if feature_names and c in feature_names:
+                        cat_idx.append(feature_names.index(c))
+                else:
+                    cat_idx.append(int(c))
+        elif (self.categorical_feature == "auto"
+              and hasattr(self.data, "dtypes")):
+            for i, dt in enumerate(self.data.dtypes):
+                if str(dt) == "category":
+                    cat_idx.append(i)
+        ref_handle = None
+        if self.reference is not None:
+            ref_handle = self.reference.construct()._handle
+        label = np.asarray(self.label, dtype=np.float64).ravel() \
+            if self.label is not None else None
+        self._handle = TpuDataset.from_numpy(
+            data, label=label, config=cfg,
+            weights=(np.asarray(self.weight, dtype=np.float64).ravel()
+                     if self.weight is not None else None),
+            group=(np.asarray(self.group) if self.group is not None else None),
+            init_score=(np.asarray(self.init_score, dtype=np.float64)
+                        if self.init_score is not None else None),
+            categorical_features=cat_idx,
+            feature_names=feature_names,
+            reference=ref_handle)
+        if self.used_indices is not None:
+            self._subset_in_place(self.used_indices)
+        return self
+
+    def _subset_in_place(self, indices: np.ndarray) -> None:
+        h = self._handle
+        sub = TpuDataset()
+        sub.num_data = len(indices)
+        sub.num_total_features = h.num_total_features
+        sub.bin_mappers = h.bin_mappers
+        sub.used_feature_indices = h.used_feature_indices
+        sub.max_num_bin = h.max_num_bin
+        sub.feature_names = h.feature_names
+        sub.monotone_constraints = h.monotone_constraints
+        sub.feature_penalty = h.feature_penalty
+        sub.binned = h.binned[indices]
+        sub.metadata = h.metadata.subset(indices)
+        sub.metadata.num_data = len(indices)
+        self._handle = sub
+
+    def subset(self, used_indices: Sequence[int],
+               params: Optional[Dict] = None) -> "Dataset":
+        """Row-subset view sharing bin mappers (Dataset::CopySubset,
+        dataset.cpp:503)."""
+        ds = Dataset(self.data, label=self.label, reference=self,
+                     weight=self.weight, group=self.group,
+                     feature_name=self.feature_name,
+                     categorical_feature=self.categorical_feature,
+                     params=params or self.params)
+        ds.used_indices = np.asarray(sorted(used_indices), dtype=np.int64)
+        ds.reference = self
+        return ds
+
+    # ------------------------------------------------------------- fields
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._handle is not None and label is not None:
+            self._handle.metadata.set_label(
+                np.asarray(label, dtype=np.float64).ravel())
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._handle is not None:
+            self._handle.metadata.set_weights(
+                np.asarray(weight, dtype=np.float64).ravel()
+                if weight is not None else None)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._handle is not None and group is not None:
+            self._handle.metadata.set_query(np.asarray(group))
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._handle is not None:
+            self._handle.metadata.set_init_score(
+                np.asarray(init_score, dtype=np.float64)
+                if init_score is not None else None)
+        return self
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        if field_name == "label":
+            return self.set_label(data)
+        if field_name == "weight":
+            return self.set_weight(data)
+        if field_name == "group":
+            return self.set_group(data)
+        if field_name == "init_score":
+            return self.set_init_score(data)
+        raise LightGBMError(f"Unknown field name {field_name}")
+
+    def get_field(self, field_name: str):
+        self.construct()
+        md = self._handle.metadata
+        if field_name == "label":
+            return md.label
+        if field_name == "weight":
+            return md.weights
+        if field_name == "group":
+            return (np.diff(md.query_boundaries)
+                    if md.query_boundaries is not None else None)
+        if field_name == "init_score":
+            return md.init_score
+        raise LightGBMError(f"Unknown field name {field_name}")
+
+    def get_label(self):
+        return self.get_field("label")
+
+    def get_weight(self):
+        return self.get_field("weight")
+
+    def get_group(self):
+        return self.get_field("group")
+
+    def get_init_score(self):
+        return self.get_field("init_score")
+
+    def num_data(self) -> int:
+        self.construct()
+        return self._handle.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._handle.num_total_features
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.construct()
+        self._handle.save_binary(filename)
+        return self
+
+    def create_valid(self, data, label=None, **kwargs) -> "Dataset":
+        return Dataset(data, label=label, reference=self, **kwargs)
+
+
+class Booster:
+    """Training-capable model handle (reference basic.py:1612)."""
+
+    def __init__(self, params: Optional[Dict] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        params = dict(params or {})
+        self.params = params
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._valid_names: List[str] = []
+        if train_set is not None:
+            check(isinstance(train_set, Dataset),
+                  "Training data should be a Dataset instance")
+            # merge dataset-level params under booster params
+            merged = dict(train_set.params or {})
+            merged.update(params)
+            self.config = Config.from_params(merged)
+            train_set.params = merged
+            train_set.construct()
+            from .objective import create_objective
+            from .models.boosting_factory import create_boosting
+            self.objective = create_objective(self.config)
+            if self.objective is not None:
+                self.objective.init(train_set._handle.metadata,
+                                    train_set._handle.num_data)
+            self.gbdt = create_boosting(self.config, train_set._handle,
+                                        self.objective)
+            self.train_set = train_set
+            self._setup_metrics()
+        elif model_file is not None or model_str is not None:
+            from .models.serialization import load_model
+            if model_file is not None:
+                with open(model_file) as fh:
+                    model_str = fh.read()
+            self.gbdt, self.config, self.objective = load_model(model_str)
+            self.train_set = None
+        else:
+            raise LightGBMError(
+                "Booster needs train_set, model_file or model_str")
+
+    # ----------------------------------------------------------- internals
+    def _setup_metrics(self):
+        names = list(self.config.metric)
+        if not names:
+            d = default_metric_for_objective(self.config.objective)
+            if d:
+                names = [d]
+        seen = []
+        for n in names:
+            c = metric_canonical_name(n) or n
+            if c not in seen:
+                seen.append(c)
+        self._metric_names = seen
+        self.gbdt.setup_metrics(seen)
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct()
+        self.gbdt.add_valid_data(name, data._handle)
+        self._valid_names.append(name)
+        self._setup_metrics()
+        return self
+
+    # ------------------------------------------------------------ training
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; returns True when no further splits are
+        possible (LGBM_BoosterUpdateOneIter, c_api.cpp:1143)."""
+        if train_set is not None:
+            raise LightGBMError("reset training data not yet supported")
+        if fobj is not None:
+            score = self.gbdt.train_score
+            grad, hess = fobj(np.asarray(score).ravel(), self.train_set)
+            return self.gbdt.train_one_iter(np.asarray(grad),
+                                            np.asarray(hess))
+        return self.gbdt.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self.gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self):
+        return self.gbdt.current_iteration
+
+    def num_trees(self) -> int:
+        return len(self.gbdt.models)
+
+    def num_model_per_iteration(self) -> int:
+        return self.gbdt.num_tree_per_iteration
+
+    # ---------------------------------------------------------------- eval
+    def eval_train(self, feval=None) -> List:
+        out = [("training", name, val, hb)
+               for name, val, hb in self.gbdt.eval_train()]
+        if feval is not None:
+            score = np.asarray(self.gbdt.train_score).ravel()
+            name, val, hb = feval(score, self.train_set)
+            out.append(("training", name, val, hb))
+        return out
+
+    def eval_valid(self, feval=None) -> List:
+        out = []
+        for i, name in enumerate(self._valid_names):
+            out.extend([(name, mname, val, hb)
+                        for mname, val, hb in self.gbdt.eval_valid(i)])
+        return out
+
+    # ------------------------------------------------------------- predict
+    def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs) -> np.ndarray:
+        if num_iteration is None or num_iteration < 0:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else -1)
+        n_feat = self.gbdt.max_feature_idx + 1
+        X = _as_2d_float(data, n_feat)
+        if X.shape[1] != n_feat:
+            raise LightGBMError(
+                f"The number of features in data ({X.shape[1]}) is not the "
+                f"same as it was in training data ({n_feat})")
+        if pred_contrib:
+            from .models.shap import predict_contrib
+            return predict_contrib(self.gbdt, X, num_iteration)
+        return self.gbdt.predict(X, num_iteration=num_iteration,
+                                 raw_score=raw_score, pred_leaf=pred_leaf)
+
+    # ---------------------------------------------------------------- model
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        from .models.serialization import save_model_to_string
+        with open(filename, "w") as fh:
+            fh.write(save_model_to_string(self.gbdt, self.config,
+                                          num_iteration or -1,
+                                          start_iteration))
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        from .models.serialization import save_model_to_string
+        return save_model_to_string(self.gbdt, self.config,
+                                    num_iteration or -1, start_iteration)
+
+    def dump_model(self, num_iteration: Optional[int] = None) -> Dict:
+        from .models.serialization import dump_model_dict
+        return dump_model_dict(self.gbdt, self.config, num_iteration or -1)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        return self.gbdt.feature_importance(importance_type, iteration)
+
+    def feature_name(self) -> List[str]:
+        return list(self.gbdt.feature_names)
+
+    def set_network(self, machines, local_listen_port=12400,
+                    listen_time_out=120, num_machines=1) -> "Booster":
+        """Distributed setup: on TPU the mesh replaces the socket ring; this
+        keeps the API seam (basic.py:1771 / LGBM_NetworkInit)."""
+        from .parallel import network
+        network.init_from_machines(machines, num_machines)
+        return self
+
+    def free_network(self) -> "Booster":
+        from .parallel import network
+        network.dispose()
+        return self

@@ -1,0 +1,182 @@
+"""Text data parsers: CSV / TSV / LibSVM with format auto-detection.
+
+Reference: src/io/parser.{cpp,hpp} (Parser::CreateParser :92 auto-detects by
+counting separators on sample lines; CSVParser/TSVParser/LibSVMParser) and
+the DatasetLoader text pipeline (src/io/dataset_loader.cpp:162-260: label
+column extraction, weight/group/ignore columns, header handling).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import LightGBMError, check, log_info, log_warning
+
+
+def _detect_format(sample_lines: List[str]) -> str:
+    """Count separators like Parser::CreateParser (parser.cpp:30-90)."""
+    def stats(line: str) -> Tuple[int, int, int]:
+        return line.count(","), line.count("\t"), line.count(":")
+
+    cnt = [stats(l) for l in sample_lines if l.strip()]
+    if not cnt:
+        raise LightGBMError("Empty data file")
+    tabs = min(c[1] for c in cnt)
+    commas = min(c[0] for c in cnt)
+    colons = min(c[2] for c in cnt)
+    if tabs > 0:
+        return "tsv"
+    if commas > 0:
+        return "csv"
+    if colons > 0:
+        return "libsvm"
+    return "csv"  # single-column fallback
+
+
+def _parse_dense(lines: List[str], sep: str) -> np.ndarray:
+    rows = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        rows.append([float(tok) if tok not in ("", "na", "nan", "NaN", "NULL")
+                     else np.nan for tok in line.split(sep)])
+    width = max(len(r) for r in rows)
+    out = np.full((len(rows), width), np.nan)
+    for i, r in enumerate(rows):
+        out[i, : len(r)] = r
+    return out
+
+
+def _parse_libsvm(lines: List[str]) -> np.ndarray:
+    """label idx:val idx:val ... (1-based or 0-based indices accepted)."""
+    parsed = []
+    max_idx = -1
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        toks = line.split()
+        label = float(toks[0])
+        feats = {}
+        for tok in toks[1:]:
+            if ":" not in tok:
+                continue
+            k, v = tok.split(":", 1)
+            idx = int(k)
+            feats[idx] = float(v)
+            max_idx = max(max_idx, idx)
+        parsed.append((label, feats))
+    out = np.zeros((len(parsed), max_idx + 2))
+    for i, (label, feats) in enumerate(parsed):
+        out[i, 0] = label
+        for k, v in feats.items():
+            out[i, k + 1] = v
+    return out
+
+
+def _column_index(spec: str, header_names: Optional[List[str]]) -> int:
+    """Resolve 'name:<col>' / numeric column spec (config.h label_column)."""
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if header_names is None or name not in header_names:
+            raise LightGBMError(f"Column name {name} not found in header")
+        return header_names.index(name)
+    return int(spec)
+
+
+def load_file_to_dataset(filename: str, config: Config, reference=None):
+    """Text file -> TpuDataset (DatasetLoader::LoadFromFile,
+    dataset_loader.cpp:162)."""
+    from .dataset import TpuDataset
+
+    if not os.path.exists(filename):
+        raise LightGBMError(f"Data file {filename} doesn't exist")
+    if filename.endswith(".bin") or _is_binary(filename):
+        return TpuDataset.load_binary(filename)
+
+    with open(filename) as fh:
+        lines = fh.readlines()
+    header_names: Optional[List[str]] = None
+    if config.header and lines:
+        first = lines[0].strip()
+        sep = "\t" if "\t" in first else ","
+        header_names = first.split(sep)
+        lines = lines[1:]
+
+    fmt = _detect_format(lines[:32])
+    log_info(f"Loading {filename} as {fmt}")
+    if fmt == "libsvm":
+        mat = _parse_libsvm(lines)
+        label_col = 0
+    else:
+        sep = "\t" if fmt == "tsv" else ","
+        mat = _parse_dense(lines, sep)
+        label_col = (_column_index(config.label_column, header_names)
+                     if config.label_column else 0)
+
+    ncol = mat.shape[1]
+    weight_col = (_column_index(config.weight_column, header_names)
+                  if config.weight_column else -1)
+    group_col = (_column_index(config.group_column, header_names)
+                 if config.group_column else -1)
+    ignore_cols = set()
+    if config.ignore_column:
+        for tok in str(config.ignore_column).split(","):
+            tok = tok.strip()
+            if tok:
+                ignore_cols.add(_column_index(tok, header_names))
+
+    label = mat[:, label_col]
+    weights = mat[:, weight_col] if weight_col >= 0 else None
+    qids = mat[:, group_col] if group_col >= 0 else None
+    drop = {label_col} | ignore_cols
+    if weight_col >= 0:
+        drop.add(weight_col)
+    if group_col >= 0:
+        drop.add(group_col)
+    feat_cols = [c for c in range(ncol) if c not in drop]
+    X = mat[:, feat_cols]
+    feat_names = ([header_names[c] for c in feat_cols] if header_names
+                  else None)
+
+    cat_idx: List[int] = []
+    if config.categorical_feature:
+        for tok in str(config.categorical_feature).split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            orig = _column_index(tok, header_names)
+            # map original column index to feature index after drops
+            if orig in feat_cols:
+                cat_idx.append(feat_cols.index(orig))
+
+    ds = TpuDataset.from_numpy(
+        X, label=label, config=config, weights=weights,
+        categorical_features=cat_idx, feature_names=feat_names,
+        reference=reference)
+    if qids is not None:
+        ds.metadata.set_query_from_ids(qids)
+    # group file sidecar: <data>.query (dataset_loader.cpp query file load)
+    qfile = filename + ".query"
+    if qids is None and os.path.exists(qfile):
+        groups = np.loadtxt(qfile, dtype=np.int64, ndmin=1)
+        ds.metadata.set_query(groups)
+    wfile = filename + ".weight"
+    if weights is None and os.path.exists(wfile):
+        ds.metadata.set_weights(np.loadtxt(wfile, ndmin=1))
+    ifile = filename + ".init"
+    if os.path.exists(ifile):
+        ds.metadata.set_init_score(np.loadtxt(ifile, ndmin=1).ravel())
+    return ds
+
+
+def _is_binary(filename: str) -> bool:
+    from .dataset import _BINARY_MAGIC
+    with open(filename, "rb") as fh:
+        head = fh.read(len(_BINARY_MAGIC))
+    return head == _BINARY_MAGIC

@@ -1,0 +1,404 @@
+"""Metric battery + factory.
+
+Reference: src/metric/metric.cpp:16-60 (factory) and the per-family headers:
+regression_metric.hpp (l2/rmse/l1/quantile/huber/fair/poisson/mape/gamma/
+gamma_deviance/tweedie), binary_metric.hpp (binary_logloss:115,
+binary_error:139, AUC:159), multiclass_metric.hpp (multi_logloss,
+multi_error with top-k), rank_metric.hpp (NDCG@k) + map_metric.hpp (MAP@k),
+xentropy_metric.hpp (cross_entropy, cross_entropy_lambda, kullback_leibler).
+
+All metrics are host-side numpy over the raw score matrix; ``eval`` applies
+the objective's link where the reference does (Metric::Eval's ConvertOutput
+hook, metric.h:44).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils.dcg import DCGCalculator
+from ..utils.log import log_fatal, log_warning
+
+
+class Metric:
+    name: str = ""
+    higher_better = False
+
+    def __init__(self, config):
+        self.config = config
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label, dtype=np.float64)
+        self.weights = (np.asarray(metadata.weights, dtype=np.float64)
+                        if metadata.weights is not None else None)
+        self.sum_weights = (float(self.weights.sum())
+                            if self.weights is not None else float(num_data))
+        self.metadata = metadata
+
+    def eval(self, score: np.ndarray, objective=None) -> float:
+        raise NotImplementedError
+
+    def _avg(self, losses: np.ndarray) -> float:
+        if self.weights is None:
+            return float(np.mean(losses))
+        return float(np.sum(losses * self.weights) / self.sum_weights)
+
+
+def _convert(score, objective):
+    if objective is not None:
+        return objective.convert_output(score)
+    return score
+
+
+# ------------------------------------------------------------------ regression
+class L2Metric(Metric):
+    name = "l2"
+
+    def eval(self, score, objective=None):
+        p = _convert(score, objective)
+        return self._avg((self.label - p) ** 2)
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def eval(self, score, objective=None):
+        return float(np.sqrt(super().eval(score, objective)))
+
+
+class L1Metric(Metric):
+    name = "l1"
+
+    def eval(self, score, objective=None):
+        p = _convert(score, objective)
+        return self._avg(np.abs(self.label - p))
+
+
+class QuantileMetric(Metric):
+    name = "quantile"
+
+    def eval(self, score, objective=None):
+        a = float(self.config.alpha)
+        p = _convert(score, objective)
+        d = self.label - p
+        return self._avg(np.where(d >= 0, a * d, (a - 1) * d))
+
+
+class HuberMetric(Metric):
+    name = "huber"
+
+    def eval(self, score, objective=None):
+        a = float(self.config.alpha)
+        p = _convert(score, objective)
+        d = np.abs(self.label - p)
+        loss = np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
+        return self._avg(loss)
+
+
+class FairMetric(Metric):
+    name = "fair"
+
+    def eval(self, score, objective=None):
+        c = float(self.config.fair_c)
+        p = _convert(score, objective)
+        x = np.abs(self.label - p)
+        return self._avg(c * c * (x / c - np.log1p(x / c)))
+
+
+class PoissonMetric(Metric):
+    name = "poisson"
+
+    def eval(self, score, objective=None):
+        p = np.maximum(_convert(score, objective), 1e-15)
+        return self._avg(p - self.label * np.log(p))
+
+
+class MAPEMetric(Metric):
+    name = "mape"
+
+    def eval(self, score, objective=None):
+        p = _convert(score, objective)
+        return self._avg(np.abs((self.label - p))
+                         / np.maximum(1.0, np.abs(self.label)))
+
+
+class GammaMetric(Metric):
+    name = "gamma"
+
+    def eval(self, score, objective=None):
+        """Negative log-likelihood of Gamma with shape=1
+        (regression_metric.hpp GammaMetric)."""
+        p = np.maximum(_convert(score, objective), 1e-15)
+        x = self.label / p
+        return self._avg(x + np.log(p) - np.log(np.maximum(self.label, 1e-15)))
+
+
+class GammaDevianceMetric(Metric):
+    name = "gamma_deviance"
+
+    def eval(self, score, objective=None):
+        p = np.maximum(_convert(score, objective), 1e-15)
+        x = self.label / p
+        return self._avg(2.0 * (np.log(np.maximum(1.0 / np.maximum(x, 1e-15),
+                                                  1e-15)) + x - 1.0))
+
+
+class TweedieMetric(Metric):
+    name = "tweedie"
+
+    def eval(self, score, objective=None):
+        rho = float(self.config.tweedie_variance_power)
+        p = np.maximum(_convert(score, objective), 1e-15)
+        a = self.label * np.power(p, 1.0 - rho) / (1.0 - rho)
+        b = np.power(p, 2.0 - rho) / (2.0 - rho)
+        return self._avg(-a + b)
+
+
+# -------------------------------------------------------------------- binary
+class BinaryLoglossMetric(Metric):
+    name = "binary_logloss"
+
+    def eval(self, score, objective=None):
+        p = np.clip(_convert(score, objective), 1e-15, 1 - 1e-15)
+        loss = -(self.label * np.log(p) + (1 - self.label) * np.log(1 - p))
+        return self._avg(loss)
+
+
+class BinaryErrorMetric(Metric):
+    name = "binary_error"
+
+    def eval(self, score, objective=None):
+        p = _convert(score, objective)
+        pred = (p > 0.5).astype(np.float64)
+        return self._avg((pred != self.label).astype(np.float64))
+
+
+class AUCMetric(Metric):
+    name = "auc"
+    higher_better = True
+
+    def eval(self, score, objective=None):
+        """Weighted rank-sum AUC (binary_metric.hpp:159-240)."""
+        order = np.argsort(score, kind="stable")
+        y = self.label[order]
+        w = (self.weights[order] if self.weights is not None
+             else np.ones_like(y))
+        # average rank for tied scores
+        s = score[order]
+        pos_w = np.sum(w * (y > 0))
+        neg_w = np.sum(w * (y <= 0))
+        if pos_w <= 0 or neg_w <= 0:
+            log_warning("AUC is undefined with a single class")
+            return 1.0
+        cum_neg = np.cumsum(w * (y <= 0))
+        # handle ties: group by unique score, use half credit within a group
+        _, first_idx, inv = np.unique(s, return_index=True, return_inverse=True)
+        grp_neg = np.add.reduceat(w * (y <= 0), first_idx)
+        cum_before = np.concatenate([[0], np.cumsum(grp_neg)[:-1]])
+        auc_sum = np.sum((cum_before[inv] + 0.5 * grp_neg[inv])
+                         * w * (y > 0))
+        return float(auc_sum / (pos_w * neg_w))
+
+
+# ----------------------------------------------------------------- multiclass
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, score, objective=None):
+        """score [C, N]; softmax via objective convert."""
+        p = _convert(score, objective)
+        p = np.clip(p, 1e-15, 1 - 1e-15)
+        lab = self.label.astype(np.int64)
+        ll = -np.log(p[lab, np.arange(self.num_data)])
+        return self._avg(ll)
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, score, objective=None):
+        lab = self.label.astype(np.int64)
+        k = max(1, int(self.config.multi_error_top_k))
+        if k == 1:
+            pred = np.argmax(score, axis=0)
+            err = (pred != lab).astype(np.float64)
+        else:
+            # top-k correctness (multiclass_metric.hpp MultiErrorMetric)
+            target = score[lab, np.arange(self.num_data)]
+            rank = np.sum(score > target[None, :], axis=0)
+            err = (rank >= k).astype(np.float64)
+        return self._avg(err)
+
+
+# ----------------------------------------------------------------- xentropy
+class CrossEntropyMetric(Metric):
+    name = "cross_entropy"
+
+    def eval(self, score, objective=None):
+        p = np.clip(_convert(score, objective), 1e-15, 1 - 1e-15)
+        loss = -(self.label * np.log(p) + (1 - self.label) * np.log(1 - p))
+        return self._avg(loss)
+
+
+class CrossEntropyLambdaMetric(Metric):
+    name = "cross_entropy_lambda"
+
+    def eval(self, score, objective=None):
+        # score -> lambda parameter; prob = 1 - exp(-w*log1p(exp(score)))
+        hhat = np.log1p(np.exp(np.asarray(score, dtype=np.float64)))
+        w = self.weights if self.weights is not None else 1.0
+        z = 1.0 - np.exp(-w * hhat)
+        z = np.clip(z, 1e-15, 1 - 1e-15)
+        loss = -(self.label * np.log(z) + (1 - self.label) * np.log(1 - z))
+        return float(np.mean(loss))
+
+
+class KLDivMetric(Metric):
+    name = "kullback_leibler"
+
+    def eval(self, score, objective=None):
+        p = np.clip(_convert(score, objective), 1e-15, 1 - 1e-15)
+        y = np.clip(self.label, 1e-15, 1 - 1e-15)
+        kl = (y * np.log(y / p) + (1 - y) * np.log((1 - y) / (1 - p)))
+        return self._avg(kl)
+
+
+# ----------------------------------------------------------------------- rank
+class NDCGMetric(Metric):
+    name = "ndcg"
+    higher_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log_fatal("The NDCG metric requires query information")
+        self.boundaries = np.asarray(metadata.query_boundaries)
+        self.calc = DCGCalculator(self.config.label_gain)
+        self.eval_at = [int(k) for k in (self.config.eval_at or [1, 2, 3, 4, 5])]
+        self.query_weights = metadata.query_weights
+
+    def eval_multi(self, score, objective=None) -> List[float]:
+        nq = len(self.boundaries) - 1
+        out = np.zeros(len(self.eval_at))
+        sumw = 0.0
+        for q in range(nq):
+            s, e = self.boundaries[q], self.boundaries[q + 1]
+            lab = self.label[s:e]
+            sc = score[s:e]
+            qw = (self.query_weights[q] if self.query_weights is not None
+                  else 1.0)
+            sumw += qw
+            for i, k in enumerate(self.eval_at):
+                maxdcg = self.calc.cal_maxdcg_at_k(k, lab)
+                if maxdcg <= 0:
+                    out[i] += qw  # no relevant docs counts as perfect
+                else:
+                    out[i] += qw * self.calc.cal_dcg_at_k(k, lab, sc) / maxdcg
+        return list(out / max(sumw, 1e-20))
+
+    def eval(self, score, objective=None):
+        return self.eval_multi(score, objective)[0]
+
+
+class MAPMetric(Metric):
+    name = "map"
+    higher_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            log_fatal("The MAP metric requires query information")
+        self.boundaries = np.asarray(metadata.query_boundaries)
+        self.eval_at = [int(k) for k in (self.config.eval_at or [1, 2, 3, 4, 5])]
+        self.query_weights = metadata.query_weights
+
+    def eval_multi(self, score, objective=None) -> List[float]:
+        nq = len(self.boundaries) - 1
+        out = np.zeros(len(self.eval_at))
+        sumw = 0.0
+        for q in range(nq):
+            s, e = self.boundaries[q], self.boundaries[q + 1]
+            lab = (self.label[s:e] > 0).astype(np.float64)
+            order = np.argsort(-score[s:e], kind="stable")
+            rel = lab[order]
+            hits = np.cumsum(rel)
+            prec = hits / np.arange(1, len(rel) + 1)
+            qw = (self.query_weights[q] if self.query_weights is not None
+                  else 1.0)
+            sumw += qw
+            for i, k in enumerate(self.eval_at):
+                topk = slice(0, min(k, len(rel)))
+                denom = max(min(k, int(lab.sum())), 1)
+                ap = np.sum(prec[topk] * rel[topk]) / denom
+                out[i] += qw * ap
+        return list(out / max(sumw, 1e-20))
+
+    def eval(self, score, objective=None):
+        return self.eval_multi(score, objective)[0]
+
+
+# -------------------------------------------------------------------- factory
+_ALIASES = {
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression": "l2",
+    "regression_l2": "l2",
+    "l2_root": "rmse", "root_mean_squared_error": "rmse", "rmse": "rmse",
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1",
+    "regression_l1": "l1",
+    "quantile": "quantile", "huber": "huber", "fair": "fair",
+    "poisson": "poisson", "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "gamma_deviance": "gamma_deviance", "tweedie": "tweedie",
+    "ndcg": "ndcg", "lambdarank": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+    "auc": "auc",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multi_error": "multi_error",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kullback_leibler", "kldiv": "kullback_leibler",
+}
+
+_REGISTRY = {
+    "l2": L2Metric, "rmse": RMSEMetric, "l1": L1Metric,
+    "quantile": QuantileMetric, "huber": HuberMetric, "fair": FairMetric,
+    "poisson": PoissonMetric, "mape": MAPEMetric, "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric, "tweedie": TweedieMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric, "multi_logloss": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric, "cross_entropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "kullback_leibler": KLDivMetric, "ndcg": NDCGMetric, "map": MAPMetric,
+}
+
+
+def metric_canonical_name(name: str) -> Optional[str]:
+    return _ALIASES.get(str(name).strip().lower())
+
+
+def create_metric(name: str, config) -> Optional[Metric]:
+    canon = metric_canonical_name(name)
+    if canon is None:
+        if name not in ("", "none", "null", "na", "custom"):
+            log_warning(f"Unknown metric {name}")
+        return None
+    return _REGISTRY[canon](config)
+
+
+def default_metric_for_objective(objective_name: str) -> str:
+    m = {
+        "regression": "l2", "regression_l1": "l1", "huber": "huber",
+        "fair": "fair", "poisson": "poisson", "quantile": "quantile",
+        "mape": "mape", "gamma": "gamma", "tweedie": "tweedie",
+        "binary": "binary_logloss", "multiclass": "multi_logloss",
+        "multiclassova": "multi_logloss", "cross_entropy": "cross_entropy",
+        "cross_entropy_lambda": "cross_entropy_lambda",
+        "lambdarank": "ndcg",
+    }
+    return m.get(objective_name, "")

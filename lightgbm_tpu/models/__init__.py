@@ -1,0 +1,5 @@
+from .gbdt import GBDT
+from .grower import GrowerParams, TreeArrays, make_grow_tree
+from .tree import Tree
+
+__all__ = ["GBDT", "GrowerParams", "TreeArrays", "make_grow_tree", "Tree"]

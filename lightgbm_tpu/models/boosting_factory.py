@@ -1,0 +1,23 @@
+"""Boosting-type factory (reference: Boosting::CreateBoosting,
+src/boosting/boosting.cpp:36-77)."""
+
+from __future__ import annotations
+
+from ..utils.log import log_fatal
+
+
+def create_boosting(config, train_set, objective):
+    btype = str(config.boosting).strip().lower()
+    if btype in ("gbdt", "gbrt"):
+        from .gbdt import GBDT
+        return GBDT(config, train_set, objective)
+    if btype in ("dart",):
+        from .dart import DART
+        return DART(config, train_set, objective)
+    if btype in ("goss",):
+        from .goss import GOSS
+        return GOSS(config, train_set, objective)
+    if btype in ("rf", "random_forest"):
+        from .rf import RF
+        return RF(config, train_set, objective)
+    log_fatal(f"Unknown boosting type {btype}")

@@ -1,0 +1,385 @@
+"""GBDT: the boosting driver.
+
+Reference: src/boosting/gbdt.{h,cpp} — Init (gbdt.cpp:49), TrainOneIter
+(:450: boost-from-average -> GetGradients -> Bagging -> per-class tree train
+-> RenewTreeOutput -> shrinkage -> score update -> constant-tree handling),
+Bagging (:182-334), RollbackOneIter (:553), train/valid metric evaluation
+(:578-660), feature importances.
+
+TPU orchestration: the per-iteration hot path stays on device — gradients
+(objective jnp fn), tree growth (fused grower), and the training-score update
+(``score += leaf_value[leaf_id]`` gather).  Host work per iteration is O(1)
+scalars plus optional leaf renewal / validation-set prediction.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..core.dataset import TpuDataset
+from ..ops.split import FeatureMeta, SplitParams
+from ..utils.log import check, log_fatal, log_info, log_warning
+from .grower import GrowerParams, make_grow_tree
+from .tree import Tree
+
+
+def _round_up_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
+
+
+def build_feature_meta(dataset: TpuDataset) -> FeatureMeta:
+    infos = dataset.feature_infos()
+    return FeatureMeta(
+        num_bin=jnp.asarray([i.num_bin for i in infos], dtype=jnp.int32),
+        missing_type=jnp.asarray([i.missing_type for i in infos],
+                                 dtype=jnp.int32),
+        default_bin=jnp.asarray([i.default_bin for i in infos],
+                                dtype=jnp.int32),
+        is_cat=jnp.asarray([i.is_categorical for i in infos], dtype=bool),
+        monotone=jnp.asarray([i.monotone for i in infos], dtype=jnp.int32),
+        penalty=jnp.asarray([i.penalty for i in infos], dtype=jnp.float32),
+    )
+
+
+@jax.jit
+def _add_tree_score(score, leaf_values, leaf_id):
+    return score + leaf_values[leaf_id]
+
+
+class GBDT:
+    """Gradient Boosted Decision Trees (boosting='gbdt')."""
+
+    def __init__(self, config: Config, train_set: Optional[TpuDataset],
+                 objective=None):
+        self.config = config
+        self.objective = objective
+        self.train_set: Optional[TpuDataset] = None
+        self.models: List[Tree] = []            # flat: iter-major, class-minor
+        self.num_tree_per_iteration = (
+            objective.num_tree_per_iteration if objective is not None
+            else max(1, config.num_class))
+        self.shrinkage_rate = config.learning_rate
+        self.iter_ = 0
+        self.init_scores: List[float] = [0.0] * self.num_tree_per_iteration
+        self.valid_sets: List[Tuple[str, TpuDataset]] = []
+        self.valid_scores: List[np.ndarray] = []
+        self.metrics = []
+        self.valid_metrics: List[list] = []
+        self.best_iter = -1
+        self.feature_names: List[str] = []
+        self._grow_fn = None
+        self.max_feature_idx = 0
+        if train_set is not None:
+            self.reset_train_data(train_set)
+
+    # ----------------------------------------------------------------- setup
+    def reset_train_data(self, train_set: TpuDataset) -> None:
+        check(train_set.num_used_features > 0 or True, "")
+        self.train_set = train_set
+        self.num_data = train_set.num_data
+        self.feature_names = list(train_set.feature_names)
+        self.max_feature_idx = train_set.num_total_features - 1
+        self.fmeta = build_feature_meta(train_set)
+        self.bins = train_set.device_binned()
+        self.num_bins = _round_up_pow2(max(train_set.max_num_bin, 2))
+        cfg = self.config
+        self.grower_params = GrowerParams(
+            num_leaves=max(2, cfg.num_leaves),
+            max_depth=cfg.max_depth,
+            feature_fraction_bynode=cfg.feature_fraction_bynode,
+            row_chunk=cfg.tpu_row_chunk,
+            split=SplitParams(
+                lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+                max_delta_step=cfg.max_delta_step,
+                min_data_in_leaf=float(cfg.min_data_in_leaf),
+                min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+                min_gain_to_split=cfg.min_gain_to_split,
+                cat_smooth=cfg.cat_smooth, cat_l2=cfg.cat_l2,
+                max_cat_threshold=cfg.max_cat_threshold,
+                max_cat_to_onehot=cfg.max_cat_to_onehot,
+                min_data_per_group=cfg.min_data_per_group))
+        self._grow_fn = make_grow_tree(self.num_bins, self.grower_params)
+        C = self.num_tree_per_iteration
+        self.train_score = jnp.zeros((C, self.num_data), dtype=jnp.float32)
+        if train_set.metadata.init_score is not None:
+            init = np.asarray(train_set.metadata.init_score, dtype=np.float32)
+            self.train_score = jnp.asarray(
+                init.reshape(C, self.num_data))
+        self._bag_rng = np.random.RandomState(cfg.bagging_seed)
+        self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self.bag_weight = jnp.ones(self.num_data, dtype=jnp.float32)
+        self._boosted_from_average = False
+
+    def add_valid_data(self, name: str, valid_set: TpuDataset) -> None:
+        C = self.num_tree_per_iteration
+        score = np.zeros((C, valid_set.num_data), dtype=np.float64)
+        if valid_set.metadata.init_score is not None:
+            score = np.asarray(valid_set.metadata.init_score,
+                               dtype=np.float64).reshape(C, valid_set.num_data)
+        # replay existing trees (continued training, gbdt.cpp AddValidDataset)
+        infos = self.train_set.feature_infos() if self.train_set else None
+        for it in range(self.iter_):
+            for k in range(C):
+                tree = self.models[it * C + k]
+                score[k] += tree.predict_binned(valid_set.binned, infos)
+        for k in range(C):
+            score[k] += self.init_scores[k]
+        self.valid_sets.append((name, valid_set))
+        self.valid_scores.append(score)
+
+    # --------------------------------------------------------------- bagging
+    def _bagging(self, iter_idx: int) -> None:
+        cfg = self.config
+        need = (cfg.bagging_freq > 0 and
+                (cfg.bagging_fraction < 1.0
+                 or cfg.pos_bagging_fraction < 1.0
+                 or cfg.neg_bagging_fraction < 1.0))
+        if not need:
+            return
+        if iter_idx % cfg.bagging_freq != 0:
+            return
+        n = self.num_data
+        if (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0):
+            # balanced bagging over positive/negative labels (gbdt.cpp:186-240)
+            lab = np.asarray(self.train_set.metadata.label)
+            mask = np.zeros(n, dtype=np.float32)
+            pos = np.nonzero(lab > 0)[0]
+            neg = np.nonzero(lab <= 0)[0]
+            kp = int(len(pos) * cfg.pos_bagging_fraction)
+            kn = int(len(neg) * cfg.neg_bagging_fraction)
+            if kp > 0:
+                mask[self._bag_rng.choice(pos, kp, replace=False)] = 1.0
+            if kn > 0:
+                mask[self._bag_rng.choice(neg, kn, replace=False)] = 1.0
+        else:
+            k = int(n * cfg.bagging_fraction)
+            idx = self._bag_rng.choice(n, k, replace=False)
+            mask = np.zeros(n, dtype=np.float32)
+            mask[idx] = 1.0
+        self.bag_weight = jnp.asarray(mask)
+
+    def _tree_feature_mask(self) -> jnp.ndarray:
+        """Per-tree feature_fraction sampling (GetUsedFeatures,
+        serial_tree_learner.cpp:273-321)."""
+        F = self.train_set.num_used_features
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            return jnp.ones(F, dtype=jnp.float32)
+        k = max(1, int(F * frac))
+        idx = self._feat_rng.choice(F, k, replace=False)
+        mask = np.zeros(F, dtype=np.float32)
+        mask[idx] = 1.0
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------- iteration
+    def _boost_from_average(self) -> None:
+        cfg = self.config
+        if (self._boosted_from_average or self.objective is None
+                or not cfg.boost_from_average
+                or self.train_set.metadata.init_score is not None):
+            self._boosted_from_average = True
+            return
+        C = self.num_tree_per_iteration
+        for k in range(C):
+            init = self.objective.boost_from_score(k)
+            if abs(init) > 1e-15:
+                self.init_scores[k] = init
+                self.train_score = self.train_score.at[k].add(init)
+                for vs in self.valid_scores:
+                    vs[k] += init
+        self._boosted_from_average = True
+
+    def _gradients(self):
+        C = self.num_tree_per_iteration
+        if C == 1:
+            g, h = self.objective.get_gradients(self.train_score[0])
+            return g[None, :], h[None, :]
+        return self.objective.get_gradients(self.train_score)
+
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration; returns True if training should stop
+        (no further splits possible), matching LGBM_BoosterUpdateOneIter
+        semantics."""
+        self._boost_from_average()
+        C = self.num_tree_per_iteration
+        if grad is None or hess is None:
+            if self.objective is None:
+                log_fatal("No objective and no custom gradients")
+            grads, hesss = self._gradients()
+        else:
+            grads = jnp.asarray(np.asarray(grad, dtype=np.float32)
+                                .reshape(C, self.num_data))
+            hesss = jnp.asarray(np.asarray(hess, dtype=np.float32)
+                                .reshape(C, self.num_data))
+        self._bagging(self.iter_)
+
+        should_stop = True
+        infos = self.train_set.feature_infos()
+        for k in range(C):
+            fmask = self._tree_feature_mask()
+            self._key, sub = jax.random.split(self._key)
+            arrays, leaf_id = self._grow_fn(
+                self.bins, grads[k], hesss[k], self.bag_weight, self.fmeta,
+                fmask, sub)
+            nl = int(arrays.num_leaves)
+            if nl <= 1:
+                tree = Tree(1)
+                self.models.append(tree)
+                continue
+            should_stop = False
+            tree = Tree.from_arrays(arrays, self.train_set)
+            # leaf renewal for percentile-fit objectives (L1/quantile/MAPE)
+            if (self.objective is not None
+                    and self.objective.is_renew_tree_output):
+                leaf_np = np.asarray(leaf_id)
+                score_np = np.asarray(self.train_score[k], dtype=np.float64)
+                tree.set_leaf_values(self.objective.renew_tree_output(
+                    tree.leaf_value, leaf_np, score_np))
+            tree.apply_shrinkage(self.shrinkage_rate)
+            # device score update via the grower's leaf assignment
+            lv = jnp.asarray(tree.leaf_value, dtype=jnp.float32)
+            self.train_score = self.train_score.at[k].set(
+                _add_tree_score(self.train_score[k], lv, leaf_id))
+            for (vname, vset), vscore in zip(self.valid_sets,
+                                             self.valid_scores):
+                vscore[k] += tree.predict_binned(vset.binned, infos)
+            self.models.append(tree)
+
+        if should_stop:
+            log_warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            # drop the all-constant iteration (gbdt.cpp:543-551)
+            for _ in range(C):
+                self.models.pop()
+            return True
+        self.iter_ += 1
+        return False
+
+    def rollback_one_iter(self) -> None:
+        """Remove the last iteration's trees and scores (gbdt.cpp:553-576)."""
+        if self.iter_ <= 0:
+            return
+        C = self.num_tree_per_iteration
+        infos = self.train_set.feature_infos()
+        for k in reversed(range(C)):
+            tree = self.models.pop()
+            if tree.num_leaves > 1:
+                delta = tree.predict_binned(self.train_set.binned, infos)
+                self.train_score = self.train_score.at[k].add(
+                    -jnp.asarray(delta, dtype=jnp.float32))
+                for (vname, vset), vscore in zip(self.valid_sets,
+                                                 self.valid_scores):
+                    vscore[k] -= tree.predict_binned(vset.binned, infos)
+        self.iter_ -= 1
+
+    # ------------------------------------------------------------ prediction
+    def current_iteration(self) -> int:
+        return self.iter_
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return self.num_tree_per_iteration
+
+    def _raw_predict(self, X: np.ndarray, num_iteration: int = -1,
+                     start_iteration: int = 0) -> np.ndarray:
+        C = self.num_tree_per_iteration
+        n_iter = self.iter_ if num_iteration <= 0 else min(num_iteration,
+                                                           self.iter_)
+        out = np.zeros((C, X.shape[0]), dtype=np.float64)
+        for k in range(C):
+            out[k] += self.init_scores[k]
+        for it in range(start_iteration, n_iter):
+            for k in range(C):
+                out[k] += self.models[it * C + k].predict_raw(X)
+        return out
+
+    def predict(self, X: np.ndarray, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        C = self.num_tree_per_iteration
+        if pred_leaf:
+            n_iter = self.iter_ if num_iteration <= 0 else min(num_iteration,
+                                                               self.iter_)
+            leaves = np.zeros((X.shape[0], n_iter * C), dtype=np.int32)
+            for i in range(n_iter * C):
+                leaves[:, i] = self.models[i].apply_raw(X)
+            return leaves
+        raw = self._raw_predict(X, num_iteration)
+        if raw_score or self.objective is None:
+            res = raw
+        else:
+            res = self.objective.convert_output(raw)
+        if C == 1:
+            return res[0]
+        return res.T  # [N, C]
+
+    # ------------------------------------------------------------------ eval
+    def setup_metrics(self, metric_names: Sequence[str]) -> None:
+        """Instantiate metrics for train + each valid set
+        (GBDT::AddValidDataset / Init metric wiring, gbdt.cpp:49-130)."""
+        from ..metric import create_metric
+        self.metrics = []
+        for name in metric_names:
+            m = create_metric(name, self.config)
+            if m is not None and self.train_set is not None:
+                m.init(self.train_set.metadata, self.train_set.num_data)
+                self.metrics.append(m)
+        self.valid_metrics = []
+        for (vname, vset) in self.valid_sets:
+            ms = []
+            for name in metric_names:
+                m = create_metric(name, self.config)
+                if m is not None:
+                    m.init(vset.metadata, vset.num_data)
+                    ms.append(m)
+            self.valid_metrics.append(ms)
+
+    def _eval_score(self, score: np.ndarray, metrics) -> List[Tuple]:
+        out = []
+        s = score[0] if (score.ndim > 1 and score.shape[0] == 1) else score
+        for m in metrics:
+            if hasattr(m, "eval_multi"):
+                for k, v in zip(m.eval_at, m.eval_multi(s, self.objective)):
+                    out.append((f"{m.name}@{k}", float(v), m.higher_better))
+            else:
+                out.append((m.name, float(m.eval(s, self.objective)),
+                            m.higher_better))
+        return out
+
+    def eval_train(self) -> List[Tuple]:
+        score = np.asarray(self.train_score, dtype=np.float64)
+        return self._eval_score(score, self.metrics)
+
+    def eval_valid(self, i: int) -> List[Tuple]:
+        return self._eval_score(np.asarray(self.valid_scores[i]),
+                                self.valid_metrics[i])
+
+    # ----------------------------------------------------------- importances
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        """split counts or total gains per original feature
+        (gbdt.h FeatureImportance)."""
+        n_feat = self.max_feature_idx + 1
+        out = np.zeros(n_feat, dtype=np.float64)
+        C = self.num_tree_per_iteration
+        n_iter = self.iter_ if iteration <= 0 else min(iteration, self.iter_)
+        for tree in self.models[: n_iter * C]:
+            n = tree.num_leaves - 1
+            for i in range(n):
+                f = int(tree.split_feature[i])
+                if importance_type == "split":
+                    out[f] += 1
+                else:
+                    out[f] += max(float(tree.split_gain[i]), 0.0)
+        return out

@@ -1,0 +1,326 @@
+"""Leaf-wise tree growth, fused on-device.
+
+The TPU re-design of SerialTreeLearner::Train (serial_tree_learner.cpp:174-239).
+The reference's per-split sequence — BeforeFindBestSplit / ConstructHistograms
+/ FindBestSplitsFromHistograms / Split over index-list leaf partitions — is
+re-expressed as ONE jitted ``lax.fori_loop`` whose state lives entirely in
+HBM:
+
+  * leaf membership is a dense ``leaf_id[N]`` vector (scatter-free splits by
+    masked where) instead of DataPartition's index lists
+    (data_partition.hpp:111);
+  * per-leaf histograms are retained in a ``[num_leaves, F, B, 3]`` tensor —
+    the HistogramPool (feature_histogram.hpp:654) without eviction since HBM
+    comfortably holds all leaves;
+  * only the smaller child is histogrammed from data; the larger child is
+    parent - smaller (the subtraction trick, serial_tree_learner.cpp:494-497,
+    596-597);
+  * the leaf to split is the argmax of per-leaf best gains
+    (serial_tree_learner.cpp:219), and tree topology is built with LightGBM's
+    node numbering (Tree::Split, tree.h:407-445: new internal node =
+    num_leaves-1, right child leaf = num_leaves, leaf refs stored as ~leaf).
+
+Everything is traced once per (N, F, B, num_leaves, params) signature; no
+host round-trips during growth.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.binning import MISSING_NAN, MISSING_ZERO
+from ..ops.histogram import histogram_chunked
+from ..ops.split import (NEG_INF, FeatureMeta, SplitParams, best_split)
+
+
+class GrowerParams(NamedTuple):
+    """Static growth hyper-parameters (folded into the jit signature)."""
+    num_leaves: int = 31
+    max_depth: int = -1
+    feature_fraction_bynode: float = 1.0
+    row_chunk: int = 0
+    split: SplitParams = SplitParams()
+
+
+class TreeArrays(NamedTuple):
+    """Flat-array tree, device-resident; mirrors reference Tree storage
+    (include/LightGBM/tree.h:330-404)."""
+    num_leaves: jax.Array          # i32 scalar: leaves actually produced
+    # internal nodes [num_leaves-1]
+    split_feature: jax.Array       # i32 (index into used features)
+    threshold_bin: jax.Array       # i32
+    default_left: jax.Array        # bool
+    is_cat: jax.Array              # bool
+    cat_bitset: jax.Array          # u32 [num_leaves-1, 8]
+    left_child: jax.Array          # i32 (>=0 internal, ~leaf for leaves)
+    right_child: jax.Array         # i32
+    split_gain: jax.Array          # f32
+    internal_value: jax.Array      # f32
+    internal_weight: jax.Array     # f32
+    internal_count: jax.Array      # f32
+    # leaves [num_leaves]
+    leaf_value: jax.Array          # f32
+    leaf_weight: jax.Array         # f32
+    leaf_count: jax.Array          # f32
+    leaf_parent: jax.Array         # i32
+    leaf_depth: jax.Array          # i32
+
+
+class _GrowState(NamedTuple):
+    leaf_id: jax.Array
+    num_leaves: jax.Array
+    leaf_hist: jax.Array           # [L, F, B, 3]
+    leaf_g: jax.Array              # [L]
+    leaf_h: jax.Array
+    leaf_c: jax.Array
+    # per-leaf best-split cache (best_split_per_leaf_,
+    # serial_tree_learner.h:153)
+    best_gain: jax.Array
+    best_feature: jax.Array
+    best_threshold: jax.Array
+    best_default_left: jax.Array
+    best_is_cat: jax.Array
+    best_cat_bitset: jax.Array     # [L, 8]
+    best_left_g: jax.Array
+    best_left_h: jax.Array
+    best_left_c: jax.Array
+    best_left_out: jax.Array
+    best_right_out: jax.Array
+    tree: TreeArrays
+
+
+def _bit_test(bitset_row: jax.Array, idx: jax.Array) -> jax.Array:
+    """bitset_row u32[8], idx i32 -> bool."""
+    word = bitset_row[idx // 32]
+    return ((word >> (idx % 32).astype(jnp.uint32)) & 1).astype(bool)
+
+
+def routed_left(fcol, threshold, default_left, is_cat, cat_bitset,
+                missing_type, default_bin, num_bin):
+    """Which side each row goes (numerical <=threshold with missing routing,
+    categorical bitset membership)."""
+    fcol = fcol.astype(jnp.int32)
+    is_missing = (((missing_type == MISSING_ZERO) & (fcol == default_bin))
+                  | ((missing_type == MISSING_NAN) & (fcol == num_bin - 1)))
+    num_left = jnp.where(is_missing, default_left, fcol <= threshold)
+    cat_left = _bit_test(cat_bitset, jnp.clip(fcol, 0, 255))
+    return jnp.where(is_cat, cat_left, num_left)
+
+
+def _node_feature_mask(base_mask, key, step, p: GrowerParams):
+    if p.feature_fraction_bynode >= 1.0:
+        return base_mask
+    sub = jax.random.fold_in(key, step)
+    m = jax.random.bernoulli(sub, p.feature_fraction_bynode,
+                             base_mask.shape).astype(base_mask.dtype)
+    m = m * base_mask
+    # guarantee at least one usable feature
+    return jnp.where(m.sum() > 0, m, base_mask)
+
+
+def _leaf_scan(hist, g, h, c, depth, fmeta, fmask, p: GrowerParams):
+    """best_split for one leaf + depth gating."""
+    info = best_split(hist, g, h, c, fmeta, p.split, fmask)
+    gain = info.gain
+    if p.max_depth > 0:
+        gain = jnp.where(depth >= p.max_depth, NEG_INF, gain)
+    return info, gain
+
+
+def make_grow_tree(num_bins: int, params: GrowerParams):
+    """Build the jitted tree-growing function for a static (B, params).
+
+    The returned ``grow(bins, grad, hess, member, fmeta, feature_mask, key)``
+    takes the [N, F] bin matrix, per-row gradients/hessians (already weighted
+    by metadata weights / GOSS amplification), a [N] inclusion weight vector
+    (bagging mask), per-feature metadata arrays, a [F] per-tree feature mask,
+    and a PRNG key; it returns ``(TreeArrays, leaf_id[N])`` where leaf ids
+    follow LightGBM leaf numbering so ``leaf_value[leaf_id]`` is this tree's
+    per-row raw prediction.
+    """
+    p = params
+    L = p.num_leaves
+    B = num_bins
+    sp = p.split
+
+    def hist_of(bins, grad, hess, member):
+        w = jnp.stack([grad * member, hess * member, member])
+        return histogram_chunked(bins, w, B, p.row_chunk)
+
+    def scan_leaf(st: _GrowState, leaf_idx, hist, g, h, c, depth, fmeta,
+                  fmask):
+        info, gain = _leaf_scan(hist, g, h, c, depth, fmeta, fmask, p)
+        return st._replace(
+            best_gain=st.best_gain.at[leaf_idx].set(gain),
+            best_feature=st.best_feature.at[leaf_idx].set(info.feature),
+            best_threshold=st.best_threshold.at[leaf_idx].set(info.threshold),
+            best_default_left=st.best_default_left.at[leaf_idx].set(
+                info.default_left),
+            best_is_cat=st.best_is_cat.at[leaf_idx].set(info.is_cat),
+            best_cat_bitset=st.best_cat_bitset.at[leaf_idx].set(info.cat_bitset),
+            best_left_g=st.best_left_g.at[leaf_idx].set(info.left_g),
+            best_left_h=st.best_left_h.at[leaf_idx].set(info.left_h),
+            best_left_c=st.best_left_c.at[leaf_idx].set(info.left_c),
+            best_left_out=st.best_left_out.at[leaf_idx].set(info.left_out),
+            best_right_out=st.best_right_out.at[leaf_idx].set(info.right_out),
+        )
+
+    def grow(bins, grad, hess, member, fmeta: FeatureMeta, feature_mask, key):
+        n, F = bins.shape
+
+        def do_split(st: _GrowState, step):
+            leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+            new_leaf = st.num_leaves
+            node = st.num_leaves - 1
+
+            f = st.best_feature[leaf]
+            t = st.best_threshold[leaf]
+            dl = st.best_default_left[leaf]
+            cat = st.best_is_cat[leaf]
+            bitset = st.best_cat_bitset[leaf]
+
+            fcol = lax.dynamic_slice_in_dim(bins, f, 1, axis=1)[:, 0]
+            go_left = routed_left(fcol, t, dl, cat, bitset,
+                                  fmeta.missing_type[f], fmeta.default_bin[f],
+                                  fmeta.num_bin[f])
+            in_leaf = st.leaf_id == leaf
+            leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, st.leaf_id)
+
+            Gl, Hl, Cl = (st.best_left_g[leaf], st.best_left_h[leaf],
+                          st.best_left_c[leaf])
+            Gp, Hp, Cp = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
+            Gr, Hr, Cr = Gp - Gl, Hp - Hl, Cp - Cl
+
+            smaller_is_left = Cl <= Cr
+            smaller = jnp.where(smaller_is_left, leaf, new_leaf)
+            mem_small = (leaf_id == smaller).astype(grad.dtype) * member
+            hist_small = hist_of(bins, grad, hess, mem_small)
+            hist_parent = st.leaf_hist[leaf]
+            hist_large = hist_parent - hist_small
+            hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
+            hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
+            leaf_hist = (st.leaf_hist.at[leaf].set(hist_left)
+                         .at[new_leaf].set(hist_right))
+
+            depth_child = st.tree.leaf_depth[leaf] + 1
+            tree = st.tree
+            parent = tree.leaf_parent[leaf]
+            # re-point the parent's child slot from ~leaf to the new node
+            # (Tree::Split's parent fixup, tree.h:411-419)
+            pl = jnp.where((parent >= 0)
+                           & (tree.left_child[jnp.maximum(parent, 0)] == ~leaf),
+                           node, tree.left_child[jnp.maximum(parent, 0)])
+            pr = jnp.where((parent >= 0)
+                           & (tree.right_child[jnp.maximum(parent, 0)] == ~leaf),
+                           node, tree.right_child[jnp.maximum(parent, 0)])
+            left_child = tree.left_child.at[jnp.maximum(parent, 0)].set(pl)
+            right_child = tree.right_child.at[jnp.maximum(parent, 0)].set(pr)
+            left_child = left_child.at[node].set(~leaf)
+            right_child = right_child.at[node].set(~new_leaf)
+
+            out_l = st.best_left_out[leaf]
+            out_r = st.best_right_out[leaf]
+            tree = tree._replace(
+                num_leaves=st.num_leaves + 1,
+                split_feature=tree.split_feature.at[node].set(f),
+                threshold_bin=tree.threshold_bin.at[node].set(t),
+                default_left=tree.default_left.at[node].set(dl),
+                is_cat=tree.is_cat.at[node].set(cat),
+                cat_bitset=tree.cat_bitset.at[node].set(bitset),
+                left_child=left_child,
+                right_child=right_child,
+                split_gain=tree.split_gain.at[node].set(st.best_gain[leaf]),
+                internal_value=tree.internal_value.at[node].set(
+                    tree.leaf_value[leaf]),
+                internal_weight=tree.internal_weight.at[node].set(Hp),
+                internal_count=tree.internal_count.at[node].set(Cp),
+                leaf_value=(tree.leaf_value.at[leaf].set(out_l)
+                            .at[new_leaf].set(out_r)),
+                leaf_weight=(tree.leaf_weight.at[leaf].set(Hl)
+                             .at[new_leaf].set(Hr)),
+                leaf_count=(tree.leaf_count.at[leaf].set(Cl)
+                            .at[new_leaf].set(Cr)),
+                leaf_parent=(tree.leaf_parent.at[leaf].set(node)
+                             .at[new_leaf].set(node)),
+                leaf_depth=(tree.leaf_depth.at[leaf].set(depth_child)
+                            .at[new_leaf].set(depth_child)),
+            )
+
+            st = st._replace(
+                leaf_id=leaf_id,
+                num_leaves=st.num_leaves + 1,
+                leaf_hist=leaf_hist,
+                leaf_g=st.leaf_g.at[leaf].set(Gl).at[new_leaf].set(Gr),
+                leaf_h=st.leaf_h.at[leaf].set(Hl).at[new_leaf].set(Hr),
+                leaf_c=st.leaf_c.at[leaf].set(Cl).at[new_leaf].set(Cr),
+                tree=tree,
+            )
+            fmask_l = _node_feature_mask(feature_mask, key, 2 * step, p)
+            fmask_r = _node_feature_mask(feature_mask, key, 2 * step + 1, p)
+            st = scan_leaf(st, leaf, hist_left, Gl, Hl, Cl, depth_child,
+                           fmeta, fmask_l)
+            st = scan_leaf(st, new_leaf, hist_right, Gr, Hr, Cr, depth_child,
+                           fmeta, fmask_r)
+            return st
+
+        def body(step, st: _GrowState):
+            can_split = jnp.max(st.best_gain) > 0.0
+            return lax.cond(can_split,
+                            lambda s: do_split(s, step),
+                            lambda s: s, st)
+
+        # ---- init root ----
+        G0 = jnp.sum(grad * member)
+        H0 = jnp.sum(hess * member)
+        C0 = jnp.sum(member)
+        root_hist = hist_of(bins, grad, hess, member)
+        neg = jnp.full(L, NEG_INF, dtype=jnp.float32)
+        zeros_l = jnp.zeros(L, dtype=jnp.float32)
+        tree0 = TreeArrays(
+            num_leaves=jnp.int32(1),
+            split_feature=jnp.zeros(L - 1, dtype=jnp.int32),
+            threshold_bin=jnp.zeros(L - 1, dtype=jnp.int32),
+            default_left=jnp.zeros(L - 1, dtype=bool),
+            is_cat=jnp.zeros(L - 1, dtype=bool),
+            cat_bitset=jnp.zeros((L - 1, 8), dtype=jnp.uint32),
+            left_child=jnp.full(L - 1, -1, dtype=jnp.int32),
+            right_child=jnp.full(L - 1, -1, dtype=jnp.int32),
+            split_gain=jnp.zeros(L - 1, dtype=jnp.float32),
+            internal_value=jnp.zeros(L - 1, dtype=jnp.float32),
+            internal_weight=jnp.zeros(L - 1, dtype=jnp.float32),
+            internal_count=jnp.zeros(L - 1, dtype=jnp.float32),
+            leaf_value=zeros_l,
+            leaf_weight=zeros_l.at[0].set(H0),
+            leaf_count=zeros_l.at[0].set(C0),
+            leaf_parent=jnp.full(L, -1, dtype=jnp.int32),
+            leaf_depth=jnp.zeros(L, dtype=jnp.int32),
+        )
+        st = _GrowState(
+            leaf_id=jnp.zeros(bins.shape[0], dtype=jnp.int32),
+            num_leaves=jnp.int32(1),
+            leaf_hist=jnp.zeros((L, bins.shape[1], B, 3), dtype=jnp.float32)
+                         .at[0].set(root_hist),
+            leaf_g=zeros_l.at[0].set(G0),
+            leaf_h=zeros_l.at[0].set(H0),
+            leaf_c=zeros_l.at[0].set(C0),
+            best_gain=neg,
+            best_feature=jnp.full(L, -1, dtype=jnp.int32),
+            best_threshold=jnp.zeros(L, dtype=jnp.int32),
+            best_default_left=jnp.zeros(L, dtype=bool),
+            best_is_cat=jnp.zeros(L, dtype=bool),
+            best_cat_bitset=jnp.zeros((L, 8), dtype=jnp.uint32),
+            best_left_g=zeros_l, best_left_h=zeros_l, best_left_c=zeros_l,
+            best_left_out=zeros_l, best_right_out=zeros_l,
+            tree=tree0,
+        )
+        fmask_root = _node_feature_mask(feature_mask, key, 2 * L, p)
+        st = scan_leaf(st, 0, root_hist, G0, H0, C0, jnp.int32(0), fmeta,
+                       fmask_root)
+        st = lax.fori_loop(0, L - 1, body, st)
+        return st.tree, st.leaf_id
+
+    return jax.jit(grow)

@@ -1,0 +1,428 @@
+"""Model text/JSON serialization, LightGBM-format compatible.
+
+Reference: src/boosting/gbdt_model_text.cpp — SaveModelToString (:250:
+header key=values, per-tree blocks, "end of trees", feature importances),
+LoadModelFromString, DumpModel (:19, JSON); src/io/tree.cpp Tree::ToString
+(:209).  Models saved here load in stock LightGBM and vice versa for the
+shared feature set.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import LightGBMError, log_warning
+from .tree import Tree
+
+MODEL_VERSION = "v2"
+
+
+def _fmt(x: float) -> str:
+    """Shortest round-trip float formatting (Common::ArrayToString)."""
+    return np.format_float_positional(
+        float(x), unique=True, trim="0") if np.isfinite(x) else str(x)
+
+
+def _join(arr, fmt=str) -> str:
+    return " ".join(fmt(x) for x in arr)
+
+
+def _objective_to_string(config: Config, objective) -> str:
+    name = config.objective
+    if name == "binary":
+        return f"binary sigmoid:{_fmt(config.sigmoid)}"
+    if name == "multiclass":
+        return f"multiclass num_class:{config.num_class}"
+    if name == "multiclassova":
+        return (f"multiclassova num_class:{config.num_class} "
+                f"sigmoid:{_fmt(config.sigmoid)}")
+    if name == "lambdarank":
+        return "lambdarank"
+    return name
+
+
+def tree_to_string(tree: Tree) -> str:
+    nl = tree.num_leaves
+    n = nl - 1
+    lines = [f"num_leaves={nl}", f"num_cat={tree.num_cat}"]
+    if n > 0:
+        lines += [
+            "split_feature=" + _join(tree.split_feature),
+            "split_gain=" + _join(tree.split_gain, _fmt),
+            "threshold=" + _join(tree.threshold, _fmt),
+            "decision_type=" + _join(tree.decision_type.astype(np.int64)),
+            "left_child=" + _join(tree.left_child),
+            "right_child=" + _join(tree.right_child),
+            "leaf_value=" + _join(tree.leaf_value, _fmt),
+            "leaf_weight=" + _join(tree.leaf_weight, _fmt),
+            "leaf_count=" + _join(tree.leaf_count),
+            "internal_value=" + _join(tree.internal_value, _fmt),
+            "internal_weight=" + _join(tree.internal_weight, _fmt),
+            "internal_count=" + _join(tree.internal_count),
+        ]
+        if tree.num_cat > 0:
+            flat = np.concatenate(tree.cat_threshold) if tree.cat_threshold \
+                else np.zeros(0, dtype=np.uint32)
+            flat_inner = (np.concatenate(tree.cat_threshold_inner)
+                          if tree.cat_threshold_inner
+                          else np.zeros(0, dtype=np.uint32))
+            lines += [
+                "cat_boundaries=" + _join(tree.cat_boundaries),
+                "cat_threshold=" + _join(flat.astype(np.int64)),
+                # extension block so binned prediction survives a round-trip
+                "cat_boundaries_inner=" + _join(tree.cat_boundaries_inner),
+                "cat_threshold_inner=" + _join(flat_inner.astype(np.int64)),
+            ]
+    else:
+        lines += ["leaf_value=" + _join(tree.leaf_value, _fmt)]
+    lines.append(f"shrinkage={_fmt(tree.shrinkage)}")
+    return "\n".join(lines) + "\n"
+
+
+def _feature_infos_strings(gbdt) -> List[str]:
+    ds = gbdt.train_set
+    out = []
+    if ds is None:
+        return ["none"] * (gbdt.max_feature_idx + 1)
+    for f, m in enumerate(ds.bin_mappers):
+        if m.is_trivial:
+            out.append("none")
+        elif m.is_categorical:
+            out.append(":".join(str(c) for c in sorted(m.bin_2_categorical)))
+        else:
+            out.append(f"[{_fmt(m.min_val)}:{_fmt(m.max_val)}]")
+    return out
+
+
+def save_model_to_string(gbdt, config: Config, num_iteration: int = -1,
+                         start_iteration: int = 0) -> str:
+    C = gbdt.num_tree_per_iteration
+    total_iter = len(gbdt.models) // max(C, 1)
+    start_iteration = min(max(start_iteration, 0), total_iter)
+    if num_iteration > 0:
+        end_iter = min(start_iteration + num_iteration, total_iter)
+    else:
+        end_iter = total_iter
+    lines = ["tree", f"version={MODEL_VERSION}",
+             f"num_class={config.num_class}",
+             f"num_tree_per_iteration={C}",
+             "label_index=0",
+             f"max_feature_idx={gbdt.max_feature_idx}",
+             f"objective={_objective_to_string(config, gbdt.objective)}"]
+    if getattr(gbdt, "average_output", False):
+        lines.append("average_output")
+    lines.append("feature_names=" + " ".join(gbdt.feature_names))
+    lines.append("feature_infos=" + " ".join(_feature_infos_strings(gbdt)))
+    lines.append("init_scores=" + _join(gbdt.init_scores, _fmt))
+
+    tree_strs = []
+    for i in range(start_iteration * C, end_iter * C):
+        s = f"Tree={i - start_iteration * C}\n" + tree_to_string(
+            gbdt.models[i]) + "\n"
+        tree_strs.append(s)
+    lines.append("tree_sizes=" + _join(len(s) for s in tree_strs))
+    lines.append("")
+    body = "\n".join(lines) + "\n" + "".join(tree_strs) + "end of trees\n"
+
+    imps = gbdt.feature_importance("split")
+    pairs = sorted(
+        [(int(v), gbdt.feature_names[i]) for i, v in enumerate(imps) if v > 0],
+        key=lambda p: -p[0])
+    body += "\nfeature importances:\n"
+    for v, name in pairs:
+        body += f"{name}={v}\n"
+    body += "\nparameters:\n"
+    for k, v in (config.raw or {}).items():
+        body += f"[{k}: {v}]\n"
+    body += "end of parameters\n"
+    return body
+
+
+def tree_from_block(block: str) -> Tree:
+    kv: Dict[str, str] = {}
+    for line in block.strip().splitlines():
+        if "=" in line:
+            k, v = line.split("=", 1)
+            kv[k.strip()] = v.strip()
+    nl = int(kv["num_leaves"])
+    t = Tree(nl)
+    t.shrinkage = float(kv.get("shrinkage", 1.0))
+    t.num_cat = int(kv.get("num_cat", 0))
+
+    def arr(key, dtype, size):
+        if key not in kv or not kv[key]:
+            return np.zeros(size, dtype=dtype)
+        return np.asarray(kv[key].split(), dtype=np.float64).astype(dtype)
+
+    t.leaf_value = arr("leaf_value", np.float64, nl)
+
+    n = nl - 1
+    if n > 0:
+        t.split_feature = arr("split_feature", np.int32, n)
+        t.split_feature_inner = t.split_feature.copy()
+        t.split_gain = arr("split_gain", np.float32, n)
+        t.threshold = arr("threshold", np.float64, n)
+        t.decision_type = arr("decision_type", np.int8, n)
+        t.left_child = arr("left_child", np.int32, n)
+        t.right_child = arr("right_child", np.int32, n)
+        t.leaf_weight = arr("leaf_weight", np.float64, nl)
+        t.leaf_count = arr("leaf_count", np.int64, nl)
+        t.internal_value = arr("internal_value", np.float64, n)
+        t.internal_weight = arr("internal_weight", np.float64, n)
+        t.internal_count = arr("internal_count", np.int64, n)
+        t.threshold_in_bin = t.threshold.astype(np.int32)  # approximate
+        if t.num_cat > 0:
+            bounds = arr("cat_boundaries", np.int64, t.num_cat + 1)
+            words = arr("cat_threshold", np.int64, 0).astype(np.uint32)
+            t.cat_boundaries = [int(b) for b in bounds]
+            t.cat_threshold = [words[bounds[i]:bounds[i + 1]]
+                               for i in range(t.num_cat)]
+            if "cat_boundaries_inner" in kv:
+                bi = arr("cat_boundaries_inner", np.int64, t.num_cat + 1)
+                wi = arr("cat_threshold_inner", np.int64, 0).astype(np.uint32)
+                t.cat_boundaries_inner = [int(b) for b in bi]
+                t.cat_threshold_inner = [wi[bi[i]:bi[i + 1]]
+                                         for i in range(t.num_cat)]
+            # categorical nodes store the cat index in threshold
+            for i in range(n):
+                if t.decision_type[i] & 1:
+                    t.threshold_in_bin[i] = int(t.threshold[i])
+    return t
+
+
+def _parse_objective_string(s: str) -> Tuple[str, Dict[str, str]]:
+    parts = s.split()
+    args = {}
+    for tok in parts[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            args[k] = v
+    return parts[0], args
+
+
+class LoadedBoosting:
+    """Prediction-only boosting reconstructed from a model string; reuses
+    GBDT's prediction/importance methods over the same attribute surface."""
+
+    def __init__(self):
+        self.models: List[Tree] = []
+        self.num_tree_per_iteration = 1
+        self.init_scores: List[float] = []
+        self.feature_names: List[str] = []
+        self.max_feature_idx = 0
+        self.objective = None
+        self.iter_ = 0
+        self.average_output = False
+        self.train_set = None
+        self.config: Optional[Config] = None
+
+    def current_iteration(self) -> int:
+        return self.iter_
+
+    def _raw_predict(self, X, num_iteration=-1, start_iteration=0):
+        from .gbdt import GBDT
+        return GBDT._raw_predict(self, X, num_iteration, start_iteration)
+
+    def predict(self, X, num_iteration=-1, raw_score=False, pred_leaf=False,
+                pred_contrib=False):
+        from .gbdt import GBDT
+        return GBDT.predict(self, X, num_iteration, raw_score, pred_leaf,
+                            pred_contrib)
+
+    def feature_importance(self, importance_type="split", iteration=-1):
+        from .gbdt import GBDT
+        return GBDT.feature_importance(self, importance_type, iteration)
+
+
+def load_model(model_str: str):
+    """Parse a model string -> (LoadedBoosting, Config, objective)."""
+    from .gbdt import GBDT
+    header, _, rest = model_str.partition("\nTree=0")
+    if not rest:
+        raise LightGBMError("Model format error: no trees found")
+    kv: Dict[str, str] = {}
+    for line in header.splitlines():
+        if "=" in line:
+            k, v = line.split("=", 1)
+            kv[k.strip()] = v.strip()
+        elif line.strip() == "average_output":
+            kv["average_output"] = "1"
+    out = LoadedBoosting()
+    out.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", 1))
+    out.max_feature_idx = int(kv.get("max_feature_idx", 0))
+    out.feature_names = kv.get("feature_names", "").split()
+    out.average_output = "average_output" in kv
+    if "init_scores" in kv and kv["init_scores"]:
+        out.init_scores = [float(x) for x in kv["init_scores"].split()]
+    else:
+        out.init_scores = [0.0] * out.num_tree_per_iteration
+
+    obj_name, obj_args = _parse_objective_string(
+        kv.get("objective", "regression"))
+    cfg_kwargs = {"objective": obj_name}
+    if "num_class" in obj_args:
+        cfg_kwargs["num_class"] = int(obj_args["num_class"])
+    if "sigmoid" in obj_args:
+        cfg_kwargs["sigmoid"] = float(obj_args["sigmoid"])
+    config = Config.from_params(cfg_kwargs)
+    from ..objective import create_objective
+    objective = create_objective(config)
+
+    trees_part = "Tree=0" + rest
+    trees_part = trees_part.split("end of trees")[0]
+    blocks = trees_part.split("Tree=")
+    for block in blocks:
+        block = block.strip()
+        if not block:
+            continue
+        _, _, body = block.partition("\n")
+        out.models.append(tree_from_block(body))
+    out.iter_ = len(out.models) // max(out.num_tree_per_iteration, 1)
+    out.objective = objective
+    out.config = config
+    # give the objective a convert_output without metadata init
+    return out, config, objective
+
+
+def load_trees_into(gbdt, init_booster, raw_data=None) -> None:
+    """Continued training: seed a fresh GBDT with an existing model's trees
+    (boosting.cpp:53-74 model-file continuation).  Init scores for the new
+    training data are computed by predicting with the loaded model
+    (application.cpp:89-92): on RAW feature values when available, else by
+    re-mapping each tree's real-valued thresholds into the new dataset's bins
+    (exact whenever the threshold is a bin boundary, which holds for
+    same-distribution data)."""
+    src = init_booster.gbdt
+    C = gbdt.num_tree_per_iteration
+    if src.num_tree_per_iteration != C:
+        raise LightGBMError("init model has different num_tree_per_iteration")
+    import jax.numpy as jnp
+    gbdt.init_scores = list(src.init_scores)
+    for k in range(C):
+        gbdt.train_score = gbdt.train_score.at[k].add(
+            float(src.init_scores[k]))
+    if raw_data is not None:
+        raw = np.asarray(raw_data, dtype=np.float64)
+        deltas = [sum(src.models[it * C + k].predict_raw(raw)
+                      for it in range(src.iter_)) for k in range(C)]
+    else:
+        ds = gbdt.train_set
+        infos = ds.feature_infos()
+        deltas = []
+        for k in range(C):
+            total = np.zeros(gbdt.num_data)
+            for it in range(src.iter_):
+                tree = src.models[it * C + k]
+                if tree.num_leaves <= 1:
+                    total += tree.leaf_value[0]
+                    continue
+                remapped = _remap_tree_to_bins(tree, ds)
+                total += remapped.predict_binned(ds.binned, infos)
+            deltas.append(total)
+    for k in range(C):
+        gbdt.train_score = gbdt.train_score.at[k].add(
+            jnp.asarray(deltas[k], dtype=jnp.float32))
+    for it in range(src.iter_):
+        for k in range(C):
+            gbdt.models.append(src.models[it * C + k])
+    gbdt.iter_ += src.iter_
+    gbdt._boosted_from_average = True
+
+
+def _remap_tree_to_bins(tree: Tree, ds) -> Tree:
+    """Rewrite a tree's inner (bin-space) split data against dataset ``ds``."""
+    import copy
+    t = copy.copy(tree)
+    n = tree.num_leaves - 1
+    t.split_feature_inner = np.asarray(
+        [ds.inner_feature_index(int(f)) for f in tree.split_feature],
+        dtype=np.int32)
+    thr = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        f = int(tree.split_feature[i])
+        if tree.decision_type[i] & 1:
+            thr[i] = tree.threshold_in_bin[i]
+            continue
+        mapper = ds.bin_mappers[f]
+        thr[i] = int(mapper.value_to_bin(
+            np.asarray([tree.threshold[i]]))[0])
+    t.threshold_in_bin = thr
+    return t
+
+
+def dump_model_dict(gbdt, config: Config, num_iteration: int = -1) -> Dict:
+    """JSON model dump (GBDT::DumpModel, gbdt_model_text.cpp:19-64)."""
+    C = gbdt.num_tree_per_iteration
+    n_iter = (gbdt.iter_ if num_iteration <= 0
+              else min(num_iteration, gbdt.iter_))
+
+    def node_dict(tree: Tree, node: int) -> Dict:
+        if node < 0:
+            leaf = ~node
+            return {
+                "leaf_index": int(leaf),
+                "leaf_value": float(tree.leaf_value[leaf]),
+                "leaf_weight": float(tree.leaf_weight[leaf])
+                if leaf < len(tree.leaf_weight) else 0.0,
+                "leaf_count": int(tree.leaf_count[leaf])
+                if leaf < len(tree.leaf_count) else 0,
+            }
+        dt = int(tree.decision_type[node])
+        is_cat = bool(dt & 1)
+        d = {
+            "split_index": int(node),
+            "split_feature": int(tree.split_feature[node]),
+            "split_gain": float(tree.split_gain[node]),
+            "threshold": (float(tree.threshold[node]) if not is_cat else
+                          "||".join(str(c) for c in _cats_of(tree, node))),
+            "decision_type": "==" if is_cat else "<=",
+            "default_left": bool(dt & 2),
+            "missing_type": ["None", "Zero", "NaN"][(dt >> 2) & 3],
+            "internal_value": float(tree.internal_value[node]),
+            "internal_weight": float(tree.internal_weight[node]),
+            "internal_count": int(tree.internal_count[node]),
+            "left_child": node_dict(tree, int(tree.left_child[node])),
+            "right_child": node_dict(tree, int(tree.right_child[node])),
+        }
+        return d
+
+    def _cats_of(tree: Tree, node: int) -> List[int]:
+        cat_idx = int(tree.threshold_in_bin[node])
+        words = tree.cat_threshold[cat_idx]
+        return [b for b in range(len(words) * 32)
+                if words[b // 32] >> (b % 32) & 1]
+
+    trees = []
+    for i in range(n_iter * C):
+        t = gbdt.models[i]
+        td = {
+            "tree_index": i,
+            "num_leaves": int(t.num_leaves),
+            "num_cat": int(t.num_cat),
+            "shrinkage": float(t.shrinkage),
+        }
+        if t.num_leaves > 1:
+            td["tree_structure"] = node_dict(t, 0)
+        else:
+            td["tree_structure"] = {"leaf_value": float(t.leaf_value[0])}
+        trees.append(td)
+    return {
+        "name": "tree",
+        "version": MODEL_VERSION,
+        "num_class": config.num_class,
+        "num_tree_per_iteration": C,
+        "label_index": 0,
+        "max_feature_idx": gbdt.max_feature_idx,
+        "objective": _objective_to_string(config, gbdt.objective),
+        "average_output": bool(getattr(gbdt, "average_output", False)),
+        "feature_names": list(gbdt.feature_names),
+        "feature_importances": {
+            name: int(v) for name, v in zip(
+                gbdt.feature_names, gbdt.feature_importance("split"))
+            if v > 0},
+        "tree_info": trees,
+    }

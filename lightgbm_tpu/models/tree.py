@@ -1,0 +1,289 @@
+"""Host-side decision tree model: prediction + (de)serialization.
+
+Reference: include/LightGBM/tree.h:25-470 + src/io/tree.cpp.  Flat-array
+binary tree with LightGBM's node numbering (internal node i created by the
+i+1-th split; leaves referenced as ``~leaf``), decision_type bit flags
+(bit0 categorical, bit1 default-left, bits2-3 missing type), numerical
+``value <= threshold`` splits with missing routing, and categorical bitset
+splits over category values (outer) / bin ids (inner).
+
+Prediction here is vectorized numpy level-by-level routing — used for raw
+feature matrices (Booster.predict) and for binned validation data during
+training.  The training-time score update does not use this path at all: the
+grower returns ``leaf_id`` directly on device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+K_ZERO_THRESHOLD = 1e-35
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+
+
+def _bitset_contains(words: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    """Vectorized Common::FindInBitset (utils/common.h:893-906)."""
+    n_bits = len(words) * 32
+    ok = (vals >= 0) & (vals < n_bits)
+    safe = np.where(ok, vals, 0)
+    word = words[safe // 32]
+    return ok & (((word >> (safe % 32)) & 1).astype(bool))
+
+
+def bitset_from_values(values: List[int]) -> np.ndarray:
+    if not values:
+        return np.zeros(1, dtype=np.uint32)
+    size = max(values) // 32 + 1
+    out = np.zeros(size, dtype=np.uint32)
+    for v in values:
+        if v >= 0:
+            out[v // 32] |= np.uint32(1) << np.uint32(v % 32)
+    return out
+
+
+class Tree:
+    """One trained decision tree (host copy)."""
+
+    def __init__(self, num_leaves: int):
+        n = max(num_leaves - 1, 0)
+        self.num_leaves = num_leaves
+        self.shrinkage = 1.0
+        # internal nodes
+        self.split_feature_inner = np.zeros(n, dtype=np.int32)
+        self.split_feature = np.zeros(n, dtype=np.int32)   # real feature idx
+        self.threshold_in_bin = np.zeros(n, dtype=np.int32)
+        self.threshold = np.zeros(n, dtype=np.float64)     # real-valued
+        self.decision_type = np.zeros(n, dtype=np.int8)
+        self.left_child = np.full(n, -1, dtype=np.int32)
+        self.right_child = np.full(n, -1, dtype=np.int32)
+        self.split_gain = np.zeros(n, dtype=np.float32)
+        self.internal_value = np.zeros(n, dtype=np.float64)
+        self.internal_weight = np.zeros(n, dtype=np.float64)
+        self.internal_count = np.zeros(n, dtype=np.int64)
+        # categorical storage: per cat node, an index into cat_boundaries
+        self.num_cat = 0
+        self.cat_boundaries = [0]
+        self.cat_threshold: List[np.ndarray] = []          # category-value bitsets
+        self.cat_boundaries_inner = [0]
+        self.cat_threshold_inner: List[np.ndarray] = []    # bin-id bitsets
+        # leaves
+        self.leaf_value = np.zeros(max(num_leaves, 1), dtype=np.float64)
+        self.leaf_weight = np.zeros(max(num_leaves, 1), dtype=np.float64)
+        self.leaf_count = np.zeros(max(num_leaves, 1), dtype=np.int64)
+        self.leaf_parent = np.full(max(num_leaves, 1), -1, dtype=np.int32)
+        self.leaf_depth = np.zeros(max(num_leaves, 1), dtype=np.int32)
+
+    # --------------------------------------------------------------- factory
+    @classmethod
+    def from_arrays(cls, arrays, dataset) -> "Tree":
+        """Finalize a device TreeArrays pytree into a host Tree.
+
+        ``dataset`` supplies bin->value realization: real thresholds come from
+        BinMapper upper bounds (Dataset::RealThreshold) and categorical bin
+        bitsets are re-expressed over raw category values for the outer model.
+        """
+        nl = int(arrays.num_leaves)
+        t = cls(nl)
+        n = nl - 1
+        sf = np.asarray(arrays.split_feature)[:n]
+        t.split_feature_inner = sf.astype(np.int32)
+        used = np.asarray(dataset.used_feature_indices)
+        t.split_feature = used[sf].astype(np.int32)
+        t.threshold_in_bin = np.asarray(arrays.threshold_bin)[:n].astype(np.int32)
+        t.left_child = np.asarray(arrays.left_child)[:n].astype(np.int32)
+        t.right_child = np.asarray(arrays.right_child)[:n].astype(np.int32)
+        t.split_gain = np.asarray(arrays.split_gain)[:n].astype(np.float32)
+        t.internal_value = np.asarray(arrays.internal_value)[:n].astype(np.float64)
+        t.internal_weight = np.asarray(arrays.internal_weight)[:n].astype(np.float64)
+        t.internal_count = np.rint(
+            np.asarray(arrays.internal_count)[:n]).astype(np.int64)
+        t.leaf_value = np.asarray(arrays.leaf_value)[:nl].astype(np.float64)
+        t.leaf_weight = np.asarray(arrays.leaf_weight)[:nl].astype(np.float64)
+        t.leaf_count = np.rint(np.asarray(arrays.leaf_count)[:nl]).astype(np.int64)
+        t.leaf_parent = np.asarray(arrays.leaf_parent)[:nl].astype(np.int32)
+        t.leaf_depth = np.asarray(arrays.leaf_depth)[:nl].astype(np.int32)
+
+        is_cat = np.asarray(arrays.is_cat)[:n]
+        dl = np.asarray(arrays.default_left)[:n]
+        bitsets = np.asarray(arrays.cat_bitset)[:n]
+        infos = dataset.feature_infos()
+        for i in range(n):
+            f_inner = int(sf[i])
+            info = infos[f_inner]
+            dt = 0
+            if is_cat[i]:
+                dt |= K_CATEGORICAL_MASK
+                # inner bitset over bins; outer over raw category values
+                inner = bitsets[i].astype(np.uint32)
+                bin_ids = [b for b in range(int(info.num_bin))
+                           if inner[b // 32] >> (b % 32) & 1]
+                mapper = dataset.bin_mappers[int(used[f_inner])]
+                cats = [mapper.bin_2_categorical[b] for b in bin_ids
+                        if b < len(mapper.bin_2_categorical)]
+                t.threshold_in_bin[i] = t.num_cat
+                t.threshold[i] = float(t.num_cat)
+                t.num_cat += 1
+                t.cat_threshold_inner.append(
+                    bitset_from_values(bin_ids))
+                t.cat_boundaries_inner.append(
+                    t.cat_boundaries_inner[-1] + len(t.cat_threshold_inner[-1]))
+                t.cat_threshold.append(bitset_from_values(cats))
+                t.cat_boundaries.append(
+                    t.cat_boundaries[-1] + len(t.cat_threshold[-1]))
+            else:
+                if dl[i]:
+                    dt |= K_DEFAULT_LEFT_MASK
+                t.threshold[i] = dataset.real_threshold(
+                    f_inner, int(t.threshold_in_bin[i]))
+            dt |= (int(info.missing_type) & 3) << 2
+            t.decision_type[i] = dt
+        return t
+
+    # ------------------------------------------------------------ prediction
+    def _decide(self, fval: np.ndarray, nodes: np.ndarray) -> np.ndarray:
+        """go-left decision for rows at internal ``nodes`` with raw values
+        ``fval`` (NumericalDecision / CategoricalDecision, tree.h:221-278)."""
+        dt = self.decision_type[nodes]
+        is_cat = (dt & K_CATEGORICAL_MASK) > 0
+        missing_type = (dt.astype(np.int32) >> 2) & 3
+        default_left = (dt & K_DEFAULT_LEFT_MASK) > 0
+
+        out = np.zeros(len(nodes), dtype=bool)
+        # numerical
+        num = ~is_cat
+        if num.any():
+            fv = fval[num].copy()
+            mt = missing_type[num]
+            nan = np.isnan(fv)
+            fv[nan & (mt != 2)] = 0.0
+            is_zero = (fv > -K_ZERO_THRESHOLD) & (fv <= K_ZERO_THRESHOLD)
+            use_default = ((mt == 1) & is_zero) | ((mt == 2) & np.isnan(fv))
+            go = np.where(use_default, default_left[num],
+                          fv <= self.threshold[nodes[num]])
+            out[num] = go
+        # categorical
+        if is_cat.any():
+            idx = np.nonzero(is_cat)[0]
+            fv = fval[idx]
+            mt = missing_type[idx]
+            int_fv = np.where(np.isnan(fv), -1, fv).astype(np.int64)
+            nan_right = np.isnan(fv) & (mt == 2)
+            int_fv = np.where(np.isnan(fv) & (mt != 2), 0, int_fv)
+            go = np.zeros(len(idx), dtype=bool)
+            for k, j in enumerate(idx):
+                if nan_right[k] or int_fv[k] < 0:
+                    go[k] = False
+                    continue
+                cat_idx = int(self.threshold_in_bin[nodes[j]])
+                words = self.cat_threshold[cat_idx]
+                go[k] = bool(_bitset_contains(
+                    words, np.asarray([int_fv[k]]))[0])
+            out[idx] = go
+        return out
+
+    def apply_raw(self, X: np.ndarray) -> np.ndarray:
+        """Leaf index for each row of a raw feature matrix."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        cur = np.zeros(n, dtype=np.int32)   # internal node index
+        leaf = np.full(n, -1, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        for _ in range(2 * self.num_leaves + 2):
+            if not active.any():
+                break
+            nodes = cur[active]
+            fv = X[active, self.split_feature[nodes]].astype(np.float64)
+            go_left = self._decide(fv, nodes)
+            nxt = np.where(go_left, self.left_child[nodes],
+                           self.right_child[nodes])
+            became_leaf = nxt < 0
+            act_idx = np.nonzero(active)[0]
+            leaf[act_idx[became_leaf]] = ~nxt[became_leaf]
+            cur[act_idx] = nxt
+            active[act_idx[became_leaf]] = False
+        return leaf
+
+    def apply_binned(self, binned: np.ndarray, feature_infos) -> np.ndarray:
+        """Leaf index for each row of a BINNED matrix aligned with training
+        bins (NumericalDecisionInner/CategoricalDecisionInner, tree.h:243-288)."""
+        n = binned.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        nb = np.asarray([fi.num_bin for fi in feature_infos], dtype=np.int32)
+        db = np.asarray([fi.default_bin for fi in feature_infos], dtype=np.int32)
+        cur = np.zeros(n, dtype=np.int32)
+        leaf = np.full(n, -1, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        for _ in range(2 * self.num_leaves + 2):
+            if not active.any():
+                break
+            nodes = cur[active]
+            f = self.split_feature_inner[nodes]
+            fv = binned[active, f].astype(np.int32)
+            dt = self.decision_type[nodes]
+            is_cat = (dt & K_CATEGORICAL_MASK) > 0
+            mt = (dt.astype(np.int32) >> 2) & 3
+            dl = (dt & K_DEFAULT_LEFT_MASK) > 0
+            is_missing = ((mt == 1) & (fv == db[f])) | \
+                         ((mt == 2) & (fv == nb[f] - 1))
+            go_left = np.where(is_missing, dl,
+                               fv <= self.threshold_in_bin[nodes])
+            if is_cat.any():
+                idx = np.nonzero(is_cat)[0]
+                for k in idx:
+                    cat_idx = int(self.threshold_in_bin[nodes[k]])
+                    words = self.cat_threshold_inner[cat_idx]
+                    go_left[k] = bool(_bitset_contains(
+                        words, np.asarray([fv[k]]))[0])
+            nxt = np.where(go_left, self.left_child[nodes],
+                           self.right_child[nodes])
+            became_leaf = nxt < 0
+            act_idx = np.nonzero(active)[0]
+            leaf[act_idx[became_leaf]] = ~nxt[became_leaf]
+            cur[act_idx] = nxt
+            active[act_idx[became_leaf]] = False
+        return leaf
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        if self.num_leaves <= 1:
+            return np.full(X.shape[0], self.leaf_value[0])
+        return self.leaf_value[self.apply_raw(X)]
+
+    def predict_binned(self, binned: np.ndarray, feature_infos) -> np.ndarray:
+        if self.num_leaves <= 1:
+            return np.full(binned.shape[0], self.leaf_value[0])
+        return self.leaf_value[self.apply_binned(binned, feature_infos)]
+
+    # -------------------------------------------------------------- mutation
+    def apply_shrinkage(self, rate: float) -> None:
+        """tree.h:149: scale leaf outputs by the learning rate."""
+        self.leaf_value *= rate
+        self.internal_value *= rate
+        self.shrinkage *= rate
+
+    def set_leaf_values(self, values: np.ndarray) -> None:
+        self.leaf_value = np.asarray(values, dtype=np.float64)[: self.num_leaves]
+
+    def as_constant(self, val: float) -> None:
+        """tree.h:170 AsConstantTree."""
+        self.num_leaves = 1
+        self.shrinkage = 1.0
+        self.leaf_value = np.asarray([val], dtype=np.float64)
+
+    @property
+    def max_depth(self) -> int:
+        if self.num_leaves <= 1:
+            return 0
+        return int(self.leaf_depth[: self.num_leaves].max())
+
+    def expected_value(self) -> float:
+        """Weighted mean output (for SHAP base value)."""
+        w = self.leaf_count[: self.num_leaves].astype(np.float64)
+        tot = w.sum()
+        if tot <= 0:
+            return float(self.leaf_value[0])
+        return float((self.leaf_value[: self.num_leaves] * w).sum() / tot)

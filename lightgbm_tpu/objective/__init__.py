@@ -1,0 +1,50 @@
+"""Objective factory.
+
+Reference: ObjectiveFunction::CreateObjectiveFunction
+(src/objective/objective_function.cpp:15-49).
+"""
+
+from __future__ import annotations
+
+from ..utils.log import log_fatal
+from .base import ObjectiveFunction
+from .binary import BinaryLogloss
+from .multiclass import MulticlassOVA, MulticlassSoftmax
+from .rank import LambdarankNDCG
+from .regression import (RegressionFairLoss, RegressionGammaLoss,
+                         RegressionHuberLoss, RegressionL1Loss,
+                         RegressionL2Loss, RegressionMAPELoss,
+                         RegressionPoissonLoss, RegressionQuantileLoss,
+                         RegressionTweedieLoss)
+from .xentropy import CrossEntropy, CrossEntropyLambda
+
+_REGISTRY = {
+    "regression": RegressionL2Loss,
+    "regression_l1": RegressionL1Loss,
+    "huber": RegressionHuberLoss,
+    "fair": RegressionFairLoss,
+    "poisson": RegressionPoissonLoss,
+    "quantile": RegressionQuantileLoss,
+    "mape": RegressionMAPELoss,
+    "gamma": RegressionGammaLoss,
+    "tweedie": RegressionTweedieLoss,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+    "lambdarank": LambdarankNDCG,
+}
+
+
+def create_objective(config) -> ObjectiveFunction:
+    name = str(config.objective).strip().lower()
+    if name in ("none", "null", "custom", "na"):
+        return None
+    if name not in _REGISTRY:
+        log_fatal(f"Unknown objective type name: {name}")
+    return _REGISTRY[name](config)
+
+
+__all__ = ["ObjectiveFunction", "create_objective"] + \
+    [c.__name__ for c in _REGISTRY.values()]

@@ -1,0 +1,112 @@
+"""Objective function interface.
+
+Reference: include/LightGBM/objective_function.h:20-80.  Objectives map the
+current raw score to per-example (gradient, hessian) pairs; some additionally
+provide a boost-from-average initial score (BoostFromScore), an output link
+(ConvertOutput), and leaf-output renewal for percentile-fit losses
+(IsRenewTreeOutput / RenewTreeOutput).
+
+TPU design: ``get_gradients`` is a pure jnp function over device arrays
+(label/weights captured at ``init``), so the GBDT driver can fuse it into the
+per-iteration jit.  Shapes: score/grad/hess are ``[num_tree_per_iter, N]``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ObjectiveFunction:
+    name = "custom"
+    num_tree_per_iteration = 1
+    is_constant_hessian = False
+    is_renew_tree_output = False
+    need_group = False
+
+    def __init__(self, config):
+        self.config = config
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = jnp.asarray(metadata.label, dtype=jnp.float32)
+        self.label_np = np.asarray(metadata.label)
+        self.weights = (jnp.asarray(metadata.weights, dtype=jnp.float32)
+                        if metadata.weights is not None else None)
+        self.weights_np = metadata.weights
+
+    # -- training--
+    def get_gradients(self, score: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        """Initial raw score (gbdt.cpp:420 BoostFromAverage)."""
+        return 0.0
+
+    # -- prediction --
+    def convert_output(self, score: np.ndarray) -> np.ndarray:
+        """Link function applied for human-facing predictions."""
+        return score
+
+    # -- leaf renewal (L1/quantile/MAPE) --
+    def renew_tree_output(self, leaf_values: np.ndarray, leaf_ids: np.ndarray,
+                          score: np.ndarray) -> np.ndarray:
+        """Recompute leaf outputs from residual percentiles.  ``leaf_ids`` is
+        the per-row leaf assignment of the new tree; ``score`` the raw score
+        BEFORE adding this tree.  Returns new leaf values."""
+        return leaf_values
+
+    def _apply_weights(self, grad, hess):
+        if self.weights is not None:
+            return grad * self.weights, hess * self.weights
+        return grad, hess
+
+    def __str__(self):
+        return self.name
+
+
+def percentile(values: np.ndarray, alpha: float) -> float:
+    """Unweighted percentile matching the reference PercentileFun
+    (regression_objective.hpp:19-44): position (1-alpha)*n counted from the
+    TOP of the sorted order, linear interpolation by the fractional part."""
+    n = len(values)
+    if n == 0:
+        return 0.0
+    if n <= 1:
+        return float(values[0])
+    s = np.sort(values)[::-1]  # descending: pos counts from the max
+    float_pos = (1.0 - alpha) * n
+    pos = int(float_pos)
+    if pos < 1:
+        return float(s[0])
+    if pos >= n:
+        return float(s[-1])
+    bias = float_pos - pos
+    v1, v2 = float(s[pos - 1]), float(s[pos])
+    return v1 - (v1 - v2) * bias
+
+
+def weighted_percentile(values: np.ndarray, weights: np.ndarray,
+                        alpha: float) -> float:
+    """Weighted percentile matching WeightedPercentileFun
+    (regression_objective.hpp:46-75)."""
+    n = len(values)
+    if n == 0:
+        return 0.0
+    if n <= 1:
+        return float(values[0])
+    order = np.argsort(values, kind="stable")
+    v = values[order]
+    cdf = np.cumsum(weights[order])
+    threshold = cdf[-1] * alpha
+    pos = int(np.searchsorted(cdf, threshold, side="right"))
+    pos = min(pos, n - 1)
+    if pos == 0 or pos == n - 1:
+        return float(v[pos])
+    v1, v2 = float(v[pos - 1]), float(v[pos])
+    if pos + 1 < n and cdf[pos + 1] - cdf[pos] >= 1.0:
+        return (threshold - cdf[pos]) / (cdf[pos + 1] - cdf[pos]) * (v2 - v1) + v1
+    return v2

@@ -1,0 +1,76 @@
+"""Binary log-loss objective.
+
+Reference: src/objective/binary_objective.hpp:21-180 — labels converted to
+±1, sigmoid-scaled logistic gradients, is_unbalance / scale_pos_weight label
+weighting, boost-from-average in log-odds.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import check, log_info
+from .base import ObjectiveFunction
+
+
+class BinaryLogloss(ObjectiveFunction):
+    name = "binary"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        check(self.sigmoid > 0, "sigmoid parameter must be positive")
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = self.label_np
+        vals = np.unique(lab)
+        if not np.all(np.isin(vals, [0, 1])):
+            raise ValueError("binary objective requires 0/1 labels")
+        cnt_pos = int((lab == 1).sum())
+        cnt_neg = int((lab == 0).sum())
+        if cnt_neg == 0 or cnt_pos == 0:
+            log_info("Contains only one class")
+        # is_unbalance: weight each class by the other's frequency
+        # (binary_objective.hpp:60-80)
+        if self.config.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                self.label_weights = (1.0, cnt_pos / cnt_neg)
+            else:
+                self.label_weights = (cnt_neg / cnt_pos, 1.0)
+        else:
+            self.label_weights = (1.0, float(self.config.scale_pos_weight))
+        self.cnt_pos, self.cnt_neg = cnt_pos, cnt_neg
+        self.sign_label = jnp.asarray(np.where(lab == 1, 1.0, -1.0),
+                                      dtype=jnp.float32)
+        w_pos, w_neg = self.label_weights[1], self.label_weights[0]
+        self.label_weight_arr = jnp.asarray(
+            np.where(lab == 1, w_pos, w_neg), dtype=jnp.float32)
+
+    def get_gradients(self, score):
+        s = self.sigmoid
+        y = self.sign_label
+        response = -y * s / (1.0 + jnp.exp(y * s * score))
+        abs_response = jnp.abs(response)
+        grad = response * self.label_weight_arr
+        hess = abs_response * (s - abs_response) * self.label_weight_arr
+        return self._apply_weights(grad, hess)
+
+    def boost_from_score(self, class_id=0):
+        """log-odds of the (weighted) positive rate / sigmoid
+        (binary_objective.hpp:131-150)."""
+        if self.weights_np is not None:
+            suml = float(np.sum((self.label_np == 1) * self.weights_np))
+            sumw = float(np.sum(self.weights_np))
+        else:
+            suml = float(self.cnt_pos)
+            sumw = float(self.num_data)
+        pavg = min(max(suml / max(sumw, 1e-10), 1e-10), 1.0 - 1e-10)
+        init = np.log(pavg / (1.0 - pavg)) / self.sigmoid
+        log_info(f"[binary:BoostFromScore]: pavg={pavg:.6f} -> "
+                 f"initscore={init:.6f}")
+        return float(init)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * score))

@@ -1,0 +1,103 @@
+"""Multiclass objectives: softmax (k trees per iteration) and one-vs-all.
+
+Reference: src/objective/multiclass_objective.hpp:24-178 (MulticlassSoftmax:
+softmax over per-class scores, grad = p - 1{y=k}, hess = 2 p (1-p);
+boost-from-average uses log of class priors) and :180-260 (MulticlassOVA:
+k independent binary objectives).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ObjectiveFunction
+from .binary import BinaryLogloss
+
+
+class MulticlassSoftmax(ObjectiveFunction):
+    name = "multiclass"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        self.num_tree_per_iteration = self.num_class
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = self.label_np.astype(np.int32)
+        if lab.min() < 0 or lab.max() >= self.num_class:
+            raise ValueError(
+                f"Label must be in [0, {self.num_class}) for multiclass")
+        self.label_int = jnp.asarray(lab)
+        onehot = np.zeros((self.num_class, self.num_data), dtype=np.float32)
+        onehot[lab, np.arange(self.num_data)] = 1.0
+        self.label_onehot = jnp.asarray(onehot)
+        if self.weights_np is not None:
+            probs = np.array([
+                float(np.sum((lab == k) * self.weights_np))
+                for k in range(self.num_class)])
+            probs /= float(np.sum(self.weights_np))
+        else:
+            probs = np.bincount(lab, minlength=self.num_class) / self.num_data
+        self.class_init_probs = probs
+
+    def get_gradients(self, score):
+        """score [C, N] -> grad/hess [C, N]."""
+        p = jnp.exp(score - jnp.max(score, axis=0, keepdims=True))
+        p = p / jnp.sum(p, axis=0, keepdims=True)
+        grad = p - self.label_onehot
+        hess = 2.0 * p * (1.0 - p)
+        if self.weights is not None:
+            grad = grad * self.weights[None, :]
+            hess = hess * self.weights[None, :]
+        return grad, hess
+
+    def boost_from_score(self, class_id=0):
+        return 0.0
+
+    def convert_output(self, score):
+        """Softmax over classes; score [C, N] or [N, C]."""
+        e = np.exp(score - np.max(score, axis=0, keepdims=True))
+        return e / np.sum(e, axis=0, keepdims=True)
+
+
+class MulticlassOVA(ObjectiveFunction):
+    name = "multiclassova"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        self.num_tree_per_iteration = self.num_class
+        self.sigmoid = float(config.sigmoid)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = self.label_np.astype(np.int32)
+        self.binary_objs = []
+        for k in range(self.num_class):
+            sub = BinaryLogloss(self.config)
+            meta_k = _BinaryView(np.where(lab == k, 1.0, 0.0).astype(np.float32),
+                                 self.weights_np)
+            sub.init(meta_k, num_data)
+            self.binary_objs.append(sub)
+
+    def get_gradients(self, score):
+        grads, hesss = [], []
+        for k in range(self.num_class):
+            g, h = self.binary_objs[k].get_gradients(score[k])
+            grads.append(g)
+            hesss.append(h)
+        return jnp.stack(grads), jnp.stack(hesss)
+
+    def boost_from_score(self, class_id=0):
+        return self.binary_objs[class_id].boost_from_score()
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * score))
+
+
+class _BinaryView:
+    def __init__(self, label, weights):
+        self.label = label
+        self.weights = weights

@@ -1,0 +1,88 @@
+"""Cross-entropy objectives for probabilistic labels in [0, 1].
+
+Reference: src/objective/xentropy_objective.hpp:44-146 (CrossEntropy: logistic
+link, optional weights act as exposure) and :148-260 (CrossEntropyLambda:
+log(1+exp) link with weight-aware gradients).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import ObjectiveFunction
+
+
+class CrossEntropy(ObjectiveFunction):
+    name = "cross_entropy"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = self.label_np
+        if lab.min() < 0 or lab.max() > 1:
+            raise ValueError("[cross_entropy]: labels must be in [0, 1]")
+
+    def get_gradients(self, score):
+        z = 1.0 / (1.0 + jnp.exp(-score))
+        if self.weights is None:
+            grad = z - self.label
+            hess = z * (1.0 - z)
+        else:
+            grad = (z - self.label) * self.weights
+            hess = z * (1.0 - z) * self.weights
+        return grad, hess
+
+    def boost_from_score(self, class_id=0):
+        if self.weights_np is not None:
+            p = (np.sum(self.label_np * self.weights_np)
+                 / np.sum(self.weights_np))
+        else:
+            p = float(np.mean(self.label_np))
+        p = min(max(p, 1e-10), 1 - 1e-10)
+        return float(np.log(p / (1.0 - p)))
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-score))
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    name = "cross_entropy_lambda"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lab = self.label_np
+        if lab.min() < 0 or lab.max() > 1:
+            raise ValueError("[cross_entropy_lambda]: labels must be in [0, 1]")
+
+    def get_gradients(self, score):
+        """Weight-aware log(1+exp) link (xentropy_objective.hpp:185-213);
+        without weights, identical to CrossEntropy."""
+        if self.weights is None:
+            z = 1.0 / (1.0 + jnp.exp(-score))
+            return z - self.label, z * (1.0 - z)
+        w = self.weights
+        y = self.label
+        epf = jnp.exp(score)
+        hhat = jnp.log1p(epf)
+        z = 1.0 - jnp.exp(-w * hhat)
+        enf = 1.0 / epf
+        grad = (1.0 - y / z) * w / (1.0 + enf)
+        c = 1.0 / (1.0 - z)
+        d = 1.0 + epf
+        a = w * epf / (d * d)
+        d = c - 1.0
+        b = (c / (d * d)) * (1.0 + w * epf - c)
+        hess = a * (1.0 + y * b)
+        return grad, hess
+
+    def boost_from_score(self, class_id=0):
+        """initscore = log(exp(havg) - 1) (xentropy_objective.hpp:254-257)."""
+        if self.weights_np is not None:
+            havg = (np.sum(self.label_np * self.weights_np)
+                    / np.sum(self.weights_np))
+        else:
+            havg = float(np.mean(self.label_np))
+        return float(np.log(max(np.expm1(havg), 1e-20)))
+
+    def convert_output(self, score):
+        return np.log1p(np.exp(score))

@@ -25,7 +25,7 @@ def _pick_row_chunk(num_data: int, num_features: int, num_bins: int) -> int:
     """Choose a row-chunk size keeping the transient one-hot under ~64MB."""
     budget = 64 * 1024 * 1024 // 4
     chunk = max(256, budget // max(num_features * num_bins, 1))
-    chunk = 1 << (chunk - 1).bit_length() if chunk & (chunk - 1) else chunk
+    chunk = 1 << (chunk.bit_length() - 1)   # round DOWN to a power of two
     return int(min(chunk, max(256, num_data)))
 
 

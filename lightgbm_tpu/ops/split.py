@@ -54,6 +54,7 @@ class SplitParams(NamedTuple):
     cat_l2: float = 10.0
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
 
 
 class SplitInfo(NamedTuple):
@@ -86,10 +87,6 @@ def leaf_output(G, H, l1, l2, max_delta_step):
     if max_delta_step > 0.0:
         out = jnp.clip(out, -max_delta_step, max_delta_step)
     return out
-
-
-def leaf_output_constrained(G, H, l1, l2, max_delta_step, lo, hi):
-    return jnp.clip(leaf_output(G, H, l1, l2, max_delta_step), lo, hi)
 
 
 def leaf_gain_given_output(G, H, l1, l2, out):
@@ -125,10 +122,13 @@ def _numerical_candidates(hist, parent, fmeta: FeatureMeta, p: SplitParams,
     b_idx = jnp.arange(B, dtype=jnp.int32)[None, :]              # [1, B]
     nb = fmeta.num_bin[:, None]
     mt = fmeta.missing_type[:, None]
+    # the reference only applies missing-direction handling when num_bin > 2;
+    # 2-bin features fall back to one plain scan (feature_histogram.hpp:96-110)
+    use_missing = (mt != MISSING_NONE) & (nb > 2)
     nan_bin = jnp.where(mt == MISSING_NAN, nb - 1, -1)
     zero_skip = jnp.where(mt == MISSING_ZERO, fmeta.default_bin[:, None], -1)
     in_range = b_idx < nb
-    excluded = (b_idx == nan_bin) | (b_idx == zero_skip)
+    excluded = ((b_idx == nan_bin) | (b_idx == zero_skip)) & use_missing
     eff = hist * (in_range & ~excluded)[:, :, None].astype(hist.dtype)
     cum = jnp.cumsum(eff, axis=1)                                 # [F, B, 3]
     total_eff = cum[:, -1:, :]
@@ -154,14 +154,17 @@ def _numerical_candidates(hist, parent, fmeta: FeatureMeta, p: SplitParams,
     t_idx = jnp.arange(B - 1, dtype=jnp.int32)[None, :, None]     # [1, T, 1]
     nb3 = nb[:, :, None]
     mt3 = mt[:, :, None]
+    um3 = use_missing[:, :, None]
     dir_idx = jnp.arange(2, dtype=jnp.int32)[None, None, :]
     valid = t_idx < nb3 - 1
     # NaN bin cannot be a left-inclusive threshold when NaN defaults left
-    valid &= ~((mt3 == MISSING_NAN) & (dir_idx == 0) & (t_idx >= nb3 - 2))
+    valid &= ~(um3 & (mt3 == MISSING_NAN) & (dir_idx == 0)
+               & (t_idx >= nb3 - 2))
     # zero-type: the skipped zero bin is not a candidate threshold
-    valid &= ~((mt3 == MISSING_ZERO) & (t_idx == zero_skip[:, :, None]))
+    valid &= ~(um3 & (mt3 == MISSING_ZERO)
+               & (t_idx == zero_skip[:, :, None]))
     # second direction only scanned for missing-capable features with >2 bins
-    valid &= ~((dir_idx == 1) & ((mt3 == MISSING_NONE) | (nb3 <= 2)))
+    valid &= ~((dir_idx == 1) & ~um3)
     valid &= ~fmeta.is_cat[:, None, None]
     valid &= (Cl >= p.min_data_in_leaf) & (Cr >= p.min_data_in_leaf)
     valid &= (Hl >= p.min_sum_hessian_in_leaf) & (Hr >= p.min_sum_hessian_in_leaf)
@@ -170,20 +173,31 @@ def _numerical_candidates(hist, parent, fmeta: FeatureMeta, p: SplitParams,
     return gain, left
 
 
+def _cat_used_bin_mask(hist, fmeta: FeatureMeta):
+    """Bins a categorical scan may use: in range, and excluding the trailing
+    NaN bin unless the feature is fully categorical
+    (used_bin = num_bin - 1 + is_full_categorical,
+    feature_histogram.hpp:130-131)."""
+    B = hist.shape[1]
+    b_idx = jnp.arange(B, dtype=jnp.int32)[None, :]
+    nb = fmeta.num_bin[:, None]
+    used = jnp.where(fmeta.missing_type[:, None] == MISSING_NAN, nb - 1, nb)
+    return b_idx < used
+
+
 def _categorical_onehot_candidates(hist, parent, fmeta: FeatureMeta,
                                    p: SplitParams, lo, hi):
     """One-hot categorical candidates: bin b alone goes left
-    (FindBestThresholdCategorical one-hot branch, feature_histogram.hpp:118+)."""
+    (FindBestThresholdCategorical one-hot branch, feature_histogram.hpp:139-170;
+    note the one-hot branch uses plain lambda_l2, not cat_l2)."""
     F, B, _ = hist.shape
     left = hist                                                   # [F, B, 3]
     right = parent[None, None, :] - left
     Gl, Hl, Cl = left[..., 0], left[..., 1] + K_EPSILON, left[..., 2]
     Gr, Hr, Cr = right[..., 0], right[..., 1] + K_EPSILON, right[..., 2]
-    mono = fmeta.monotone[:, None]
-    gain = _split_gain(Gl, Hl, Gr, Hr, p, mono, lo, hi, extra_l2=p.cat_l2)
+    gain = _split_gain(Gr, Hr, Gl, Hl, p, 0, lo, hi)
 
-    b_idx = jnp.arange(B, dtype=jnp.int32)[None, :]
-    valid = fmeta.is_cat[:, None] & (b_idx < fmeta.num_bin[:, None])
+    valid = fmeta.is_cat[:, None] & _cat_used_bin_mask(hist, fmeta)
     valid &= (Cl >= p.min_data_in_leaf) & (Cr >= p.min_data_in_leaf)
     valid &= (Hl >= p.min_sum_hessian_in_leaf) & (Hr >= p.min_sum_hessian_in_leaf)
     gain = jnp.where(valid, gain, NEG_INF)
@@ -203,15 +217,17 @@ def _categorical_sorted_candidates(hist, parent, fmeta: FeatureMeta,
     """
     F, B, _ = hist.shape
     b_idx = jnp.arange(B, dtype=jnp.int32)[None, :]
-    in_range = b_idx < fmeta.num_bin[:, None]
+    in_range = _cat_used_bin_mask(hist, fmeta)
     cnt = hist[..., 2]
-    # bins with no data are pushed to the end of the order and contribute 0
+    # only bins with cnt >= cat_smooth enter the order
+    # (feature_histogram.hpp:172-175); excluded bins sort to the end with 0
+    # contribution
+    usable = in_range & (cnt >= p.cat_smooth)
     ratio = hist[..., 0] / (hist[..., 1] + p.cat_smooth)
-    ratio = jnp.where(in_range & (cnt > 0), ratio, jnp.inf)
+    ratio = jnp.where(usable, ratio, jnp.inf)
     order = jnp.argsort(ratio, axis=1).astype(jnp.int32)          # [F, B]
     sorted_hist = jnp.take_along_axis(hist, order[:, :, None], axis=1)
-    sorted_valid = jnp.take_along_axis(
-        (in_range & (cnt > 0)), order, axis=1)
+    sorted_valid = jnp.take_along_axis(usable, order, axis=1)
     sorted_hist = sorted_hist * sorted_valid[:, :, None]
 
     pre = jnp.cumsum(sorted_hist, axis=1)                         # prefix sums
@@ -222,18 +238,28 @@ def _categorical_sorted_candidates(hist, parent, fmeta: FeatureMeta,
 
     Gl, Hl, Cl = left[..., 0], left[..., 1] + K_EPSILON, left[..., 2]
     Gr, Hr, Cr = right[..., 0], right[..., 1] + K_EPSILON, right[..., 2]
-    mono = fmeta.monotone[:, None, None]
-    gain = _split_gain(Gl, Hl, Gr, Hr, p, mono, lo, hi, extra_l2=p.cat_l2)
+    # categorical splits ignore monotone constraints (GetSplitGains called
+    # with monotone_type=0, feature_histogram.hpp:226)
+    gain = _split_gain(Gl, Hl, Gr, Hr, p, 0, lo, hi, extra_l2=p.cat_l2)
 
     num_valid = sorted_valid.sum(axis=1).astype(jnp.int32)[:, None, None]
     k_idx = b_idx[:, :, None]
     left_size = jnp.where(jnp.arange(2)[None, None, :] == 0,
                           k_idx + 1, num_valid - k_idx)
     valid = fmeta.is_cat[:, None, None] & sorted_valid[:, :, None]
-    # a strict non-empty subset, at most max_cat_threshold categories left
+    # a strict non-empty subset; the moved set is capped at
+    # min(max_cat_threshold, (used_bin+1)/2) categories
+    # (feature_histogram.hpp:192: max_num_cat)
+    max_num_cat = jnp.minimum(int(p.max_cat_threshold), (num_valid + 1) // 2)
     valid &= (left_size >= 1) & (left_size < num_valid)
-    valid &= left_size <= int(p.max_cat_threshold)
+    valid &= left_size <= max_num_cat
     valid &= (Cl >= p.min_data_in_leaf) & (Cr >= p.min_data_in_leaf)
+    # the right (unmoved) side must keep at least min_data_per_group rows
+    # (feature_histogram.hpp:216); the reference's cnt_cur_group run-length
+    # gate thins candidates WITHIN the scan — omitted here (vectorized scan
+    # evaluates each prefix independently), which can only consider more
+    # candidates, never fewer.
+    valid &= Cr >= float(p.min_data_per_group)
     valid &= (Hl >= p.min_sum_hessian_in_leaf) & (Hr >= p.min_sum_hessian_in_leaf)
     gain = jnp.where(valid, gain, NEG_INF)
     return gain, left, order
@@ -329,7 +355,8 @@ def best_split(hist: jax.Array, parent_g, parent_h, parent_c,
     order_f = so_order[best_f]
     pos = jnp.arange(B, dtype=jnp.int32)
     cnt_row = hist[best_f, :, 2]
-    valid_bins = (b_idx < nb_f) & (cnt_row > 0)
+    used_mask_f = _cat_used_bin_mask(hist, fmeta)[best_f]
+    valid_bins = used_mask_f & (cnt_row >= p.cat_smooth)
     nvalid = valid_bins.sum().astype(jnp.int32)
     sel_sorted = jnp.where(s_dir == 0, pos <= s_k, (pos >= s_k) & (pos < nvalid))
     sorted_mask = jnp.zeros(B, dtype=bool).at[order_f].set(sel_sorted)
@@ -338,14 +365,15 @@ def best_split(hist: jax.Array, parent_g, parent_h, parent_c,
 
     Gl, Hl, Cl = left_stats[0], left_stats[1], left_stats[2]
     Gr, Hr, Cr = parent[0] - Gl, parent[1] - Hl, parent[2] - Cl
-    extra_l2 = jnp.where(is_cat, p.cat_l2, 0.0)
-    out_l = jnp.clip(-threshold_l1(Gl, p.lambda_l1)
-                     / (Hl + p.lambda_l2 + extra_l2 + K_EPSILON), lo, hi)
-    out_r = jnp.clip(-threshold_l1(Gr, p.lambda_l1)
-                     / (Hr + p.lambda_l2 + extra_l2 + K_EPSILON), lo, hi)
-    if p.max_delta_step > 0.0:
-        out_l = jnp.clip(out_l, -p.max_delta_step, p.max_delta_step)
-        out_r = jnp.clip(out_r, -p.max_delta_step, p.max_delta_step)
+    # cat_l2 applies only to the sorted-subset branch (fam 2); same clip
+    # order as candidate scoring: max_delta_step inside, then constraints
+    extra_l2 = jnp.where(fam_f == 2, p.cat_l2, 0.0)
+    out_l = jnp.clip(leaf_output(Gl, Hl, p.lambda_l1,
+                                 p.lambda_l2 + extra_l2, p.max_delta_step),
+                     lo, hi)
+    out_r = jnp.clip(leaf_output(Gr, Hr, p.lambda_l1,
+                                 p.lambda_l2 + extra_l2, p.max_delta_step),
+                     lo, hi)
 
     return SplitInfo(
         gain=jnp.where(has_split, best_gain, NEG_INF),

@@ -1,0 +1,3 @@
+from . import network
+
+__all__ = ["network"]

@@ -1,0 +1,91 @@
+"""Distributed "network" state: the TPU-native stand-in for the reference's
+static Network class (include/LightGBM/network.h:102-297, src/network/).
+
+The reference builds a TCP/MPI mesh from a machine list and hand-rolls
+Bruck/recursive-halving/ring collectives (network.cpp:115-434).  On TPU the
+runtime owns transport and algorithm selection: collectives are XLA ops over
+a `jax.sharding.Mesh` spanning ICI (and DCN for multi-host).  This module
+keeps the reference's API seam — init/rank/num_machines/dispose — and holds
+the process-wide mesh used by the parallel tree learners.
+
+Multi-host: run one process per host under `jax.distributed.initialize`;
+`jax.devices()` then spans all hosts and the same mesh covers DCN, which is
+the TPU equivalent of the reference's machine list + socket handshake
+(linkers_socket.cpp:23-230).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..utils.log import log_info, log_warning
+
+_mesh: Optional["jax.sharding.Mesh"] = None
+_injected: Optional[dict] = None
+
+MACHINES_AXIS = "machines"
+
+
+def init(num_machines: int = 0) -> "jax.sharding.Mesh":
+    """Build (or rebuild) the 1-D device mesh over the `machines` axis."""
+    global _mesh
+    devices = jax.devices()
+    if num_machines <= 0:
+        num_machines = len(devices)
+    if num_machines > len(devices):
+        log_warning(f"num_machines={num_machines} > available devices "
+                    f"({len(devices)}); clamping")
+        num_machines = len(devices)
+    _mesh = jax.sharding.Mesh(np.asarray(devices[:num_machines]),
+                              (MACHINES_AXIS,))
+    log_info(f"Initialized TPU collective mesh with {num_machines} devices")
+    return _mesh
+
+
+def init_from_machines(machines: str, num_machines: int = 1) -> None:
+    """Reference-API shim: LGBM_NetworkInit(machines, port, ...) — the
+    machine list is advisory on TPU (the runtime already knows the slice)."""
+    init(num_machines)
+
+
+def init_with_functions(reduce_scatter_fn: Callable, allgather_fn: Callable,
+                        rank: int, num_machines: int) -> None:
+    """External-collective injection seam (network.h:123,
+    LGBM_NetworkInitWithFunctions c_api.cpp:1572) — used by tests to fake
+    multi-machine runs in one process."""
+    global _injected
+    _injected = {"reduce_scatter": reduce_scatter_fn,
+                 "allgather": allgather_fn,
+                 "rank": rank, "num_machines": num_machines}
+
+
+def injected() -> Optional[dict]:
+    return _injected
+
+
+def mesh() -> "jax.sharding.Mesh":
+    global _mesh
+    if _mesh is None:
+        init()
+    return _mesh
+
+
+def num_machines() -> int:
+    if _injected is not None:
+        return _injected["num_machines"]
+    return mesh().devices.size
+
+
+def rank() -> int:
+    if _injected is not None:
+        return _injected["rank"]
+    return jax.process_index()
+
+
+def dispose() -> None:
+    global _mesh, _injected
+    _mesh = None
+    _injected = None

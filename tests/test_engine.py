@@ -1,0 +1,293 @@
+"""End-to-end training tests.
+
+Mirrors the reference's tests/python_package_test/test_engine.py strategy:
+small synthetic data, few iterations, assert metric thresholds and
+evals_result bookkeeping.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_binary(rng, n=2000, f=10):
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 2 + X[:, 1] - X[:, 2] * 0.5
+    y = (logit + rng.normal(size=n) * 0.5 > 0).astype(np.float64)
+    return X, y
+
+
+def make_regression(rng, n=2000, f=10):
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 3 + np.abs(X[:, 1]) + rng.normal(size=n) * 0.1
+    return X, y
+
+
+def log_loss(y, p):
+    p = np.clip(p, 1e-15, 1 - 1e-15)
+    return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+
+
+def test_binary(rng):
+    X, y = make_binary(rng)
+    Xt, yt = make_binary(rng, n=500)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 15}
+    ds = lgb.Dataset(X, y)
+    vs = ds.create_valid(Xt, yt)
+    evals_result = {}
+    bst = lgb.train(params, ds, num_boost_round=50, valid_sets=[vs],
+                    verbose_eval=False, evals_result=evals_result)
+    pred = bst.predict(Xt)
+    ll = log_loss(yt, pred)
+    assert ll < 0.25
+    assert "valid_0" in evals_result
+    assert evals_result["valid_0"]["binary_logloss"][-1] == \
+        pytest.approx(ll, rel=1e-3)
+    # logloss decreasing overall
+    curve = evals_result["valid_0"]["binary_logloss"]
+    assert curve[-1] < curve[0]
+
+
+def test_regression(rng):
+    X, y = make_regression(rng)
+    Xt, yt = make_regression(rng, n=500)
+    params = {"objective": "regression", "metric": "l2", "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    vs = ds.create_valid(Xt, yt)
+    evals_result = {}
+    bst = lgb.train(params, ds, num_boost_round=50, valid_sets=[vs],
+                    verbose_eval=False, evals_result=evals_result)
+    mse = float(np.mean((bst.predict(Xt) - yt) ** 2))
+    assert mse < 0.8
+    assert evals_result["valid_0"]["l2"][-1] == pytest.approx(mse, rel=1e-3)
+
+
+def test_regression_l1_and_huber(rng):
+    X, y = make_regression(rng, n=1500)
+    for obj in ["regression_l1", "huber", "fair", "quantile", "mape"]:
+        params = {"objective": obj, "verbose": -1, "num_leaves": 15}
+        ds = lgb.Dataset(X, y)
+        bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+        pred = bst.predict(X)
+        mae = float(np.mean(np.abs(pred - y)))
+        base = float(np.mean(np.abs(np.median(y) - y)))
+        assert mae < base * 0.6, (obj, mae, base)
+
+
+def test_poisson_gamma_tweedie(rng):
+    X = rng.normal(size=(1500, 5))
+    mu = np.exp(0.5 * X[:, 0] + 0.2 * X[:, 1])
+    y = rng.poisson(mu).astype(np.float64)
+    for obj in ["poisson", "tweedie"]:
+        ds = lgb.Dataset(X, y)
+        bst = lgb.train({"objective": obj, "verbose": -1}, ds,
+                        num_boost_round=40, verbose_eval=False)
+        pred = bst.predict(X)
+        assert (pred >= 0).all()
+        corr = np.corrcoef(pred, mu)[0, 1]
+        assert corr > 0.8, (obj, corr)
+    yg = mu + 0.1
+    ds = lgb.Dataset(X, yg)
+    bst = lgb.train({"objective": "gamma", "verbose": -1}, ds,
+                    num_boost_round=40, verbose_eval=False)
+    assert np.corrcoef(bst.predict(X), mu)[0, 1] > 0.8
+
+
+def test_multiclass(rng):
+    n, f, C = 3000, 8, 4
+    X = rng.normal(size=(n, f))
+    centers = rng.normal(size=(C, f)) * 2
+    logits = X @ centers.T
+    y = np.argmax(logits + rng.normal(size=(n, C)) * 0.5, axis=1)
+    params = {"objective": "multiclass", "num_class": C,
+              "metric": "multi_logloss", "verbose": -1, "num_leaves": 15}
+    ds = lgb.Dataset(X, y.astype(np.float64))
+    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    pred = bst.predict(X)
+    assert pred.shape == (n, C)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-4)
+    acc = float(np.mean(np.argmax(pred, axis=1) == y))
+    assert acc > 0.85
+
+
+def test_multiclassova(rng):
+    n, f, C = 2000, 6, 3
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+    params = {"objective": "multiclassova", "num_class": C, "verbose": -1}
+    ds = lgb.Dataset(X, y.astype(np.float64))
+    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    pred = bst.predict(X)
+    acc = float(np.mean(np.argmax(pred, axis=1) == y))
+    assert acc > 0.8
+
+
+def test_early_stopping(rng):
+    X, y = make_binary(rng)
+    Xt, yt = make_binary(rng, n=500)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "learning_rate": 0.3, "num_leaves": 63}
+    ds = lgb.Dataset(X, y)
+    vs = ds.create_valid(Xt, yt)
+    bst = lgb.train(params, ds, num_boost_round=300, valid_sets=[vs],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert bst.best_iteration > 0
+    assert bst.best_iteration < 300
+    assert bst.gbdt.current_iteration() < 300
+
+
+def test_continued_training(rng):
+    X, y = make_regression(rng)
+    params = {"objective": "regression", "verbose": -1}
+    ds = lgb.Dataset(X, y)
+    bst1 = lgb.train(params, ds, num_boost_round=10, verbose_eval=False)
+    mse1 = float(np.mean((bst1.predict(X) - y) ** 2))
+    ds2 = lgb.Dataset(X, y)
+    bst2 = lgb.train(params, ds2, num_boost_round=10, verbose_eval=False,
+                     init_model=bst1)
+    assert bst2.num_trees() == 20
+    mse2 = float(np.mean((bst2.predict(X) - y) ** 2))
+    assert mse2 < mse1
+
+
+def test_save_load_roundtrip(tmp_path, rng):
+    X, y = make_binary(rng)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15}
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train(params, ds, num_boost_round=20, verbose_eval=False)
+    pred = bst.predict(X)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    pred2 = bst2.predict(X)
+    np.testing.assert_allclose(pred, pred2, rtol=1e-5, atol=1e-7)
+    # model string roundtrip
+    s = bst.model_to_string()
+    bst3 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(pred, bst3.predict(X), rtol=1e-5, atol=1e-7)
+
+
+def test_model_dump_json(rng):
+    X, y = make_regression(rng, n=800)
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train({"objective": "regression", "verbose": -1}, ds,
+                    num_boost_round=5, verbose_eval=False)
+    d = bst.dump_model()
+    assert d["num_tree_per_iteration"] == 1
+    assert len(d["tree_info"]) == 5
+    assert "tree_structure" in d["tree_info"][0]
+    node = d["tree_info"][0]["tree_structure"]
+    assert "split_feature" in node
+
+
+def test_cv(rng):
+    X, y = make_binary(rng, n=1500)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 15}
+    res = lgb.cv(params, lgb.Dataset(X, y), num_boost_round=10, nfold=3,
+                 stratified=True, shuffle=True, seed=7)
+    key = "valid binary_logloss-mean"
+    assert key in res
+    assert len(res[key]) == 10
+    assert res[key][-1] < res[key][0]
+
+
+def test_feature_importance(rng):
+    X, y = make_regression(rng)
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train({"objective": "regression", "verbose": -1}, ds,
+                    num_boost_round=20, verbose_eval=False)
+    imp = bst.feature_importance("split")
+    assert imp.shape == (X.shape[1],)
+    # features 0 and 1 carry all the signal
+    assert imp[0] + imp[1] > imp[2:].sum()
+    gains = bst.feature_importance("gain")
+    assert gains[0] > 0
+
+
+def test_custom_objective_fobj(rng):
+    X, y = make_regression(rng)
+    ds = lgb.Dataset(X, y)
+
+    def l2_obj(preds, dataset):
+        labels = dataset.get_label()
+        return preds - labels, np.ones_like(preds)
+
+    bst = lgb.train({"verbose": -1, "metric": "l2"}, ds, num_boost_round=20,
+                    fobj=l2_obj, verbose_eval=False)
+    # raw predictions (no objective transform)
+    pred = bst.predict(X, raw_score=True)
+    assert float(np.mean((pred - y) ** 2)) < 1.5
+
+
+def test_weights_affect_training(rng):
+    X, y = make_regression(rng, n=1000)
+    w = np.where(X[:, 0] > 0, 10.0, 0.1)
+    ds = lgb.Dataset(X, y, weight=w)
+    bst = lgb.train({"objective": "regression", "verbose": -1}, ds,
+                    num_boost_round=20, verbose_eval=False)
+    pred = bst.predict(X)
+    err_hi = np.mean((pred - y)[X[:, 0] > 0] ** 2)
+    err_lo = np.mean((pred - y)[X[:, 0] <= 0] ** 2)
+    assert err_hi < err_lo
+
+
+def test_lambdarank(rng):
+    # 60 queries x 20 docs with a learnable relevance signal
+    nq, per = 60, 20
+    n = nq * per
+    X = rng.normal(size=(n, 5))
+    rel = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(size=n) * 0.3)
+    y = np.digitize(rel, np.quantile(rel, [0.5, 0.75, 0.9])).astype(np.float64)
+    group = np.full(nq, per)
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "eval_at": [3, 5], "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, y, group=group)
+    vs = lgb.Dataset(X, y, group=group, reference=ds)
+    evals_result = {}
+    bst = lgb.train(params, ds, num_boost_round=30, valid_sets=[vs],
+                    verbose_eval=False, evals_result=evals_result)
+    ndcg3 = evals_result["valid_0"]["ndcg@3"]
+    assert ndcg3[-1] > 0.85
+    assert ndcg3[-1] > ndcg3[0]
+
+
+def test_missing_values(rng):
+    X, y = make_regression(rng, n=1500)
+    X[rng.uniform(size=X.shape) < 0.2] = np.nan
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train({"objective": "regression", "verbose": -1}, ds,
+                    num_boost_round=30, verbose_eval=False)
+    pred = bst.predict(X)
+    assert np.isfinite(pred).all()
+    assert float(np.mean((pred - y) ** 2)) < 0.5 * y.var()
+
+
+def test_categorical_features(rng):
+    n = 2000
+    cat = rng.randint(0, 6, size=n)
+    Xnum = rng.normal(size=(n, 3))
+    effects = np.array([0.0, 2.0, -1.0, 4.0, 0.5, -3.0])
+    y = effects[cat] + Xnum[:, 0] + rng.normal(size=n) * 0.1
+    X = np.column_stack([cat.astype(np.float64), Xnum])
+    ds = lgb.Dataset(X, y, categorical_feature=[0])
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 5}, ds,
+                    num_boost_round=40, verbose_eval=False)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.1 * y.var()
+
+
+def test_predict_leaf_index(rng):
+    X, y = make_regression(rng, n=500)
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "num_leaves": 7}, ds, num_boost_round=5,
+                    verbose_eval=False)
+    leaves = bst.predict(X, pred_leaf=True)
+    assert leaves.shape == (500, 5)
+    assert leaves.max() < 7
+    assert leaves.min() >= 0
