@@ -1,0 +1,127 @@
+"""DART boosting: per-iteration random tree dropout with re-normalization.
+
+Reference: src/boosting/dart.hpp:23-211 — DroppingTrees (uniform or
+tree-weighted selection capped by max_drop, skip_drop chance), shrinkage
+lr/(1+k) (or lr/(lr+k) in xgboost_dart_mode), and Normalize's three-step
+shrinkage dance whose NET effect per dropped tree with k drops is:
+
+  * train/valid score -= 1/(k+1) x tree's current prediction
+  * stored leaf values scale by k/(k+1)
+
+(xgboost mode: lr/(k+lr) and k/(k+lr) respectively).  This implementation
+applies the net effect directly instead of replaying the sign-flip steps.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    def __init__(self, config, train_set, objective=None):
+        super().__init__(config, train_set, objective)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+
+    def _select_drop(self) -> List[int]:
+        cfg = self.config
+        if self._drop_rng.rand() < cfg.skip_drop:
+            return []
+        drop: List[int] = []
+        if not cfg.uniform_drop and self.sum_weight > 0:
+            inv_avg = len(self.tree_weight) / self.sum_weight
+            rate = cfg.drop_rate
+            if cfg.max_drop > 0:
+                rate = min(rate, cfg.max_drop * inv_avg / self.sum_weight)
+            for i in range(self.iter_):
+                if self._drop_rng.rand() < rate * self.tree_weight[i] * inv_avg:
+                    drop.append(i)
+                    if len(drop) >= cfg.max_drop > 0:
+                        break
+        else:
+            rate = cfg.drop_rate
+            if cfg.max_drop > 0 and self.iter_ > 0:
+                rate = min(rate, cfg.max_drop / self.iter_)
+            for i in range(self.iter_):
+                if self._drop_rng.rand() < rate:
+                    drop.append(i)
+                    if len(drop) >= cfg.max_drop > 0:
+                        break
+        return drop
+
+    def _tree_predictions(self, it: int):
+        """Current train/valid predictions of iteration ``it``'s trees."""
+        C = self.num_tree_per_iteration
+        infos = self.train_set.feature_infos()
+        train_preds, valid_preds = [], []
+        for k in range(C):
+            tree = self.models[it * C + k]
+            train_preds.append(tree.predict_binned(self.train_set.binned,
+                                                   infos))
+            valid_preds.append([tree.predict_binned(vset.binned, infos)
+                                for (_, vset) in self.valid_sets])
+        return train_preds, valid_preds
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        cfg = self.config
+        self._boost_from_average()
+        C = self.num_tree_per_iteration
+        drop = self._select_drop()
+        k = float(len(drop))
+
+        # drop: remove the dropped trees' full contribution before gradients
+        dropped_preds = []
+        for it in drop:
+            tp, vp = self._tree_predictions(it)
+            dropped_preds.append((it, tp, vp))
+            for ki in range(C):
+                self.train_score = self.train_score.at[ki].add(
+                    -jnp.asarray(tp[ki], dtype=jnp.float32))
+                for vi, vscore in enumerate(self.valid_scores):
+                    vscore[ki] -= vp[ki][vi]
+
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k)
+            scale = k / (k + 1.0)
+            sub = 1.0 / (k + 1.0)
+        else:
+            self.shrinkage_rate = (cfg.learning_rate if not drop else
+                                   cfg.learning_rate / (cfg.learning_rate + k))
+            scale = k / (k + cfg.learning_rate)
+            sub = cfg.learning_rate / (k + cfg.learning_rate)
+
+        ret = super().train_one_iter(grad, hess)
+        if ret:
+            # training stopped: restore the dropped trees' contribution
+            for it, tp, vp in dropped_preds:
+                for ki in range(C):
+                    self.train_score = self.train_score.at[ki].add(
+                        jnp.asarray(tp[ki], dtype=jnp.float32))
+                    for vi, vscore in enumerate(self.valid_scores):
+                        vscore[ki] += vp[ki][vi]
+            return ret
+
+        # normalize: add back scale x prediction, shrink stored trees
+        for it, tp, vp in dropped_preds:
+            for ki in range(C):
+                tree = self.models[it * C + ki]
+                tree.apply_shrinkage(scale)
+                self.train_score = self.train_score.at[ki].add(
+                    jnp.asarray(np.asarray(tp[ki]) * scale,
+                                dtype=jnp.float32))
+                for vi, vscore in enumerate(self.valid_scores):
+                    vscore[ki] += vp[ki][vi] * scale
+            if not cfg.uniform_drop:
+                self.sum_weight -= self.tree_weight[it] * sub
+                self.tree_weight[it] *= scale
+
+        if not cfg.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False

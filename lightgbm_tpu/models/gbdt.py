@@ -135,16 +135,18 @@ class GBDT:
         self.valid_scores.append(score)
 
     # --------------------------------------------------------------- bagging
-    def _bagging(self, iter_idx: int) -> None:
+    def _bagging(self, iter_idx: int, grads, hesss):
+        """Compute the per-iteration row-inclusion mask; may also rescale
+        gradients (GOSS overrides).  Returns (grads, hesss)."""
         cfg = self.config
         need = (cfg.bagging_freq > 0 and
                 (cfg.bagging_fraction < 1.0
                  or cfg.pos_bagging_fraction < 1.0
                  or cfg.neg_bagging_fraction < 1.0))
         if not need:
-            return
+            return grads, hesss
         if iter_idx % cfg.bagging_freq != 0:
-            return
+            return grads, hesss
         n = self.num_data
         if (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0):
             # balanced bagging over positive/negative labels (gbdt.cpp:186-240)
@@ -164,6 +166,7 @@ class GBDT:
             mask = np.zeros(n, dtype=np.float32)
             mask[idx] = 1.0
         self.bag_weight = jnp.asarray(mask)
+        return grads, hesss
 
     def _tree_feature_mask(self) -> jnp.ndarray:
         """Per-tree feature_fraction sampling (GetUsedFeatures,
@@ -219,7 +222,7 @@ class GBDT:
                                 .reshape(C, self.num_data))
             hesss = jnp.asarray(np.asarray(hess, dtype=np.float32)
                                 .reshape(C, self.num_data))
-        self._bagging(self.iter_)
+        grads, hesss = self._bagging(self.iter_, grads, hesss)
 
         should_stop = True
         infos = self.train_set.feature_infos()
@@ -316,6 +319,10 @@ class GBDT:
                 leaves[:, i] = self.models[i].apply_raw(X)
             return leaves
         raw = self._raw_predict(X, num_iteration)
+        if getattr(self, "average_output", False):
+            n_iter = self.iter_ if num_iteration <= 0 else min(num_iteration,
+                                                               self.iter_)
+            raw = raw / max(n_iter, 1)
         if raw_score or self.objective is None:
             res = raw
         else:

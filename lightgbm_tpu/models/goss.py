@@ -1,0 +1,50 @@
+"""GOSS: Gradient-based One-Side Sampling.
+
+Reference: src/boosting/goss.hpp:30-220 — keep the top ``top_rate`` fraction
+of rows by sum-over-classes |grad x hess|, sample ``other_rate`` of the rest
+uniformly and amplify their grad AND hess by (cnt - top_k) / other_k; no
+subsampling for the first 1/learning_rate iterations (goss.hpp:142-145).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import check, log_fatal
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    def __init__(self, config, train_set, objective=None):
+        super().__init__(config, train_set, objective)
+        check(config.top_rate + config.other_rate <= 1.0,
+              "top_rate + other_rate cannot be larger than 1.0")
+        check(config.top_rate > 0 and config.other_rate > 0,
+              "top_rate and other_rate must be positive for GOSS")
+
+    def _bagging(self, iter_idx, grads, hesss):
+        cfg = self.config
+        n = self.num_data
+        # warm-up: use all data for the first 1/lr iterations
+        if iter_idx < int(1.0 / cfg.learning_rate):
+            self.bag_weight = jnp.ones(n, dtype=jnp.float32)
+            return grads, hesss
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+
+        score = np.abs(np.asarray(grads) * np.asarray(hesss)).sum(axis=0)
+        top_idx = np.argpartition(-score, top_k - 1)[:top_k]
+        rest = np.setdiff1d(np.arange(n), top_idx, assume_unique=False)
+        sampled = self._bag_rng.choice(rest, min(other_k, len(rest)),
+                                       replace=False)
+        multiply = (n - top_k) / other_k
+
+        mask = np.zeros(n, dtype=np.float32)
+        mask[top_idx] = 1.0
+        mask[sampled] = 1.0
+        amp = np.ones(n, dtype=np.float32)
+        amp[sampled] = multiply
+        amp_d = jnp.asarray(amp)[None, :]
+        self.bag_weight = jnp.asarray(mask)
+        return grads * amp_d, hesss * amp_d

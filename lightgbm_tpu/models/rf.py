@@ -1,0 +1,80 @@
+"""Random Forest mode.
+
+Reference: src/boosting/rf.hpp:25-218 — bagging is mandatory, shrinkage is
+1.0, every tree fits gradients computed ONCE from a constant boost-from-
+average score, each tree absorbs that init score as a bias (AddBias), and
+predictions are the average over iterations (average_output).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import check, log_fatal
+from .gbdt import GBDT
+
+
+class RF(GBDT):
+    average_output = True
+
+    def __init__(self, config, train_set, objective=None):
+        check(config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0,
+              "RF mode requires bagging "
+              "(bagging_freq > 0 and bagging_fraction in (0, 1))")
+        if objective is None:
+            log_fatal("RF mode does not support custom objective functions")
+        super().__init__(config, train_set, objective)
+        self.shrinkage_rate = 1.0
+        self._fixed_grads = None
+
+    def _boost_from_average(self):
+        # RF keeps scores as sums of per-tree predictions; the init score is
+        # baked into each tree (AddBias), never into the score buffer.
+        self._boosted_from_average = True
+
+    def _rf_gradients(self):
+        if self._fixed_grads is None:
+            C = self.num_tree_per_iteration
+            self._rf_init = [self.objective.boost_from_score(k)
+                             for k in range(C)]
+            const = jnp.stack([
+                jnp.full(self.num_data, v, dtype=jnp.float32)
+                for v in self._rf_init])
+            g, h = self.objective.get_gradients(
+                const if C > 1 else const[0])
+            if C == 1:
+                g, h = g[None, :], h[None, :]
+            self._fixed_grads = (g, h)
+        return self._fixed_grads
+
+    def _gradients(self):
+        return self._rf_gradients()
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        if grad is not None or hess is not None:
+            log_fatal("RF mode does not support custom gradients")
+        ret = super().train_one_iter()
+        if ret:
+            return ret
+        # fold the init score into the new trees' leaf values
+        # (rf.hpp:140-146 AddBias) so averaged predictions are calibrated
+        C = self.num_tree_per_iteration
+        infos = self.train_set.feature_infos()
+        for k in range(C):
+            bias = self._rf_init[k]
+            if abs(bias) < 1e-15:
+                continue
+            tree = self.models[(self.iter_ - 1) * C + k]
+            if tree.num_leaves > 1:
+                tree.leaf_value = tree.leaf_value + bias
+                # score buffers must include the bias too
+                self.train_score = self.train_score.at[k].add(bias)
+                for vscore in self.valid_scores:
+                    vscore[k] += bias
+        return False
+
+    # eval uses the AVERAGED score (train_score holds the running sum)
+    def _eval_score(self, score, metrics):
+        denom = max(self.iter_, 1)
+        return super()._eval_score(score / denom, metrics)

@@ -1,0 +1,173 @@
+"""Plotting helpers: importance / metric / tree visualizations.
+
+Reference: python-package/lightgbm/plotting.py — plot_importance (:21),
+plot_metric (:133), plot_tree + create_tree_digraph (:242+, graphviz).
+Matplotlib/graphviz are optional; functions raise ImportError lazily like
+the reference's compat layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .basic import Booster
+from .utils.log import LightGBMError
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name="obj"):
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim=None, ylim=None, title="Feature importance",
+                    xlabel="Feature importance", ylabel="Features",
+                    importance_type="split", max_num_features=None,
+                    ignore_zero=True, figsize=None, dpi=None, grid=True,
+                    precision=3, **kwargs):
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib "
+                          "to plot importance.")
+    if isinstance(booster, Booster):
+        b = booster
+    elif hasattr(booster, "booster_"):
+        b = booster.booster_
+    else:
+        raise TypeError("booster must be Booster or LGBMModel.")
+    importance = b.feature_importance(importance_type)
+    feature_name = b.feature_name()
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    if not tuples:
+        raise ValueError("Cannot plot empty feature importances.")
+    labels, values = zip(*tuples)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y,
+                f"{x:.{precision}f}" if importance_type == "gain" else str(x),
+                va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster, metric=None, dataset_names=None, ax=None,
+                xlim=None, ylim=None, title="Metric during training",
+                xlabel="Iterations", ylabel="auto", figsize=None, dpi=None,
+                grid=True):
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot metric.")
+    if isinstance(booster, dict):
+        eval_results = booster
+    elif hasattr(booster, "evals_result_"):
+        eval_results = booster.evals_result_
+    else:
+        raise TypeError("booster must be dict or LGBMModel.")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    names = dataset_names or list(eval_results.keys())
+    first = eval_results[names[0]]
+    if metric is None:
+        metric = list(first.keys())[0]
+    for name in names:
+        if metric not in eval_results[name]:
+            continue
+        results = eval_results[name][metric]
+        ax.plot(range(len(results)), results, label=name)
+    ax.legend(loc="best")
+    if title:
+        ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(metric if ylabel == "auto" else ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def create_tree_digraph(booster, tree_index=0, show_info=None, precision=3,
+                        name=None, comment=None, **kwargs):
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("You must install graphviz to plot tree.")
+    if hasattr(booster, "booster_"):
+        booster = booster.booster_
+    model = booster.dump_model()
+    tree_infos = model["tree_info"]
+    if tree_index >= len(tree_infos):
+        raise IndexError("tree_index is out of range.")
+    tree_info = tree_infos[tree_index]
+    feature_names = model.get("feature_names")
+    show_info = show_info or []
+
+    graph = Digraph(name=name, comment=comment, **kwargs)
+
+    def add(node, parent=None, decision=None):
+        if "split_index" in node:
+            nid = f"split{node['split_index']}"
+            f = node["split_feature"]
+            fname = (feature_names[f] if feature_names else f"Column_{f}")
+            label = f"{fname} {node['decision_type']} " \
+                f"{node['threshold']}"
+            for info in show_info:
+                if info in node:
+                    label += f"\n{info}: {node[info]}"
+            graph.node(nid, label=label)
+            add(node["left_child"], nid, "yes")
+            add(node["right_child"], nid, "no")
+        else:
+            nid = f"leaf{node.get('leaf_index', 0)}"
+            label = f"leaf {node.get('leaf_index', 0)}: " \
+                f"{round(node['leaf_value'], precision)}"
+            graph.node(nid, label=label)
+        if parent is not None:
+            graph.edge(parent, nid, decision)
+
+    add(tree_info["tree_structure"])
+    return graph
+
+
+def plot_tree(booster, ax=None, tree_index=0, figsize=None, dpi=None,
+              show_info=None, precision=3, **kwargs):
+    try:
+        import matplotlib.pyplot as plt
+        import matplotlib.image as image
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot tree.")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    graph = create_tree_digraph(booster=booster, tree_index=tree_index,
+                                show_info=show_info, precision=precision,
+                                **kwargs)
+    import io
+    s = graph.pipe(format="png")
+    img = image.imread(io.BytesIO(s))
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

@@ -1,0 +1,300 @@
+"""scikit-learn compatible API.
+
+Reference: python-package/lightgbm/sklearn.py:168-879 — LGBMModel base with
+get/set_params, fit with eval sets / early stopping / sample weights, and
+the Classifier/Regressor/Ranker specializations (label encoding, predict /
+predict_proba, query groups).  Works with or without scikit-learn installed
+(duck-typed mixins like the reference's compat shims).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .engine import train as train_fn
+from .utils.log import LightGBMError, log_warning
+
+try:  # pragma: no cover - sklearn is optional
+    from sklearn.base import BaseEstimator as _SKBase
+
+    class _Base(_SKBase):
+        pass
+except Exception:  # pragma: no cover
+    class _Base:
+        def get_params(self, deep=True):
+            params = {}
+            for k, v in self.__dict__.items():
+                if not k.endswith("_") and not k.startswith("_"):
+                    params[k] = v
+            return params
+
+        def set_params(self, **params):
+            for k, v in params.items():
+                setattr(self, k, v)
+            return self
+
+
+class LGBMModel(_Base):
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[str] = None, class_weight=None,
+                 min_split_gain: float = 0.0, min_child_weight: float = 1e-3,
+                 min_child_samples: int = 20, subsample: float = 1.0,
+                 subsample_freq: int = 0, colsample_bytree: float = 1.0,
+                 reg_alpha: float = 0.0, reg_lambda: float = 0.0,
+                 random_state: Optional[int] = None, n_jobs: int = -1,
+                 silent: bool = True, importance_type: str = "split",
+                 **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.silent = silent
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster: Optional[Booster] = None
+        self._n_features = None
+        self._classes = None
+        self._n_classes = None
+        self._evals_result = None
+        self._best_iteration = -1
+        self._objective = objective
+
+    def get_params(self, deep=True):
+        params = super().get_params(deep=deep) if hasattr(
+            super(), "get_params") else {}
+        if not params:
+            params = {k: getattr(self, k) for k in (
+                "boosting_type num_leaves max_depth learning_rate "
+                "n_estimators subsample_for_bin objective class_weight "
+                "min_split_gain min_child_weight min_child_samples subsample "
+                "subsample_freq colsample_bytree reg_alpha reg_lambda "
+                "random_state n_jobs silent importance_type").split()}
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params):
+        for key, value in params.items():
+            setattr(self, key, value)
+            if not hasattr(type(self), key):
+                self._other_params[key] = value
+        return self
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def _train_params(self) -> Dict[str, Any]:
+        p = {
+            "boosting": self.boosting_type,
+            "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "bin_construct_sample_cnt": self.subsample_for_bin,
+            "objective": self._objective or self._default_objective(),
+            "min_gain_to_split": self.min_split_gain,
+            "min_sum_hessian_in_leaf": self.min_child_weight,
+            "min_data_in_leaf": self.min_child_samples,
+            "bagging_fraction": self.subsample,
+            "bagging_freq": self.subsample_freq,
+            "feature_fraction": self.colsample_bytree,
+            "lambda_l1": self.reg_alpha,
+            "lambda_l2": self.reg_lambda,
+            "verbosity": -1 if self.silent else 1,
+        }
+        if self.random_state is not None:
+            p["seed"] = int(self.random_state)
+        p.update(self._other_params)
+        return p
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_class_weight=None, eval_init_score=None, eval_group=None,
+            eval_metric=None, early_stopping_rounds=None, verbose=False,
+            feature_name="auto", categorical_feature="auto",
+            callbacks=None, init_model=None):
+        X = np.asarray(X, dtype=np.float64) if not hasattr(X, "columns") else X
+        self._n_features = (X.shape[1] if hasattr(X, "shape")
+                            else len(X.columns))
+        y_arr = self._process_label(np.asarray(y).ravel())
+        # params resolved AFTER label processing so n_classes is known
+        params = self._train_params()
+        if eval_metric is not None:
+            params["metric"] = eval_metric
+        if sample_weight is not None:
+            sample_weight = np.asarray(sample_weight, dtype=np.float64)
+        sample_weight = self._apply_class_weight(y_arr, sample_weight)
+        train_set = Dataset(X, y_arr, weight=sample_weight, group=group,
+                            init_score=init_score,
+                            feature_name=feature_name,
+                            categorical_feature=categorical_feature)
+        valid_sets: List[Dataset] = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vX, vy) in enumerate(eval_set):
+                if vX is X and vy is y:
+                    valid_sets.append(train_set)
+                    continue
+                vw = (eval_sample_weight[i]
+                      if eval_sample_weight is not None else None)
+                vg = eval_group[i] if eval_group is not None else None
+                vi = (eval_init_score[i]
+                      if eval_init_score is not None else None)
+                valid_sets.append(Dataset(
+                    vX, self._process_label(np.asarray(vy).ravel(),
+                                            fit=False),
+                    reference=train_set, weight=vw, group=vg, init_score=vi))
+        self._evals_result = {}
+        self._Booster = train_fn(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets or None, valid_names=eval_names,
+            early_stopping_rounds=early_stopping_rounds,
+            evals_result=self._evals_result, verbose_eval=verbose,
+            callbacks=callbacks, init_model=init_model)
+        self._best_iteration = self._Booster.best_iteration
+        return self
+
+    def _process_label(self, y, fit=True):
+        return y.astype(np.float64)
+
+    def _apply_class_weight(self, y, sample_weight):
+        return sample_weight
+
+    def predict(self, X, raw_score=False, num_iteration=None,
+                pred_leaf=False, pred_contrib=False, **kwargs):
+        if self._Booster is None:
+            raise LightGBMError("Estimator not fitted, "
+                                "call fit before exploiting the model.")
+        return self._Booster.predict(
+            X, raw_score=raw_score,
+            num_iteration=num_iteration if num_iteration is not None else -1,
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib)
+
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise LightGBMError("No booster found. "
+                                "Need to call fit beforehand.")
+        return self._Booster
+
+    @property
+    def evals_result_(self):
+        return self._evals_result
+
+    @property
+    def best_iteration_(self):
+        return self._best_iteration
+
+    @property
+    def n_features_(self):
+        return self._n_features
+
+    @property
+    def feature_importances_(self):
+        return self.booster_.feature_importance(self.importance_type)
+
+    @property
+    def best_score_(self):
+        return self.booster_.best_score
+
+
+class LGBMRegressor(LGBMModel):
+    def _default_objective(self):
+        return "regression"
+
+
+class LGBMClassifier(LGBMModel):
+    def _default_objective(self):
+        if self._n_classes is not None and self._n_classes > 2:
+            return "multiclass"
+        return "binary"
+
+    def _process_label(self, y, fit=True):
+        if fit:
+            self._classes = np.unique(y)
+            self._n_classes = len(self._classes)
+            if self._n_classes > 2:
+                if self._objective is None:
+                    self._other_params.setdefault("num_class",
+                                                  self._n_classes)
+        self._label_map = {c: i for i, c in enumerate(self._classes)}
+        return np.asarray([self._label_map[v] for v in y], dtype=np.float64)
+
+    def _apply_class_weight(self, y, sample_weight):
+        if self.class_weight is None:
+            return sample_weight
+        if self.class_weight == "balanced":
+            counts = np.bincount(y.astype(int))
+            weights_per_class = len(y) / (len(counts) * np.maximum(counts, 1))
+            cw = weights_per_class[y.astype(int)]
+        else:
+            cw = np.asarray([self.class_weight.get(self._classes[int(v)], 1.0)
+                             for v in y])
+        if sample_weight is None:
+            return cw
+        return sample_weight * cw
+
+    def predict(self, X, raw_score=False, num_iteration=None,
+                pred_leaf=False, pred_contrib=False, **kwargs):
+        result = self.predict_proba(X, raw_score, num_iteration, pred_leaf,
+                                    pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim > 1:
+            idx = np.argmax(result, axis=1)
+        else:
+            idx = (result > 0.5).astype(int)
+        return self._classes[idx]
+
+    def predict_proba(self, X, raw_score=False, num_iteration=None,
+                      pred_leaf=False, pred_contrib=False, **kwargs):
+        result = super().predict(X, raw_score, num_iteration, pred_leaf,
+                                 pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim == 1:
+            return np.vstack([1.0 - result, result]).T
+        return result
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self):
+        return self._n_classes
+
+
+class LGBMRanker(LGBMModel):
+    def _default_objective(self):
+        return "lambdarank"
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            **kwargs):
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        eval_group = kwargs.get("eval_group")
+        if kwargs.get("eval_set") is not None and eval_group is None:
+            raise ValueError("Eval_group cannot be None when eval_set "
+                             "is not None")
+        return super().fit(X, y, sample_weight=sample_weight,
+                           init_score=init_score, group=group, **kwargs)

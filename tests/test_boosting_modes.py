@@ -1,0 +1,159 @@
+"""Boosting-mode tests: bagging, GOSS, DART, RF (+ sklearn API)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_regression(rng, n=2000, f=8):
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 3 + np.abs(X[:, 1]) + rng.normal(size=n) * 0.1
+    return X, y
+
+
+def make_binary(rng, n=2000, f=8):
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] * 2 + X[:, 1] + rng.normal(size=n) * 0.5 > 0).astype(float)
+    return X, y
+
+
+def test_bagging(rng):
+    X, y = make_regression(rng)
+    params = {"objective": "regression", "bagging_fraction": 0.5,
+              "bagging_freq": 1, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=30,
+                    verbose_eval=False)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.2 * y.var()
+
+
+def test_balanced_bagging(rng):
+    X, y = make_binary(rng)
+    params = {"objective": "binary", "pos_bagging_fraction": 0.5,
+              "neg_bagging_fraction": 0.9, "bagging_freq": 1, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=20,
+                    verbose_eval=False)
+    p = bst.predict(X)
+    acc = np.mean((p > 0.5) == y)
+    assert acc > 0.85
+
+
+def test_goss(rng):
+    X, y = make_regression(rng, n=3000)
+    params = {"objective": "regression", "boosting": "goss",
+              "top_rate": 0.2, "other_rate": 0.1, "learning_rate": 0.2,
+              "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=40,
+                    verbose_eval=False)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.1 * y.var()
+
+
+def test_dart(rng):
+    X, y = make_regression(rng)
+    params = {"objective": "regression", "boosting": "dart",
+              "drop_rate": 0.3, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=40,
+                    verbose_eval=False)
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < 0.3 * y.var()
+
+
+def test_dart_xgboost_mode(rng):
+    X, y = make_regression(rng, n=1000)
+    params = {"objective": "regression", "boosting": "dart",
+              "xgboost_dart_mode": True, "drop_rate": 0.2, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=20,
+                    verbose_eval=False)
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_rf(rng):
+    X, y = make_binary(rng, n=3000)
+    params = {"objective": "binary", "boosting": "rf",
+              "bagging_fraction": 0.6, "bagging_freq": 1,
+              "feature_fraction": 0.8, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=30,
+                    verbose_eval=False)
+    p = bst.predict(X)
+    assert (p >= 0).all() and (p <= 1).all()
+    acc = np.mean((p > 0.5) == y)
+    assert acc > 0.85
+
+
+def test_rf_requires_bagging(rng):
+    X, y = make_binary(rng, n=500)
+    with pytest.raises(Exception):
+        lgb.train({"objective": "binary", "boosting": "rf", "verbose": -1},
+                  lgb.Dataset(X, y), num_boost_round=3, verbose_eval=False)
+
+
+# ------------------------------------------------------------------ sklearn
+def test_sklearn_regressor(rng):
+    X, y = make_regression(rng)
+    model = lgb.LGBMRegressor(n_estimators=30, num_leaves=15)
+    model.fit(X, y)
+    pred = model.predict(X)
+    assert float(np.mean((pred - y) ** 2)) < 0.2 * y.var()
+    assert model.feature_importances_.shape == (X.shape[1],)
+    assert model.n_features_ == X.shape[1]
+
+
+def test_sklearn_classifier_binary(rng):
+    X, y = make_binary(rng)
+    ylab = np.where(y > 0, "pos", "neg")
+    model = lgb.LGBMClassifier(n_estimators=30, num_leaves=15)
+    model.fit(X, ylab)
+    pred = model.predict(X)
+    assert set(pred) <= {"pos", "neg"}
+    acc = np.mean(pred == ylab)
+    assert acc > 0.9
+    proba = model.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_sklearn_classifier_multiclass(rng):
+    n, f = 2000, 6
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+    model = lgb.LGBMClassifier(n_estimators=30, num_leaves=15)
+    model.fit(X, y)
+    assert model.n_classes_ == 3
+    proba = model.predict_proba(X)
+    assert proba.shape == (n, 3)
+    acc = np.mean(model.predict(X) == y)
+    assert acc > 0.8
+
+
+def test_sklearn_early_stopping(rng):
+    X, y = make_binary(rng)
+    Xt, yt = make_binary(rng, n=400)
+    model = lgb.LGBMClassifier(n_estimators=200, learning_rate=0.3)
+    model.fit(X, y, eval_set=[(Xt, yt)], early_stopping_rounds=5,
+              eval_metric="binary_logloss", verbose=False)
+    assert model.best_iteration_ > 0
+    assert model.best_iteration_ < 200
+
+
+def test_sklearn_ranker(rng):
+    nq, per = 40, 25
+    n = nq * per
+    X = rng.normal(size=(n, 5))
+    y = np.clip((X[:, 0] + rng.normal(size=n) * 0.3 > 0.5).astype(int)
+                + (X[:, 0] > 1.2).astype(int), 0, 2).astype(float)
+    model = lgb.LGBMRanker(n_estimators=20, num_leaves=7,
+                           min_child_samples=5)
+    model.fit(X, y, group=np.full(nq, per))
+    s = model.predict(X)
+    # higher label -> higher average score
+    assert s[y == 2].mean() > s[y == 0].mean()
+
+
+def test_sklearn_get_set_params(rng):
+    model = lgb.LGBMRegressor(n_estimators=10, num_leaves=5)
+    p = model.get_params()
+    assert p["n_estimators"] == 10
+    model.set_params(n_estimators=20)
+    assert model.n_estimators == 20
