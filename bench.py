@@ -1,0 +1,80 @@
+"""Benchmark: HIGGS-proxy binary training throughput on one TPU chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+The box has zero egress, so the real HIGGS file (10.5M x 28 dense floats)
+is proxied by synthetic data with the same feature count and the reference
+GPU-benchmark config (max_bin=63, num_leaves=255, lr=0.1,
+docs/GPU-Performance.rst:110-127).  Steady-state per-iteration time is
+measured after warmup and extrapolated to the reference's 500 iterations.
+
+Baseline: the reference's published HIGGS CPU time is 238.505 s for 500
+iters on 10.5M rows (docs/Experiments.rst:101-116) = 22.715 s row-scaled to
+this benchmark's 1M rows.  vs_baseline = ours / baseline (< 1.0 beats the
+reference CPU; the GPU learner's wall-clock is only published as a chart).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = 1_000_000
+N_FEATURES = 28
+MAX_BIN = 63
+NUM_LEAVES = 255
+WARMUP_ITERS = 3
+MEASURE_ITERS = 12
+TOTAL_ITERS_REF = 500
+BASELINE_500_ITERS_S = 238.505 * (N_ROWS / 10_500_000)
+
+
+def main():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.core.dataset import TpuDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objective import create_objective
+
+    rng = np.random.RandomState(42)
+    X = rng.normal(size=(N_ROWS, N_FEATURES)).astype(np.float32)
+    logit = (2.0 * X[:, 0] + X[:, 1] - X[:, 2] * X[:, 3]
+             + 0.5 * np.sin(3 * X[:, 4]))
+    y = (logit + rng.normal(size=N_ROWS) * 0.5 > 0).astype(np.float64)
+
+    cfg = Config(objective="binary", num_leaves=NUM_LEAVES, max_bin=MAX_BIN,
+                 learning_rate=0.1, min_sum_hessian_in_leaf=100.0,
+                 verbosity=-1)
+    t0 = time.time()
+    ds = TpuDataset.from_numpy(X, y, config=cfg)
+    t_bin = time.time() - t0
+
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    booster = GBDT(cfg, ds, obj)
+
+    for _ in range(WARMUP_ITERS):
+        booster.train_one_iter()
+
+    t0 = time.time()
+    for _ in range(MEASURE_ITERS):
+        booster.train_one_iter()
+    import jax
+    jax.block_until_ready(booster.train_score)
+    per_iter = (time.time() - t0) / MEASURE_ITERS
+    total_500 = per_iter * TOTAL_ITERS_REF
+
+    print(f"binning: {t_bin:.1f}s, per-iter: {per_iter:.3f}s, "
+          f"extrapolated 500-iter: {total_500:.1f}s "
+          f"(baseline row-scaled: {BASELINE_500_ITERS_S:.1f}s)",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "higgs_proxy_1m_500iter_train_time",
+        "value": round(total_500, 2),
+        "unit": "s",
+        "vs_baseline": round(total_500 / BASELINE_500_ITERS_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

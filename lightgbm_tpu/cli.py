@@ -1,0 +1,226 @@
+"""CLI application: train / predict / convert_model / refit.
+
+Reference: src/main.cpp + src/application/application.cpp — LoadParameters
+(:48: argv key=value pairs + ``config=`` file), InitTrain (:165: network
+init, data load, boosting init), Train (:201: iterate + metric output +
+snapshots + final model save), Predict (:212: batch file prediction to
+output_result), ConvertModel (if-else C++ codegen), plus the same config
+file syntax so the reference's examples/*/train.conf run unchanged.
+
+Run as ``python -m lightgbm_tpu train.conf [key=value ...]`` or
+``python -m lightgbm_tpu task=train data=... objective=...``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import Config, kv2map
+from .core.parser import load_file_to_dataset
+from .metric import default_metric_for_objective, metric_canonical_name
+from .models.boosting_factory import create_boosting
+from .objective import create_objective
+from .utils.log import LightGBMError, Timer, log_fatal, log_info, log_warning
+
+
+def load_parameters(argv: List[str]) -> Dict[str, str]:
+    """argv key=value pairs + optional config file (application.cpp:48-81)."""
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg and os.path.exists(arg):
+            arg = f"config={arg}"
+        kv2map(params, arg)
+    config_file = params.get("config", params.get("config_file", ""))
+    if config_file:
+        file_params: Dict[str, str] = {}
+        with open(config_file) as fh:
+            for line in fh:
+                kv2map(file_params, line)
+        # CLI args override config-file values
+        for k, v in file_params.items():
+            params.setdefault(k, v)
+    return params
+
+
+class Application:
+    def __init__(self, argv: List[str]):
+        self.params = load_parameters(argv)
+        self.config = Config.from_params(self.params)
+
+    def run(self) -> None:
+        task = str(self.config.task).strip().lower()
+        if task in ("train", "training"):
+            self.train()
+        elif task in ("predict", "prediction", "test"):
+            self.predict()
+        elif task == "convert_model":
+            self.convert_model()
+        elif task in ("refit", "refit_tree"):
+            self.refit()
+        else:
+            log_fatal(f"Unknown task type {task}")
+
+    # -------------------------------------------------------------- training
+    def _load_data(self):
+        cfg = self.config
+        if not cfg.data:
+            log_fatal("No training data, set data=... in config")
+        with Timer("load train data", print_on_exit=True):
+            train = load_file_to_dataset(cfg.data, cfg)
+        valids = []
+        names = []
+        for i, vf in enumerate(cfg.valid or []):
+            with Timer(f"load valid data {vf}", print_on_exit=True):
+                valids.append(load_file_to_dataset(str(vf), cfg,
+                                                   reference=train))
+            names.append(os.path.basename(str(vf)))
+        return train, valids, names
+
+    def train(self) -> None:
+        cfg = self.config
+        train, valids, names = self._load_data()
+        if cfg.save_binary:
+            train.save_binary(cfg.data + ".bin")
+        objective = create_objective(cfg)
+        if objective is not None:
+            objective.init(train.metadata, train.num_data)
+        booster = create_boosting(cfg, train, objective)
+        if cfg.input_model:
+            from .basic import Booster as PyBooster
+            from .models.serialization import load_trees_into
+            init = PyBooster(model_file=cfg.input_model)
+            load_trees_into(booster, init)
+        for name, vset in zip(names, valids):
+            booster.add_valid_data(name, vset)
+        metric_names = list(cfg.metric)
+        if not metric_names:
+            d = default_metric_for_objective(cfg.objective)
+            metric_names = [d] if d else []
+        booster.setup_metrics(metric_names)
+
+        log_info(f"Started training for {cfg.num_iterations} iterations")
+        start = time.perf_counter()
+        for it in range(cfg.num_iterations):
+            stop = booster.train_one_iter()
+            if (cfg.metric_freq > 0 and (it + 1) % cfg.metric_freq == 0
+                    and metric_names):
+                if cfg.is_provide_training_metric:
+                    for mname, val, _ in booster.eval_train():
+                        log_info(f"Iteration:{it + 1}, training {mname} : "
+                                 f"{val:g}")
+                for vi, vname in enumerate(names):
+                    for mname, val, _ in booster.eval_valid(vi):
+                        log_info(f"Iteration:{it + 1}, valid_{vi + 1} "
+                                 f"{mname} : {val:g}")
+            if (cfg.snapshot_freq > 0
+                    and (it + 1) % cfg.snapshot_freq == 0):
+                snap = f"{cfg.output_model}.snapshot_iter_{it + 1}"
+                self._save_model(booster, snap)
+                log_info(f"Saved snapshot to {snap}")
+            if stop:
+                break
+            log_info(f"{time.perf_counter() - start:.6f} seconds elapsed, "
+                     f"finished iteration {it + 1}")
+        self._save_model(booster, cfg.output_model)
+        log_info(f"Finished training, saved model to {cfg.output_model}")
+
+    def _save_model(self, booster, filename: str) -> None:
+        from .models.serialization import save_model_to_string
+        with open(filename, "w") as fh:
+            fh.write(save_model_to_string(booster, self.config))
+
+    # ------------------------------------------------------------ prediction
+    def predict(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            log_fatal("No model file, set input_model=...")
+        from .basic import Booster as PyBooster
+        booster = PyBooster(model_file=cfg.input_model)
+        X, _ = self._load_predict_matrix(booster)
+        result = booster.predict(
+            X, num_iteration=cfg.num_iteration_predict,
+            raw_score=cfg.predict_raw_score,
+            pred_leaf=cfg.predict_leaf_index,
+            pred_contrib=cfg.predict_contrib)
+        result = np.asarray(result)
+        with open(cfg.output_result, "w") as fh:
+            for row in result.reshape(result.shape[0], -1):
+                fh.write("\t".join(f"{v:g}" for v in row) + "\n")
+        log_info(f"Finished prediction, wrote results to {cfg.output_result}")
+
+    def _load_predict_matrix(self, booster):
+        cfg = self.config
+        from .core.parser import (_detect_format, _parse_dense,
+                                  _parse_libsvm, _column_index)
+        with open(cfg.data) as fh:
+            lines = fh.readlines()
+        header_names = None
+        if cfg.header and lines:
+            sep = "\t" if "\t" in lines[0] else ","
+            header_names = lines[0].strip().split(sep)
+            lines = lines[1:]
+        fmt = _detect_format(lines[:32])
+        if fmt == "libsvm":
+            mat = _parse_libsvm(lines)
+            label_col = 0
+        else:
+            sep = "\t" if fmt == "tsv" else ","
+            mat = _parse_dense(lines, sep)
+            label_col = (_column_index(cfg.label_column, header_names)
+                         if cfg.label_column else 0)
+        label = mat[:, label_col]
+        X = np.delete(mat, label_col, axis=1)
+        # align width with the trained model
+        n_feat = booster.gbdt.max_feature_idx + 1
+        if X.shape[1] < n_feat:
+            X = np.pad(X, ((0, 0), (0, n_feat - X.shape[1])))
+        elif X.shape[1] > n_feat:
+            X = X[:, :n_feat]
+        return X, label
+
+    # ---------------------------------------------------------- model convert
+    def convert_model(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            log_fatal("No model file, set input_model=...")
+        if cfg.convert_model_language not in ("", "cpp"):
+            log_fatal("Only cpp is supported as convert_model_language")
+        from .basic import Booster as PyBooster
+        from .models.convert import model_to_if_else
+        booster = PyBooster(model_file=cfg.input_model)
+        code = model_to_if_else(booster.gbdt)
+        with open(cfg.convert_model, "w") as fh:
+            fh.write(code)
+        log_info(f"Converted model to if-else code at {cfg.convert_model}")
+
+    # ------------------------------------------------------------------ refit
+    def refit(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            log_fatal("No model file, set input_model=...")
+        from .basic import Booster as PyBooster
+        booster = PyBooster(model_file=cfg.input_model)
+        X, label = self._load_predict_matrix(booster)
+        leaf_preds = booster.predict(X, pred_leaf=True)
+        from .models.refit import refit_model
+        refit_model(booster.gbdt, X, label, np.asarray(leaf_preds),
+                    cfg)
+        self._save_model(booster.gbdt, cfg.output_model)
+        log_info(f"Finished refit, saved model to {cfg.output_model}")
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: python -m lightgbm_tpu <config-file|key=value> ...")
+        sys.exit(1)
+    Application(argv).run()
+
+
+if __name__ == "__main__":
+    main()

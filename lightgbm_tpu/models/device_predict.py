@@ -1,0 +1,123 @@
+"""Device-side (jittable) ensemble prediction over binned features.
+
+The training-time score update never needs this (the grower returns leaf
+assignments directly), but batch prediction of a trained ensemble is itself
+a TPU-friendly computation: stack every tree's flat arrays into [T, ...]
+tensors and route all rows through all trees with a bounded fori_loop.
+Replaces the reference's per-row OpenMP tree walk
+(GBDT::PredictRaw, src/boosting/gbdt_prediction.cpp + tree.h:243-288
+NumericalDecisionInner) with vectorized gathers.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.binning import MISSING_NAN, MISSING_ZERO
+
+
+class TreeStack(NamedTuple):
+    """Ensemble as stacked arrays; max_nodes = max(num_leaves) - 1."""
+    split_feature: jax.Array   # [T, M] i32 (inner/used-feature index)
+    threshold_bin: jax.Array   # [T, M] i32
+    decision_type: jax.Array   # [T, M] i8-ish i32 bits
+    left_child: jax.Array      # [T, M] i32
+    right_child: jax.Array     # [T, M] i32
+    cat_bitset: jax.Array      # [T, M, 8] u32 (inner bins)
+    leaf_value: jax.Array      # [T, L] f32
+    num_leaves: jax.Array      # [T] i32
+    max_depth: int             # static bound on routing steps
+
+
+def stack_trees(trees: List, num_features: int) -> TreeStack:
+    """Stack host Tree objects (with inner thresholds) into a TreeStack."""
+    T = len(trees)
+    M = max(max(t.num_leaves - 1, 1) for t in trees)
+    L = max(max(t.num_leaves, 1) for t in trees)
+    sf = np.zeros((T, M), dtype=np.int32)
+    tb = np.zeros((T, M), dtype=np.int32)
+    dt = np.zeros((T, M), dtype=np.int32)
+    lc = np.full((T, M), -1, dtype=np.int32)
+    rc = np.full((T, M), -1, dtype=np.int32)
+    cb = np.zeros((T, M, 8), dtype=np.uint32)
+    lv = np.zeros((T, L), dtype=np.float32)
+    nl = np.ones(T, dtype=np.int32)
+    depth = 1
+    for i, t in enumerate(trees):
+        n = t.num_leaves - 1
+        nl[i] = t.num_leaves
+        lv[i, : t.num_leaves] = t.leaf_value[: t.num_leaves]
+        if n <= 0:
+            continue
+        sf[i, :n] = t.split_feature_inner[:n]
+        tb[i, :n] = t.threshold_in_bin[:n]
+        dt[i, :n] = t.decision_type[:n].astype(np.int32)
+        lc[i, :n] = t.left_child[:n]
+        rc[i, :n] = t.right_child[:n]
+        for node in range(n):
+            if dt[i, node] & 1:
+                cat_idx = int(t.threshold_in_bin[node])
+                words = t.cat_threshold_inner[cat_idx]
+                cb[i, node, : min(len(words), 8)] = words[:8]
+                tb[i, node] = 0
+        depth = max(depth, t.max_depth)
+    return TreeStack(jnp.asarray(sf), jnp.asarray(tb), jnp.asarray(dt),
+                     jnp.asarray(lc), jnp.asarray(rc), jnp.asarray(cb),
+                     jnp.asarray(lv), jnp.asarray(nl), int(depth))
+
+
+def predict_binned_ensemble(stack: TreeStack, bins: jax.Array,
+                            fmeta_num_bin: jax.Array,
+                            fmeta_default_bin: jax.Array) -> jax.Array:
+    """Sum of per-tree raw outputs for binned rows: [N] f32."""
+    n = bins.shape[0]
+
+    def route_one_tree(carry, tree_idx):
+        total = carry
+        sf = stack.split_feature[tree_idx]
+        tb = stack.threshold_bin[tree_idx]
+        dt = stack.decision_type[tree_idx]
+        lc = stack.left_child[tree_idx]
+        rc = stack.right_child[tree_idx]
+        cb = stack.cat_bitset[tree_idx]
+        lv = stack.leaf_value[tree_idx]
+
+        def step(_, node):
+            internal = node >= 0
+            safe = jnp.maximum(node, 0)
+            f = sf[safe]
+            fv = jnp.take_along_axis(
+                bins, f[:, None].astype(jnp.int32), axis=1)[:, 0] \
+                .astype(jnp.int32)
+            d = dt[safe]
+            is_cat = (d & 1) > 0
+            mt = (d >> 2) & 3
+            dl = (d & 2) > 0
+            is_missing = (((mt == MISSING_ZERO)
+                           & (fv == fmeta_default_bin[f]))
+                          | ((mt == MISSING_NAN)
+                             & (fv == fmeta_num_bin[f] - 1)))
+            num_left = jnp.where(is_missing, dl, fv <= tb[safe])
+            word = cb[safe, jnp.clip(fv // 32, 0, 7)]
+            cat_left = ((word >> (fv % 32).astype(jnp.uint32)) & 1) > 0
+            go_left = jnp.where(is_cat, cat_left, num_left)
+            nxt = jnp.where(go_left, lc[safe], rc[safe])
+            return jnp.where(internal, nxt, node)
+
+        # single-leaf trees start terminal at node -1 (= leaf ~(-1) = 0)
+        start = jnp.where(stack.num_leaves[tree_idx] <= 1,
+                          jnp.full(n, -1, dtype=jnp.int32),
+                          jnp.zeros(n, dtype=jnp.int32))
+        node = lax.fori_loop(0, stack.max_depth + 1, step, start)
+        leaf = jnp.maximum(~node, 0)
+        return total + lv[leaf], None
+
+    init = jnp.zeros(n, dtype=jnp.float32)
+    total, _ = lax.scan(route_one_tree, init,
+                        jnp.arange(stack.split_feature.shape[0]))
+    return total

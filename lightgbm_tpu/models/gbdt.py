@@ -87,6 +87,7 @@ class GBDT:
         self.max_feature_idx = train_set.num_total_features - 1
         self.fmeta = build_feature_meta(train_set)
         self.bins = train_set.device_binned()
+        self._row_pad = 0
         self.num_bins = _round_up_pow2(max(train_set.max_num_bin, 2))
         cfg = self.config
         self.grower_params = GrowerParams(
@@ -104,7 +105,34 @@ class GBDT:
                 max_cat_threshold=cfg.max_cat_threshold,
                 max_cat_to_onehot=cfg.max_cat_to_onehot,
                 min_data_per_group=cfg.min_data_per_group))
-        self._grow_fn = make_grow_tree(self.num_bins, self.grower_params)
+        tl = str(cfg.tree_learner).strip().lower()
+        if tl in ("data", "data_parallel", "feature", "feature_parallel",
+                  "voting", "voting_parallel"):
+            from ..parallel import network
+            from ..parallel.learners import make_parallel_grower
+            # num_machines=1 (the default) means "use every device on the
+            # mesh" — the TPU runtime already knows the slice topology
+            mesh = network.init(cfg.num_machines if cfg.num_machines > 1
+                                else 0)
+            if mesh.devices.size <= 1:
+                log_warning("Only one device available; using the serial "
+                            "tree learner")
+                self._grow_fn = make_grow_tree(self.num_bins,
+                                               self.grower_params)
+            else:
+                D = int(mesh.devices.size)
+                # pad rows to a multiple of the mesh size; pad rows carry
+                # zero membership weight so they never contribute
+                pad = (-self.num_data) % D
+                if pad:
+                    self.bins = jnp.pad(self.bins, ((0, pad), (0, 0)))
+                    self._row_pad = pad
+                self._grow_fn = make_parallel_grower(
+                    self.num_bins, self.grower_params, mesh, tl,
+                    top_k=cfg.top_k)
+                self._mesh = mesh
+        else:
+            self._grow_fn = make_grow_tree(self.num_bins, self.grower_params)
         C = self.num_tree_per_iteration
         self.train_score = jnp.zeros((C, self.num_data), dtype=jnp.float32)
         if train_set.metadata.init_score is not None:
@@ -229,9 +257,15 @@ class GBDT:
         for k in range(C):
             fmask = self._tree_feature_mask()
             self._key, sub = jax.random.split(self._key)
+            g_k, h_k, member = grads[k], hesss[k], self.bag_weight
+            if self._row_pad:
+                g_k = jnp.pad(g_k, (0, self._row_pad))
+                h_k = jnp.pad(h_k, (0, self._row_pad))
+                member = jnp.pad(member, (0, self._row_pad))
             arrays, leaf_id = self._grow_fn(
-                self.bins, grads[k], hesss[k], self.bag_weight, self.fmeta,
-                fmask, sub)
+                self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
+            if self._row_pad:
+                leaf_id = leaf_id[: self.num_data]
             nl = int(arrays.num_leaves)
             if nl <= 1:
                 tree = Tree(1)

@@ -131,7 +131,24 @@ def _leaf_scan(hist, g, h, c, depth, fmeta, fmask, p: GrowerParams):
     return info, gain
 
 
-def make_grow_tree(num_bins: int, params: GrowerParams):
+class CommHooks(NamedTuple):
+    """Collective hooks injected by the parallel tree learners
+    (SURVEY.md §2.5: the TPU equivalent of the Network reducers).
+
+    ``reduce_hist(hist, G, H, C, fmeta)`` runs after every histogram build
+    (data-parallel: psum / voting: vote + masked psum); ``reduce_stats(x)``
+    reduces root scalar stats; ``merge_split(info)`` merges per-shard
+    SplitInfos by max gain (feature-parallel: SyncUpGlobalBestSplit,
+    parallel_tree_learner.h:356-397).  All default to identity (serial).
+    """
+    reduce_hist: object = None
+    reduce_stats: object = None
+    merge_split: object = None
+    shard_feature_mask: object = None
+
+
+def make_grow_tree(num_bins: int, params: GrowerParams,
+                   comm: CommHooks = CommHooks(), wrap=None):
     """Build the jitted tree-growing function for a static (B, params).
 
     The returned ``grow(bins, grad, hess, member, fmeta, feature_mask, key)``
@@ -147,13 +164,18 @@ def make_grow_tree(num_bins: int, params: GrowerParams):
     B = num_bins
     sp = p.split
 
-    def hist_of(bins, grad, hess, member):
+    def hist_of(bins, grad, hess, member, G, H, C, fmeta):
         w = jnp.stack([grad * member, hess * member, member])
-        return histogram_chunked(bins, w, B, p.row_chunk)
+        out = histogram_chunked(bins, w, B, p.row_chunk)
+        if comm.reduce_hist is not None:
+            out = comm.reduce_hist(out, G, H, C, fmeta)
+        return out
 
     def scan_leaf(st: _GrowState, leaf_idx, hist, g, h, c, depth, fmeta,
                   fmask):
         info, gain = _leaf_scan(hist, g, h, c, depth, fmeta, fmask, p)
+        if comm.merge_split is not None:
+            info, gain = comm.merge_split(info, gain)
         return st._replace(
             best_gain=st.best_gain.at[leaf_idx].set(gain),
             best_feature=st.best_feature.at[leaf_idx].set(info.feature),
@@ -171,6 +193,8 @@ def make_grow_tree(num_bins: int, params: GrowerParams):
 
     def grow(bins, grad, hess, member, fmeta: FeatureMeta, feature_mask, key):
         n, F = bins.shape
+        if comm.shard_feature_mask is not None:
+            feature_mask = comm.shard_feature_mask(feature_mask)
 
         def do_split(st: _GrowState, step):
             leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
@@ -198,7 +222,11 @@ def make_grow_tree(num_bins: int, params: GrowerParams):
             smaller_is_left = Cl <= Cr
             smaller = jnp.where(smaller_is_left, leaf, new_leaf)
             mem_small = (leaf_id == smaller).astype(grad.dtype) * member
-            hist_small = hist_of(bins, grad, hess, mem_small)
+            Gs = jnp.where(smaller_is_left, Gl, Gr)
+            Hs = jnp.where(smaller_is_left, Hl, Hr)
+            Cs = jnp.where(smaller_is_left, Cl, Cr)
+            hist_small = hist_of(bins, grad, hess, mem_small, Gs, Hs, Cs,
+                                 fmeta)
             hist_parent = st.leaf_hist[leaf]
             hist_large = hist_parent - hist_small
             hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
@@ -277,7 +305,12 @@ def make_grow_tree(num_bins: int, params: GrowerParams):
         G0 = jnp.sum(grad * member)
         H0 = jnp.sum(hess * member)
         C0 = jnp.sum(member)
-        root_hist = hist_of(bins, grad, hess, member)
+        if comm.reduce_stats is not None:
+            # allreduce of the root (cnt, sum_g, sum_h) tuple
+            # (data_parallel_tree_learner.cpp:311-357)
+            G0, H0, C0 = (comm.reduce_stats(G0), comm.reduce_stats(H0),
+                          comm.reduce_stats(C0))
+        root_hist = hist_of(bins, grad, hess, member, G0, H0, C0, fmeta)
         neg = jnp.full(L, NEG_INF, dtype=jnp.float32)
         zeros_l = jnp.zeros(L, dtype=jnp.float32)
         tree0 = TreeArrays(
@@ -323,4 +356,6 @@ def make_grow_tree(num_bins: int, params: GrowerParams):
         st = lax.fori_loop(0, L - 1, body, st)
         return st.tree, st.leaf_id
 
+    if wrap is not None:
+        return wrap(grow)
     return jax.jit(grow)

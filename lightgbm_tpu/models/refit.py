@@ -1,0 +1,54 @@
+"""Refit: re-estimate leaf outputs of an existing model on new data.
+
+Reference: GBDT::RefitTree (src/boosting/gbdt.cpp) via the CLI refit task
+(application.cpp) and Booster.refit (python basic.py): walk trees in order,
+compute objective gradients at the progressively-updated score, and blend
+each leaf's output with the gradient-optimal value using refit_decay_rate:
+new = decay * old + (1 - decay) * (-sum_g / (sum_h + lambda_l2)).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..objective import create_objective
+from ..ops.split import K_EPSILON
+
+
+def refit_model(gbdt, X: np.ndarray, label: np.ndarray,
+                leaf_preds: np.ndarray, config) -> None:
+    objective = create_objective(config)
+    if objective is None:
+        objective = gbdt.objective
+    from ..core.metadata import Metadata
+    meta = Metadata(len(label))
+    meta.set_label(label)
+    objective.init(meta, len(label))
+
+    C = gbdt.num_tree_per_iteration
+    decay = float(config.refit_decay_rate)
+    lam = float(config.lambda_l2)
+    n_trees = leaf_preds.shape[1]
+    score = np.zeros((C, len(label)), dtype=np.float64)
+    for k in range(C):
+        score[k] += gbdt.init_scores[k]
+
+    import jax.numpy as jnp
+    for t in range(n_trees):
+        k = t % C
+        g, h = objective.get_gradients(
+            jnp.asarray(score if C > 1 else score[k], dtype=jnp.float32))
+        g = np.asarray(g if C == 1 else g[k], dtype=np.float64)
+        h = np.asarray(h if C == 1 else h[k], dtype=np.float64)
+        tree = gbdt.models[t]
+        leaves = leaf_preds[:, t]
+        new_values = np.array(tree.leaf_value, dtype=np.float64)
+        for leaf in range(tree.num_leaves):
+            sel = leaves == leaf
+            if not sel.any():
+                continue
+            sum_g, sum_h = g[sel].sum(), h[sel].sum()
+            opt = -sum_g / (sum_h + lam + K_EPSILON) * tree.shrinkage
+            new_values[leaf] = decay * new_values[leaf] + (1 - decay) * opt
+        tree.leaf_value = new_values
+        score[k] += tree.predict_raw(X)

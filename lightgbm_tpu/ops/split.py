@@ -276,27 +276,19 @@ def build_cat_bitset(selected_bins_mask: jax.Array) -> jax.Array:
     return out.at[: words.shape[0]].set(words[:8])
 
 
-def best_split(hist: jax.Array, parent_g, parent_h, parent_c,
-               fmeta: FeatureMeta, params: SplitParams,
-               feature_mask: jax.Array, mono_lo=None, mono_hi=None) -> SplitInfo:
-    """Find the best split of one leaf from its [F, B, 3] histogram.
-
-    Mirrors SerialTreeLearner::FindBestSplitsFromHistograms
-    (serial_tree_learner.cpp:549-640): per-feature best threshold, then the
-    per-leaf argmax over features with feature-fraction masking and penalty.
-    """
-    p = params
-    F, B, _ = hist.shape
+def _all_candidates(hist, parent_g, parent_h, parent_c, fmeta: FeatureMeta,
+                    p: SplitParams, lo, hi):
+    """Shared candidate evaluation: per-feature family winners + gains."""
+    F = hist.shape[0]
     parent = jnp.stack([parent_g, parent_h, parent_c]).astype(hist.dtype)
-    lo = -jnp.inf if mono_lo is None else mono_lo
-    hi = jnp.inf if mono_hi is None else mono_hi
 
     gain_shift = leaf_gain(parent_g, parent_h + 2 * K_EPSILON,
                            p.lambda_l1, p.lambda_l2, p.max_delta_step)
     min_gain_shift = gain_shift + p.min_gain_to_split
 
     num_gain, num_left = _numerical_candidates(hist, parent, fmeta, p, lo, hi)
-    oh_gain, oh_left = _categorical_onehot_candidates(hist, parent, fmeta, p, lo, hi)
+    oh_gain, oh_left = _categorical_onehot_candidates(hist, parent, fmeta,
+                                                      p, lo, hi)
     so_gain, so_left, so_order = _categorical_sorted_candidates(
         hist, parent, fmeta, p, lo, hi)
 
@@ -305,7 +297,6 @@ def best_split(hist: jax.Array, parent_g, parent_h, parent_c,
     oh_gain = jnp.where(use_onehot, oh_gain, NEG_INF)
     so_gain = jnp.where(use_onehot[:, :, None], NEG_INF, so_gain)
 
-    # per-feature winners of each family
     def fam_best(gain_flat):
         idx = jnp.argmax(gain_flat, axis=1)
         return idx, jnp.take_along_axis(gain_flat, idx[:, None], axis=1)[:, 0]
@@ -317,11 +308,44 @@ def best_split(hist: jax.Array, parent_g, parent_h, parent_c,
     fam_gains = jnp.stack([ng, og, sg], axis=1)                    # [F, 3]
     fam = jnp.argmax(fam_gains, axis=1)
     fgain = jnp.max(fam_gains, axis=1)
-
-    # min-gain check, feature mask, penalty (FindBestThreshold:83-90)
     splittable = fgain > min_gain_shift
-    fgain_out = (fgain - min_gain_shift) * fmeta.penalty
-    fgain_out = jnp.where(splittable & (feature_mask > 0), fgain_out, NEG_INF)
+    fgain_out = jnp.where(splittable,
+                          (fgain - min_gain_shift) * fmeta.penalty, NEG_INF)
+    return dict(parent=parent, num_left=num_left, oh_left=oh_left,
+                so_left=so_left, so_order=so_order, ni=ni, oi=oi, si=si,
+                fam=fam, fgain_out=fgain_out)
+
+
+def per_feature_gains(hist: jax.Array, parent_g, parent_h, parent_c,
+                      fmeta: FeatureMeta, params: SplitParams) -> jax.Array:
+    """[F] best gain per feature (NEG_INF where unsplittable) — used by the
+    voting-parallel learner's local vote
+    (voting_parallel_tree_learner.cpp:170-201)."""
+    c = _all_candidates(hist, parent_g, parent_h, parent_c, fmeta, params,
+                        -jnp.inf, jnp.inf)
+    return c["fgain_out"]
+
+
+def best_split(hist: jax.Array, parent_g, parent_h, parent_c,
+               fmeta: FeatureMeta, params: SplitParams,
+               feature_mask: jax.Array, mono_lo=None, mono_hi=None) -> SplitInfo:
+    """Find the best split of one leaf from its [F, B, 3] histogram.
+
+    Mirrors SerialTreeLearner::FindBestSplitsFromHistograms
+    (serial_tree_learner.cpp:549-640): per-feature best threshold, then the
+    per-leaf argmax over features with feature-fraction masking and penalty.
+    """
+    p = params
+    F, B, _ = hist.shape
+    lo = -jnp.inf if mono_lo is None else mono_lo
+    hi = jnp.inf if mono_hi is None else mono_hi
+
+    c = _all_candidates(hist, parent_g, parent_h, parent_c, fmeta, p, lo, hi)
+    parent = c["parent"]
+    num_left, oh_left = c["num_left"], c["oh_left"]
+    so_left, so_order = c["so_left"], c["so_order"]
+    ni, oi, si, fam = c["ni"], c["oi"], c["si"], c["fam"]
+    fgain_out = jnp.where(feature_mask > 0, c["fgain_out"], NEG_INF)
 
     best_f = jnp.argmax(fgain_out).astype(jnp.int32)
     best_gain = fgain_out[best_f]

@@ -1,0 +1,131 @@
+"""Distributed tree learners over a device mesh.
+
+The reference parallelizes tree learning across machines with hand-rolled
+socket/MPI collectives (SURVEY.md §2.7): data-parallel (rows sharded,
+histogram reduce-scatter + best-split allreduce,
+src/treelearner/data_parallel_tree_learner.cpp:209-601), feature-parallel
+(full data, split finding sharded by feature, 2xSplitInfo max-gain allreduce,
+feature_parallel_tree_learner.cpp:33-75), and voting-parallel (top-k vote to
+cut reduce volume, voting_parallel_tree_learner.cpp:170-380).
+
+Here each strategy is a set of collective hooks injected into the SAME fused
+grower and executed under ``shard_map`` over a 1-D ``machines`` mesh axis:
+
+  * data-parallel:    rows sharded; per-histogram ``lax.psum`` over ICI (the
+    runtime lowers the replicated-output psum to reduce-scatter +
+    all-gather, i.e. the reference's ReduceScatter-then-scan pattern but
+    compiler-scheduled); root stats psum.
+  * feature-parallel: data replicated; each shard strips the tree-level
+    feature mask to its modulo stripe, scans only those features, and the
+    per-leaf SplitInfos merge via all_gather + argmax on gain (the packed-
+    SplitInfo max-gain allreduce).
+  * voting-parallel:  rows sharded; each shard votes its local top-k
+    features by local best gain, votes are psum'd, and only the 2*top_k
+    globally-elected features' histograms are reduced.
+
+Multi-host: initialize ``jax.distributed`` so ``jax.devices()`` spans hosts;
+the same axis then rides ICI within a slice and DCN across hosts — no code
+changes (the reference's machine-list/socket handshake has no equivalent
+work here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.grower import CommHooks, GrowerParams, make_grow_tree
+from ..ops.split import NEG_INF, SplitInfo, SplitParams, per_feature_gains
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+        except TypeError:
+            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def _merge_split_by_gain(info: SplitInfo, gain, axis):
+    """all_gather each SplitInfo field, keep the max-gain shard's
+    (SyncUpGlobalBestSplit, parallel_tree_learner.h:356-397)."""
+    gains = lax.all_gather(gain, axis)              # [D]
+    winner = jnp.argmax(gains)
+    merged = SplitInfo(*[lax.all_gather(f, axis)[winner] for f in info])
+    return merged, gains[winner]
+
+
+def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
+                         mode: str, top_k: int = 20):
+    """shard_map-wrapped grower for mode in {'data', 'feature', 'voting'}.
+
+    Argument order of the returned fn matches the serial grower:
+    (bins, grad, hess, member, fmeta, feature_mask, key).
+    """
+    axis = mesh.axis_names[0]
+    D = int(mesh.devices.size)
+    sp = params.split
+    repl = P()
+
+    if mode in ("data", "data_parallel"):
+        comm = CommHooks(
+            reduce_hist=lambda h, G, H, C, f: lax.psum(h, axis),
+            reduce_stats=lambda x: lax.psum(x, axis))
+        in_specs = (P(axis, None), P(axis), P(axis), P(axis), repl, repl,
+                    repl)
+        out_specs = (repl, P(axis))
+    elif mode in ("feature", "feature_parallel"):
+        def shard_mask(fmask):
+            # features striped modulo D (the reference re-balances by #bins
+            # per tree, feature_parallel_tree_learner.cpp:36-47; a stripe is
+            # an even split when bins are uniform)
+            F = fmask.shape[0]
+            me = lax.axis_index(axis)
+            stripe = (jnp.arange(F, dtype=jnp.int32) % D) == me
+            return fmask * stripe.astype(fmask.dtype)
+
+        comm = CommHooks(
+            merge_split=lambda info, gain: _merge_split_by_gain(
+                info, gain, axis),
+            shard_feature_mask=shard_mask)
+        in_specs = (repl, repl, repl, repl, repl, repl, repl)
+        out_specs = (repl, repl)
+    elif mode in ("voting", "voting_parallel"):
+        def reduce_voted(h, G, H, C, fmeta):
+            local_gains = per_feature_gains(h, G, H, C, fmeta, sp)   # [F]
+            F = h.shape[0]
+            k = min(top_k, F)
+            gains_top, local_top = lax.top_k(local_gains, k)
+            votes = jnp.zeros(F, dtype=jnp.int32).at[local_top].add(
+                jnp.where(gains_top > NEG_INF, 1, 0))
+            votes = lax.psum(votes, axis)
+            k2 = min(2 * top_k, F)
+            _, elected = lax.top_k(votes, k2)
+            mask = jnp.zeros(F, dtype=h.dtype).at[elected].set(1.0)
+            # only elected features' histograms cross the wire; the rest are
+            # zeroed so their candidates mask out in the scan
+            return lax.psum(h * mask[:, None, None], axis)
+
+        comm = CommHooks(
+            reduce_hist=reduce_voted,
+            reduce_stats=lambda x: lax.psum(x, axis))
+        in_specs = (P(axis, None), P(axis), P(axis), P(axis), repl, repl,
+                    repl)
+        out_specs = (repl, P(axis))
+    else:
+        raise ValueError(f"Unknown parallel tree learner mode {mode}")
+
+    def wrap(grow):
+        return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
+
+    return make_grow_tree(num_bins, params, comm=comm, wrap=wrap)

@@ -1,0 +1,145 @@
+"""CLI application tests: train/predict/convert_model/refit from conf files
+(mirrors the reference's tests/cpp_test CLI parity harness and
+test_consistency.py conf-file loading)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.cli import Application, load_parameters
+
+
+@pytest.fixture
+def data_files(tmp_path, rng):
+    n, f = 600, 5
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    train = tmp_path / "train.csv"
+    rows = np.column_stack([y, X])
+    np.savetxt(train, rows, delimiter=",", fmt="%.6f")
+    valid = tmp_path / "valid.csv"
+    np.savetxt(valid, rows[:200], delimiter=",", fmt="%.6f")
+    return tmp_path, str(train), str(valid)
+
+
+def test_load_parameters_conf_file(tmp_path):
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        "task = train\n"
+        "objective = binary\n"
+        "# a comment line\n"
+        "num_trees = 7\n"
+        "learning_rate = 0.2\n")
+    params = load_parameters([str(conf), "num_leaves=9"])
+    assert params["task"] == "train"
+    assert params["objective"] == "binary"
+    assert params["num_trees"] == "7"
+    assert params["num_leaves"] == "9"
+
+
+def test_cli_override_beats_conf(tmp_path):
+    conf = tmp_path / "c.conf"
+    conf.write_text("num_trees = 7\n")
+    params = load_parameters(["num_trees=3", f"config={conf}"])
+    assert params["num_trees"] == "3"
+
+
+def test_train_and_predict(data_files):
+    tmp_path, train, valid = data_files
+    model = str(tmp_path / "model.txt")
+    out = str(tmp_path / "preds.txt")
+    Application([
+        "task=train", f"data={train}", f"valid={valid}",
+        "objective=binary", "num_trees=10", "num_leaves=7",
+        f"output_model={model}", "metric=binary_logloss", "verbosity=-1",
+    ]).run()
+    assert os.path.exists(model)
+    with open(model) as fh:
+        content = fh.read()
+    assert content.startswith("tree")
+    assert "objective=binary" in content
+
+    Application([
+        "task=predict", f"data={train}", f"input_model={model}",
+        f"output_result={out}", "verbosity=-1",
+    ]).run()
+    preds = np.loadtxt(out)
+    assert preds.shape[0] == 600
+    assert (preds >= 0).all() and (preds <= 1).all()
+    # predictions should separate the classes
+    y = np.loadtxt(train, delimiter=",")[:, 0]
+    assert np.mean((preds > 0.5) == y) > 0.9
+
+
+def test_snapshot_and_continue(data_files):
+    tmp_path, train, valid = data_files
+    model = str(tmp_path / "m.txt")
+    Application([
+        "task=train", f"data={train}", "objective=binary", "num_trees=6",
+        f"output_model={model}", "snapshot_freq=2", "verbosity=-1",
+    ]).run()
+    assert os.path.exists(model + ".snapshot_iter_2")
+    assert os.path.exists(model + ".snapshot_iter_4")
+    # continued training from the saved model
+    model2 = str(tmp_path / "m2.txt")
+    Application([
+        "task=train", f"data={train}", "objective=binary", "num_trees=4",
+        f"input_model={model}", f"output_model={model2}", "verbosity=-1",
+    ]).run()
+    from lightgbm_tpu.basic import Booster
+    b = Booster(model_file=model2)
+    assert b.num_trees() == 10
+
+
+def test_convert_model(data_files):
+    tmp_path, train, _ = data_files
+    model = str(tmp_path / "m.txt")
+    cpp = str(tmp_path / "pred.cpp")
+    Application(["task=train", f"data={train}", "objective=binary",
+                 "num_trees=3", f"output_model={model}",
+                 "verbosity=-1"]).run()
+    Application(["task=convert_model", f"input_model={model}",
+                 f"convert_model={cpp}", "verbosity=-1"]).run()
+    code = open(cpp).read()
+    assert "PredictTree0" in code
+    assert "PredictRaw" in code
+
+
+def test_convert_model_compiles(data_files):
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    tmp_path, train, _ = data_files
+    model = str(tmp_path / "m.txt")
+    cpp = str(tmp_path / "pred.cpp")
+    Application(["task=train", f"data={train}", "objective=binary",
+                 "num_trees=3", f"output_model={model}",
+                 "verbosity=-1"]).run()
+    Application(["task=convert_model", f"input_model={model}",
+                 f"convert_model={cpp}", "verbosity=-1"]).run()
+    r = subprocess.run(["g++", "-fsyntax-only", "-std=c++11", cpp],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def test_refit(data_files):
+    tmp_path, train, _ = data_files
+    model = str(tmp_path / "m.txt")
+    refitted = str(tmp_path / "refit.txt")
+    Application(["task=train", f"data={train}", "objective=binary",
+                 "num_trees=5", f"output_model={model}",
+                 "verbosity=-1"]).run()
+    Application(["task=refit", f"data={train}", f"input_model={model}",
+                 f"output_model={refitted}", "refit_decay_rate=0.5",
+                 "verbosity=-1"]).run()
+    assert os.path.exists(refitted)
+    from lightgbm_tpu.basic import Booster
+    b1 = Booster(model_file=model)
+    b2 = Booster(model_file=refitted)
+    X = np.loadtxt(train, delimiter=",")[:, 1:]
+    p1, p2 = b1.predict(X), b2.predict(X)
+    assert p1.shape == p2.shape
+    assert not np.allclose(p1, p2)  # refit changed the leaves

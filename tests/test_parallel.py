@@ -1,0 +1,83 @@
+"""Distributed tree-learner tests on the virtual 8-device CPU mesh.
+
+The reference cannot test its parallel learners in one process (SURVEY.md
+§4: no mock network; real multi-machine launches only).  Here the same
+shard_map code path that runs on a TPU pod runs on 8 virtual CPU devices,
+so data-/feature-/voting-parallel are exercised in-process and compared
+against the serial learner.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_data(rng, n=2000, f=10):
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + (X[:, 2] > 0) + \
+        rng.normal(size=n) * 0.1
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def devices():
+    return jax.devices()
+
+
+def _train(X, y, tree_learner, **extra):
+    params = {"objective": "regression", "verbose": -1, "num_leaves": 15,
+              "tree_learner": tree_learner, "max_bin": 63, "seed": 5}
+    params.update(extra)
+    ds = lgb.Dataset(X, y)
+    return lgb.train(params, ds, num_boost_round=20, verbose_eval=False)
+
+
+def test_mesh_available(devices):
+    assert len(devices) == 8, "conftest should provide 8 virtual devices"
+
+
+def test_data_parallel_matches_serial(rng):
+    X, y = make_data(rng)
+    serial = _train(X, y, "serial")
+    data = _train(X, y, "data")
+    ps = serial.predict(X)
+    pd = data.predict(X)
+    # identical split decisions up to float reduction order
+    np.testing.assert_allclose(ps, pd, rtol=1e-3, atol=1e-4)
+    mse = float(np.mean((pd - y) ** 2))
+    assert mse < 0.1 * y.var()
+
+
+def test_feature_parallel_matches_serial(rng):
+    X, y = make_data(rng)
+    serial = _train(X, y, "serial")
+    feat = _train(X, y, "feature")
+    np.testing.assert_allclose(serial.predict(X), feat.predict(X),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_voting_parallel_trains(rng):
+    X, y = make_data(rng, n=4000)
+    vot = _train(X, y, "voting", top_k=5)
+    mse = float(np.mean((vot.predict(X) - y) ** 2))
+    assert mse < 0.15 * y.var()
+
+
+def test_data_parallel_uneven_rows(rng):
+    # 2003 % 8 != 0: exercises the zero-member row padding
+    X, y = make_data(rng, n=2003)
+    data = _train(X, y, "data")
+    assert float(np.mean((data.predict(X) - y) ** 2)) < 0.1 * y.var()
+
+
+def test_data_parallel_binary(rng):
+    X = rng.normal(size=(2000, 8))
+    yb = (X[:, 0] + X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "tree_learner": "data"}
+    bst = lgb.train(params, lgb.Dataset(X, yb), num_boost_round=15,
+                    verbose_eval=False)
+    acc = np.mean((bst.predict(X) > 0.5) == yb)
+    assert acc > 0.9
