@@ -200,6 +200,22 @@ class TpuDataset:
             self._device_binned = jnp.asarray(self.binned)
         return self._device_binned
 
+    def device_binned_T(self, row_multiple: int = 1):
+        """Feature-major [F, Npad] bin matrix, rows padded to a multiple of
+        ``row_multiple`` (pad rows are bin 0; training must give them zero
+        weight).  This is the training layout: each feature is a contiguous
+        lane stream for the histogram kernels."""
+        import jax.numpy as jnp
+        key = getattr(self, "_device_binned_T_key", None)
+        if key != row_multiple:
+            npad = (-self.num_data) % row_multiple
+            t = np.ascontiguousarray(self.binned.T)
+            if npad:
+                t = np.pad(t, ((0, 0), (0, npad)))
+            self._device_binned_T = jnp.asarray(t)
+            self._device_binned_T_key = row_multiple
+        return self._device_binned_T
+
     def create_valid(self, data: np.ndarray, label: Optional[np.ndarray] = None,
                      **kwargs) -> "TpuDataset":
         return TpuDataset.from_numpy(data, label=label, reference=self, **kwargs)

@@ -34,8 +34,13 @@ class TreeStack(NamedTuple):
     max_depth: int             # static bound on routing steps
 
 
-def stack_trees(trees: List, num_features: int) -> TreeStack:
-    """Stack host Tree objects (with inner thresholds) into a TreeStack."""
+def stack_trees(trees: List, num_features: int = -1) -> TreeStack:
+    """Stack host Tree objects (with inner thresholds) into a TreeStack.
+
+    ``num_features``, when given, validates that every split references a
+    feature inside the bin matrix (out-of-range splits would otherwise
+    become silent clipped gathers inside the jitted predict).
+    """
     T = len(trees)
     M = max(max(t.num_leaves - 1, 1) for t in trees)
     L = max(max(t.num_leaves, 1) for t in trees)
@@ -54,6 +59,12 @@ def stack_trees(trees: List, num_features: int) -> TreeStack:
         lv[i, : t.num_leaves] = t.leaf_value[: t.num_leaves]
         if n <= 0:
             continue
+        if num_features >= 0 and n > 0 and \
+                int(np.max(t.split_feature_inner[:n])) >= num_features:
+            raise ValueError(
+                f"tree {i} splits on feature "
+                f"{int(np.max(t.split_feature_inner[:n]))} but the bin "
+                f"matrix has only {num_features} features")
         sf[i, :n] = t.split_feature_inner[:n]
         tb[i, :n] = t.threshold_in_bin[:n]
         dt[i, :n] = t.decision_type[:n].astype(np.int32)

@@ -25,7 +25,7 @@ from ..config import Config
 from ..core.dataset import TpuDataset
 from ..ops.split import FeatureMeta, SplitParams
 from ..utils.log import check, log_fatal, log_info, log_warning
-from .grower import GrowerParams, make_grow_tree
+from .grower import GrowerParams, fetch_tree_arrays, make_grow_tree
 from .tree import Tree
 
 
@@ -79,6 +79,37 @@ class GBDT:
             self.reset_train_data(train_set)
 
     # ----------------------------------------------------------------- setup
+    def _resolve_hist_backend(self) -> str:
+        """auto -> pallas on TPU when the kernel supports the shape
+        (ops/pallas_histogram.supported); parallel learners and explicit
+        double-precision requests stay on the XLA one-hot path."""
+        cfg = self.config
+        choice = str(cfg.tpu_histogram_backend).strip().lower()
+        if choice == "onehot":
+            return "onehot"
+        tl = str(cfg.tree_learner).strip().lower()
+        parallel = tl in ("data", "data_parallel", "feature",
+                          "feature_parallel", "voting", "voting_parallel")
+        if choice == "pallas" or choice == "auto":
+            import jax
+            from ..ops.pallas_histogram import supported
+            ok = (not parallel
+                  and not cfg.gpu_use_dp and not cfg.tpu_double_precision
+                  and supported(self.train_set.num_used_features,
+                                _round_up_pow2(
+                                    max(self.train_set.max_num_bin, 2)),
+                                self.train_set.binned.dtype))
+            if choice == "pallas":
+                if not ok:
+                    from ..utils.log import log_warning as _warn
+                    _warn("tpu_histogram_backend=pallas unsupported for "
+                          "this dataset/learner; falling back to onehot")
+                    return "onehot"
+                return "pallas"
+            return "pallas" if (ok and jax.default_backend() == "tpu") \
+                else "onehot"
+        return "onehot"
+
     def reset_train_data(self, train_set: TpuDataset) -> None:
         check(train_set.num_used_features > 0 or True, "")
         self.train_set = train_set
@@ -86,15 +117,25 @@ class GBDT:
         self.feature_names = list(train_set.feature_names)
         self.max_feature_idx = train_set.num_total_features - 1
         self.fmeta = build_feature_meta(train_set)
-        self.bins = train_set.device_binned()
         self._row_pad = 0
         self.num_bins = _round_up_pow2(max(train_set.max_num_bin, 2))
         cfg = self.config
+        backend = self._resolve_hist_backend()
+        if backend == "pallas":
+            from ..ops.pallas_histogram import pick_block_rows
+            rb = (cfg.tpu_row_chunk if cfg.tpu_row_chunk > 0 else
+                  pick_block_rows(train_set.num_used_features,
+                                  self.num_bins))
+            self.bins = train_set.device_binned_T(rb)
+            self._row_pad = int(self.bins.shape[1]) - self.num_data
+        else:
+            self.bins = train_set.device_binned()
         self.grower_params = GrowerParams(
             num_leaves=max(2, cfg.num_leaves),
             max_depth=cfg.max_depth,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
             row_chunk=cfg.tpu_row_chunk,
+            hist_backend=backend,
             split=SplitParams(
                 lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
                 max_delta_step=cfg.max_delta_step,
@@ -266,6 +307,7 @@ class GBDT:
                 self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
             if self._row_pad:
                 leaf_id = leaf_id[: self.num_data]
+            arrays = fetch_tree_arrays(arrays)
             nl = int(arrays.num_leaves)
             if nl <= 1:
                 tree = Tree(1)

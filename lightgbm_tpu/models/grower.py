@@ -43,7 +43,15 @@ class GrowerParams(NamedTuple):
     max_depth: int = -1
     feature_fraction_bynode: float = 1.0
     row_chunk: int = 0
+    # "onehot": row-major [N, F] bins, XLA one-hot einsum (works anywhere);
+    # "pallas": feature-major [F, Npad] bins, TPU pallas kernel
+    # (ops/pallas_histogram.py)
+    hist_backend: str = "onehot"
     split: SplitParams = SplitParams()
+
+    @property
+    def feature_major(self) -> bool:
+        return self.hist_backend == "pallas"
 
 
 class TreeArrays(NamedTuple):
@@ -68,6 +76,73 @@ class TreeArrays(NamedTuple):
     leaf_count: jax.Array          # f32
     leaf_parent: jax.Array         # i32
     leaf_depth: jax.Array          # i32
+
+
+@jax.jit
+def _pack_tree_device(t: TreeArrays):
+    """Concatenate all tree fields into one i32 + one f32 buffer so the
+    host fetch is two transfers instead of ~17 (each pays a full device
+    round-trip)."""
+    ints = jnp.concatenate([
+        jnp.atleast_1d(t.num_leaves),
+        t.split_feature, t.threshold_bin,
+        t.default_left.astype(jnp.int32), t.is_cat.astype(jnp.int32),
+        t.cat_bitset.astype(jnp.int32).ravel(),
+        t.left_child, t.right_child,
+        t.leaf_parent, t.leaf_depth,
+    ])
+    floats = jnp.concatenate([
+        t.split_gain, t.internal_value, t.internal_weight,
+        t.internal_count, t.leaf_value, t.leaf_weight, t.leaf_count,
+    ])
+    return ints, floats
+
+
+def fetch_tree_arrays(t: TreeArrays) -> TreeArrays:
+    """Device TreeArrays -> host (numpy) TreeArrays via two transfers."""
+    import numpy as np
+    ints_d, floats_d = _pack_tree_device(t)
+    ints = np.asarray(ints_d)
+    floats = np.asarray(floats_d)
+    L = t.leaf_value.shape[0]
+    n = L - 1
+
+    def take(buf, pos, count, shape=None):
+        out = buf[pos:pos + count]
+        return (out.reshape(shape) if shape else out), pos + count
+
+    p = 0
+    num_leaves, p = take(ints, p, 1)
+    split_feature, p = take(ints, p, n)
+    threshold_bin, p = take(ints, p, n)
+    default_left, p = take(ints, p, n)
+    is_cat, p = take(ints, p, n)
+    cat_bitset, p = take(ints, p, n * 8, (n, 8))
+    left_child, p = take(ints, p, n)
+    right_child, p = take(ints, p, n)
+    leaf_parent, p = take(ints, p, L)
+    leaf_depth, p = take(ints, p, L)
+    q = 0
+    split_gain, q = take(floats, q, n)
+    internal_value, q = take(floats, q, n)
+    internal_weight, q = take(floats, q, n)
+    internal_count, q = take(floats, q, n)
+    leaf_value, q = take(floats, q, L)
+    leaf_weight, q = take(floats, q, L)
+    leaf_count, q = take(floats, q, L)
+    return TreeArrays(
+        num_leaves=int(num_leaves[0]),
+        split_feature=split_feature, threshold_bin=threshold_bin,
+        default_left=default_left.astype(bool),
+        is_cat=is_cat.astype(bool),
+        cat_bitset=cat_bitset.astype(np.uint32),
+        left_child=left_child, right_child=right_child,
+        split_gain=split_gain, internal_value=internal_value,
+        internal_weight=internal_weight, internal_count=internal_count,
+        leaf_value=leaf_value, leaf_weight=leaf_weight,
+        leaf_count=leaf_count, leaf_parent=leaf_parent,
+        leaf_depth=leaf_depth,
+    )
 
 
 class _GrowState(NamedTuple):
@@ -140,11 +215,19 @@ class CommHooks(NamedTuple):
     reduces root scalar stats; ``merge_split(info)`` merges per-shard
     SplitInfos by max gain (feature-parallel: SyncUpGlobalBestSplit,
     parallel_tree_learner.h:356-397).  All default to identity (serial).
+
+    ``no_subtract=True`` disables the parent-minus-smaller histogram trick
+    and builds BOTH children's histograms from data.  Required whenever
+    ``reduce_hist`` is not a plain linear reduction over a fixed feature
+    set (voting-parallel: each call's vote elects a different feature
+    subset, so parent and child histograms are masked inconsistently and
+    their difference is meaningless).
     """
     reduce_hist: object = None
     reduce_stats: object = None
     merge_split: object = None
     shard_feature_mask: object = None
+    no_subtract: bool = False
 
 
 def make_grow_tree(num_bins: int, params: GrowerParams,
@@ -165,8 +248,13 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
     sp = p.split
 
     def hist_of(bins, grad, hess, member, G, H, C, fmeta):
-        w = jnp.stack([grad * member, hess * member, member])
-        out = histogram_chunked(bins, w, B, p.row_chunk)
+        if p.feature_major:
+            from ..ops.pallas_histogram import leaf_histogram_pallas
+            out = leaf_histogram_pallas(bins, grad, hess, member, B,
+                                        p.row_chunk)
+        else:
+            w = jnp.stack([grad * member, hess * member, member])
+            out = histogram_chunked(bins, w, B, p.row_chunk)
         if comm.reduce_hist is not None:
             out = comm.reduce_hist(out, G, H, C, fmeta)
         return out
@@ -192,7 +280,10 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
         )
 
     def grow(bins, grad, hess, member, fmeta: FeatureMeta, feature_mask, key):
-        n, F = bins.shape
+        if p.feature_major:
+            F, n = bins.shape
+        else:
+            n, F = bins.shape
         if comm.shard_feature_mask is not None:
             feature_mask = comm.shard_feature_mask(feature_mask)
 
@@ -207,7 +298,12 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             cat = st.best_is_cat[leaf]
             bitset = st.best_cat_bitset[leaf]
 
-            fcol = lax.dynamic_slice_in_dim(bins, f, 1, axis=1)[:, 0]
+            if p.feature_major:
+                # contiguous [1, N] stream — far cheaper than the strided
+                # row-major column gather
+                fcol = lax.dynamic_slice_in_dim(bins, f, 1, axis=0)[0, :]
+            else:
+                fcol = lax.dynamic_slice_in_dim(bins, f, 1, axis=1)[:, 0]
             go_left = routed_left(fcol, t, dl, cat, bitset,
                                   fmeta.missing_type[f], fmeta.default_bin[f],
                                   fmeta.num_bin[f])
@@ -219,18 +315,27 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             Gp, Hp, Cp = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
             Gr, Hr, Cr = Gp - Gl, Hp - Hl, Cp - Cl
 
-            smaller_is_left = Cl <= Cr
-            smaller = jnp.where(smaller_is_left, leaf, new_leaf)
-            mem_small = (leaf_id == smaller).astype(grad.dtype) * member
-            Gs = jnp.where(smaller_is_left, Gl, Gr)
-            Hs = jnp.where(smaller_is_left, Hl, Hr)
-            Cs = jnp.where(smaller_is_left, Cl, Cr)
-            hist_small = hist_of(bins, grad, hess, mem_small, Gs, Hs, Cs,
-                                 fmeta)
-            hist_parent = st.leaf_hist[leaf]
-            hist_large = hist_parent - hist_small
-            hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
-            hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
+            if comm.no_subtract:
+                mem_l = (leaf_id == leaf).astype(grad.dtype) * member
+                mem_r = (leaf_id == new_leaf).astype(grad.dtype) * member
+                hist_left = hist_of(bins, grad, hess, mem_l, Gl, Hl, Cl,
+                                    fmeta)
+                hist_right = hist_of(bins, grad, hess, mem_r, Gr, Hr, Cr,
+                                     fmeta)
+            else:
+                smaller_is_left = Cl <= Cr
+                smaller = jnp.where(smaller_is_left, leaf, new_leaf)
+                mem_small = (leaf_id == smaller).astype(grad.dtype) * member
+                Gs = jnp.where(smaller_is_left, Gl, Gr)
+                Hs = jnp.where(smaller_is_left, Hl, Hr)
+                Cs = jnp.where(smaller_is_left, Cl, Cr)
+                hist_small = hist_of(bins, grad, hess, mem_small, Gs, Hs, Cs,
+                                     fmeta)
+                hist_parent = st.leaf_hist[leaf]
+                hist_large = hist_parent - hist_small
+                hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
+                hist_right = jnp.where(smaller_is_left, hist_large,
+                                       hist_small)
             leaf_hist = (st.leaf_hist.at[leaf].set(hist_left)
                          .at[new_leaf].set(hist_right))
 
@@ -333,9 +438,9 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             leaf_depth=jnp.zeros(L, dtype=jnp.int32),
         )
         st = _GrowState(
-            leaf_id=jnp.zeros(bins.shape[0], dtype=jnp.int32),
+            leaf_id=jnp.zeros(n, dtype=jnp.int32),
             num_leaves=jnp.int32(1),
-            leaf_hist=jnp.zeros((L, bins.shape[1], B, 3), dtype=jnp.float32)
+            leaf_hist=jnp.zeros((L, F, B, 3), dtype=jnp.float32)
                          .at[0].set(root_hist),
             leaf_g=zeros_l.at[0].set(G0),
             leaf_h=zeros_l.at[0].set(H0),

@@ -1,0 +1,244 @@
+"""Pallas TPU histogram kernels — the performance core.
+
+Replaces the reference's OpenCL local-atomic kernels
+(src/treelearner/ocl/histogram{16,64,256}.cl) and its 4-way unrolled CPU
+loop (src/io/dense_bin.hpp:69-193) with a TPU-native formulation:
+
+  * bins live feature-major ``[F, N]`` so each feature's stream is
+    contiguous on the lane axis;
+  * the per-feature one-hot ``[B, rows]`` is built with int32 VPU compares
+    (v5e supports only 32-bit vector compares) and *never leaves VMEM*;
+  * the (grad, hess, count) contraction runs on the MXU as a bf16 matmul
+    with f32 accumulation.  Gradients/hessians are carried as bf16 hi+lo
+    channel pairs (``pack_channels``), giving ~16 mantissa bits — the same
+    single-precision stance as the reference GPU learner's default
+    ``gpu_use_dp=false`` (src/treelearner/gpu_tree_learner.cpp:677), with
+    the count channel exact in f32 accumulation.
+
+Two kernels share the inner body:
+
+  * ``histogram_all``: every row block contributes (the root / full-data
+    case);
+  * ``histogram_segment``: a scalar-prefetched ``(start_block, n_blocks,
+    target_leaf)`` descriptor restricts DMA *and* compute to the blocks of
+    one leaf's confinement interval — the TPU equivalent of the reference's
+    ordered bins (src/io/ordered_sparse_bin.hpp) whose histogram cost is
+    proportional to the leaf, not the dataset.  Out-of-range grid steps
+    re-map to the last in-range block, so the pipeline issues no new DMA
+    for them, and ``pl.when`` skips their compute.
+
+The 8 weight channels are ``[g_hi, g_lo, h_hi, h_lo, member, 0, 0, 0]``;
+``unpack_hist`` folds them back to the ``[F, B, 3]`` (sum_grad, sum_hess,
+count) layout the split scan consumes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NUM_CHANNELS = 8
+DEFAULT_BLOCK_ROWS = 8192
+# VMEM working-set budget for auto block sizing (bytes, of ~16MB/core)
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def supported(num_features: int, num_bins: int, dtype) -> bool:
+    """Whether the kernels handle this shape (else callers fall back to the
+    XLA one-hot path in ops/histogram.py)."""
+    if dtype not in (jnp.uint8, jnp.int8):
+        return False
+    if num_bins > 256:
+        return False
+    # accumulator [F, 8, B] f32 must fit VMEM alongside the streams
+    if num_features * NUM_CHANNELS * num_bins * 4 > 6 * 1024 * 1024:
+        return False
+    return True
+
+
+def pick_block_rows(num_features: int, num_bins: int) -> int:
+    """Largest power-of-two row block whose VMEM working set fits budget."""
+    acc = num_features * NUM_CHANNELS * num_bins * 4
+    rb = DEFAULT_BLOCK_ROWS
+    while rb > 512:
+        # double-buffered input blocks + one-hot + onehot-int copy
+        streams = 2 * rb * (num_features + 2 * NUM_CHANNELS + 4)
+        onehot = rb * num_bins * (2 + 4)
+        if acc + streams + onehot <= _VMEM_BUDGET:
+            return rb
+        rb //= 2
+    return rb
+
+
+def pack_channels(grad: jax.Array, hess: jax.Array,
+                  member: jax.Array) -> jax.Array:
+    """[N] f32 grad/hess/member -> [8, N] bf16 weight channels.
+
+    ``lax.reduce_precision`` performs the hi/lo split; a plain
+    f32->bf16->f32 round-trip is elided under XLA's
+    ``--xla_allow_excess_precision`` and would zero the lo channel.
+    """
+    gm = grad * member
+    hm = hess * member
+    g_hi = lax.reduce_precision(gm, 8, 7)
+    h_hi = lax.reduce_precision(hm, 8, 7)
+    g_lo = (gm - g_hi).astype(jnp.bfloat16)
+    h_lo = (hm - h_hi).astype(jnp.bfloat16)
+    z = jnp.zeros(gm.shape, jnp.bfloat16)
+    return jnp.stack([g_hi.astype(jnp.bfloat16), g_lo,
+                      h_hi.astype(jnp.bfloat16), h_lo,
+                      member.astype(jnp.bfloat16), z, z, z])
+
+
+def unpack_hist(out: jax.Array) -> jax.Array:
+    """[F, 8, B] channel sums -> [F, B, 3] (sum_grad, sum_hess, count)."""
+    g = out[:, 0] + out[:, 1]
+    h = out[:, 2] + out[:, 3]
+    c = out[:, 4]
+    return jnp.stack([g, h, c], axis=-1)
+
+
+def _accumulate_block(binsT_ref, w, acc_ref, num_bins):
+    """Shared inner body: one [F, rb] bin block x [8, rb] weights."""
+    F, rb = binsT_ref.shape
+    b = binsT_ref[:].astype(jnp.int32)
+    iota = lax.broadcasted_iota(jnp.int32, (num_bins, rb), 0)
+    for f in range(F):
+        onehot = (b[f:f + 1, :] == iota).astype(jnp.bfloat16)  # [B, rb]
+        acc_ref[f] += lax.dot_general(
+            w, onehot, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    _accumulate_block(binsT_ref, w_ref[:], acc_ref, acc_ref.shape[2])
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+def _kernel_segment(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref):
+    # sref: prefetched [3] i32 = (start_block, n_blocks, target_leaf)
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(i < sref[1])
+    def _():
+        mask = (lid_ref[:] == sref[2]).astype(jnp.bfloat16)    # [1, rb]
+        _accumulate_block(binsT_ref, w_ref[:] * mask, acc_ref,
+                          acc_ref.shape[2])
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block_rows", "interpret"))
+def histogram_all(binsT: jax.Array, w8: jax.Array, num_bins: int,
+                  block_rows: int = 0,
+                  interpret: bool | None = None) -> jax.Array:
+    """Full-data histogram: [F, Npad] bins x [8, Npad] channels -> [F, 8, B].
+
+    Npad must be a multiple of ``block_rows``; pad rows must carry zero
+    weight channels (the bin values there may be anything).
+    """
+    F, n = binsT.shape
+    if block_rows <= 0:
+        block_rows = pick_block_rows(F, num_bins)
+    if interpret is None:
+        interpret = _interpret_default()
+    assert n % block_rows == 0, (n, block_rows)
+    return pl.pallas_call(
+        _kernel_all,
+        out_shape=jax.ShapeDtypeStruct((F, NUM_CHANNELS, num_bins),
+                                       jnp.float32),
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec((F, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((NUM_CHANNELS, block_rows), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((F, NUM_CHANNELS, num_bins),
+                               lambda i: (0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((F, NUM_CHANNELS, num_bins),
+                                   jnp.float32)],
+        interpret=interpret,
+    )(binsT, w8)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block_rows", "interpret"))
+def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
+                      start_block: jax.Array, n_blocks: jax.Array,
+                      target_leaf: jax.Array, num_bins: int,
+                      block_rows: int = 0,
+                      interpret: bool | None = None) -> jax.Array:
+    """Histogram of one leaf, scanning only its confinement blocks.
+
+    ``leaf_id`` is [Npad] i32 row->leaf; rows outside the leaf (or padding,
+    which must carry zero weights) contribute nothing.  DMA and compute are
+    proportional to ``n_blocks``, not N.
+    """
+    F, n = binsT.shape
+    if block_rows <= 0:
+        block_rows = pick_block_rows(F, num_bins)
+    if interpret is None:
+        interpret = _interpret_default()
+    assert n % block_rows == 0, (n, block_rows)
+    max_blocks = n // block_rows
+    scalars = jnp.stack([start_block, n_blocks, target_leaf]).astype(
+        jnp.int32)
+
+    def im_data(i, s):
+        blk = jnp.minimum(s[0] + jnp.minimum(i, jnp.maximum(s[1] - 1, 0)),
+                          max_blocks - 1)
+        return (0, blk)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(max_blocks,),
+        in_specs=[
+            pl.BlockSpec((F, block_rows), im_data),
+            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((1, block_rows), im_data),
+        ],
+        out_specs=pl.BlockSpec((F, NUM_CHANNELS, num_bins),
+                               lambda i, s: (0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((F, NUM_CHANNELS, num_bins),
+                                   jnp.float32)],
+    )
+    return pl.pallas_call(
+        _kernel_segment,
+        out_shape=jax.ShapeDtypeStruct((F, NUM_CHANNELS, num_bins),
+                                       jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(scalars, binsT, w8, leaf_id.reshape(1, -1))
+
+
+def leaf_histogram_pallas(binsT: jax.Array, grad: jax.Array,
+                          hess: jax.Array, member: jax.Array,
+                          num_bins: int, block_rows: int = 0) -> jax.Array:
+    """Drop-in [F, B, 3] leaf histogram matching ops.histogram semantics,
+    computed with the full-data pallas kernel."""
+    w8 = pack_channels(grad, hess, member)
+    return unpack_hist(histogram_all(binsT, w8, num_bins, block_rows))

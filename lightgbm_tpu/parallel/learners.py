@@ -94,6 +94,10 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
             stripe = (jnp.arange(F, dtype=jnp.int32) % D) == me
             return fmask * stripe.astype(fmask.dtype)
 
+        # TODO(perf): histograms are still built for ALL features on every
+        # shard (only the scan is striped); sharding construction itself
+        # needs the grower to histogram a per-shard feature slice while
+        # routing on the full matrix — tracked for the distributed phase.
         comm = CommHooks(
             merge_split=lambda info, gain: _merge_split_by_gain(
                 info, gain, axis),
@@ -116,9 +120,13 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
             # zeroed so their candidates mask out in the scan
             return lax.psum(h * mask[:, None, None], axis)
 
+        # votes differ per histogram call, so parent/child histograms carry
+        # different election masks; the subtraction trick is invalid here
+        # and both children must be histogrammed from data
         comm = CommHooks(
             reduce_hist=reduce_voted,
-            reduce_stats=lambda x: lax.psum(x, axis))
+            reduce_stats=lambda x: lax.psum(x, axis),
+            no_subtract=True)
         in_specs = (P(axis, None), P(axis), P(axis), P(axis), repl, repl,
                     repl)
         out_specs = (repl, P(axis))

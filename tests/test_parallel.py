@@ -65,6 +65,16 @@ def test_voting_parallel_trains(rng):
     assert mse < 0.15 * y.var()
 
 
+def test_voting_parallel_active_mask(rng):
+    """top_k small enough that 2*top_k < num_features, so the election
+    mask actually restricts candidates (the regression that shipped with
+    an all-ones mask went unseen)."""
+    X, y = make_data(rng, n=4000)
+    vot = _train(X, y, "voting", top_k=2)
+    mse = float(np.mean((vot.predict(X) - y) ** 2))
+    assert mse < 0.2 * y.var()
+
+
 def test_data_parallel_uneven_rows(rng):
     # 2003 % 8 != 0: exercises the zero-member row padding
     X, y = make_data(rng, n=2003)
