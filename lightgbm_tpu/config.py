@@ -169,6 +169,7 @@ _PARAMS: Dict[str, _P] = {
     "gpu_use_dp": _P(False),
     # -- tpu-specific (new in this framework) --
     "tpu_histogram_backend": _P("auto"),   # auto | onehot | pallas
+    "tpu_tree_impl": _P("auto"),           # auto | fused | segment
     "tpu_row_chunk": _P(0),                # 0 = auto-pick row chunk for histogram scan
     "tpu_double_precision": _P(False),     # accumulate histograms in f64-equivalent
 }

@@ -23,6 +23,9 @@ from .gbdt import GBDT
 
 
 class DART(GBDT):
+
+    # mutates freshly-grown trees right after each iteration
+    _async_trees = False
     def __init__(self, config, train_set, objective=None):
         super().__init__(config, train_set, objective)
         self._drop_rng = np.random.RandomState(config.drop_seed)

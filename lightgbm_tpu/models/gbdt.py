@@ -25,7 +25,8 @@ from ..config import Config
 from ..core.dataset import TpuDataset
 from ..ops.split import FeatureMeta, SplitParams
 from ..utils.log import check, log_fatal, log_info, log_warning
-from .grower import GrowerParams, fetch_tree_arrays, make_grow_tree
+from .grower import (GrowerParams, _pack_tree_device, fetch_tree_arrays,
+                     make_grow_tree, unpack_tree_buffers)
 from .tree import Tree
 
 
@@ -52,6 +53,14 @@ def _add_tree_score(score, leaf_values, leaf_id):
     return score + leaf_values[leaf_id]
 
 
+@jax.jit
+def _apply_tree_score(score, leaf_values, leaf_id, shrinkage):
+    """Device-side score update straight from the grower's output — no host
+    round-trip in the training loop (shrinkage folded in here; the stored
+    model applies it at materialization)."""
+    return score + shrinkage * leaf_values[leaf_id]
+
+
 class GBDT:
     """Gradient Boosted Decision Trees (boosting='gbdt')."""
 
@@ -60,7 +69,11 @@ class GBDT:
         self.config = config
         self.objective = objective
         self.train_set: Optional[TpuDataset] = None
-        self.models: List[Tree] = []            # flat: iter-major, class-minor
+        self._models: List[Tree] = []           # flat: iter-major, class-minor
+        # finished trees whose device->host transfer is still in flight:
+        # list of (ints_dev, floats_dev, shrinkage) in iteration order
+        self._pending: List[tuple] = []
+        self._stop_flag = False
         self.num_tree_per_iteration = (
             objective.num_tree_per_iteration if objective is not None
             else max(1, config.num_class))
@@ -146,6 +159,8 @@ class GBDT:
                 max_cat_threshold=cfg.max_cat_threshold,
                 max_cat_to_onehot=cfg.max_cat_to_onehot,
                 min_data_per_group=cfg.min_data_per_group))
+        impl = str(cfg.tpu_tree_impl).strip().lower()
+        self._use_segment = (backend == "pallas" and impl != "fused")
         tl = str(cfg.tree_learner).strip().lower()
         if tl in ("data", "data_parallel", "feature", "feature_parallel",
                   "voting", "voting_parallel"):
@@ -172,6 +187,13 @@ class GBDT:
                     self.num_bins, self.grower_params, mesh, tl,
                     top_k=cfg.top_k)
                 self._mesh = mesh
+        elif self._use_segment and impl in ("auto", "segment"):
+            from ..ops.pallas_histogram import pick_block_rows as _pbr
+            from .grower_seg import make_grow_tree_segment
+            seg_rb = (cfg.tpu_row_chunk if cfg.tpu_row_chunk > 0 else
+                      _pbr(train_set.num_used_features, self.num_bins))
+            self._grow_fn = make_grow_tree_segment(
+                self.num_bins, self.grower_params, seg_rb)
         else:
             self._grow_fn = make_grow_tree(self.num_bins, self.grower_params)
         C = self.num_tree_per_iteration
@@ -275,11 +297,90 @@ class GBDT:
             return g[None, :], h[None, :]
         return self.objective.get_gradients(self.train_score)
 
+    # trees may be fetched asynchronously (pipeline depth 1) when nothing
+    # needs them on the host mid-iteration; DART/RF mutate freshly-grown
+    # trees and opt out
+    _async_trees = True
+
+    @property
+    def models(self) -> List[Tree]:
+        self._flush_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value) -> None:
+        self._models = list(value)
+        self._pending = []
+
+    def _flush_pending(self, keep_latest: int = 0) -> None:
+        """Materialize in-flight trees (oldest first) into self._models.
+
+        A fully-constant iteration means training stopped there: its trees
+        and every later pending iteration's are discarded (their score
+        deltas undone), matching the reference's drop of the all-constant
+        iteration (gbdt.cpp:543-551) — just detected one iteration late.
+        """
+        while len(self._pending) > keep_latest:
+            iter_idx, items = self._pending.pop(0)
+            trees = []
+            all_const = True
+            for (ints_d, floats_d, lr) in items:
+                arrays = unpack_tree_buffers(
+                    np.asarray(ints_d), np.asarray(floats_d),
+                    self.grower_params.num_leaves)
+                if int(arrays.num_leaves) <= 1:
+                    trees.append(Tree(1))
+                else:
+                    all_const = False
+                    tree = Tree.from_arrays(arrays, self.train_set)
+                    tree.apply_shrinkage(lr)
+                    trees.append(tree)
+            if all_const:
+                self._undo_pending_scores([(iter_idx, trees)]
+                                          + self._materialize_rest())
+                self._pending = []
+                self._stop_flag = True
+                self.iter_ = iter_idx
+                log_warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                return
+            self._models.extend(trees)
+
+    def _materialize_rest(self):
+        out = []
+        for iter_idx, items in self._pending:
+            trees = []
+            for (ints_d, floats_d, lr) in items:
+                arrays = unpack_tree_buffers(
+                    np.asarray(ints_d), np.asarray(floats_d),
+                    self.grower_params.num_leaves)
+                if int(arrays.num_leaves) <= 1:
+                    trees.append(Tree(1))
+                else:
+                    tree = Tree.from_arrays(arrays, self.train_set)
+                    tree.apply_shrinkage(lr)
+                    trees.append(tree)
+            out.append((iter_idx, trees))
+        return out
+
+    def _undo_pending_scores(self, iter_trees) -> None:
+        """Subtract discarded iterations' contributions from train_score
+        (rare: only when stop is detected late under bagging randomness)."""
+        infos = self.train_set.feature_infos()
+        for _, trees in iter_trees:
+            for k, tree in enumerate(trees):
+                if tree.num_leaves > 1:
+                    delta = tree.predict_binned(self.train_set.binned, infos)
+                    self.train_score = self.train_score.at[k].add(
+                        -jnp.asarray(delta, dtype=jnp.float32))
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True if training should stop
         (no further splits possible), matching LGBM_BoosterUpdateOneIter
         semantics."""
+        if self._stop_flag:
+            return True
         self._boost_from_average()
         C = self.num_tree_per_iteration
         if grad is None or hess is None:
@@ -292,6 +393,45 @@ class GBDT:
             hesss = jnp.asarray(np.asarray(hess, dtype=np.float32)
                                 .reshape(C, self.num_data))
         grads, hesss = self._bagging(self.iter_, grads, hesss)
+
+        use_async = (self._async_trees and not self.valid_sets
+                     and (self.objective is None
+                          or not self.objective.is_renew_tree_output))
+        if use_async:
+            items = []
+            for k in range(C):
+                fmask = self._tree_feature_mask()
+                self._key, sub = jax.random.split(self._key)
+                g_k, h_k, member = grads[k], hesss[k], self.bag_weight
+                if self._row_pad:
+                    g_k = jnp.pad(g_k, (0, self._row_pad))
+                    h_k = jnp.pad(h_k, (0, self._row_pad))
+                    member = jnp.pad(member, (0, self._row_pad))
+                arrays, leaf_id = self._grow_fn(
+                    self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
+                if self._row_pad:
+                    leaf_id = leaf_id[: self.num_data]
+                self.train_score = self.train_score.at[k].set(
+                    _apply_tree_score(self.train_score[k],
+                                      arrays.leaf_value, leaf_id,
+                                      jnp.float32(self.shrinkage_rate)))
+                ints_d, floats_d = _pack_tree_device(arrays)
+                for buf in (ints_d, floats_d):
+                    copy_async = getattr(buf, "copy_to_host_async", None)
+                    if copy_async is not None:
+                        try:
+                            copy_async()
+                        except Exception:
+                            pass
+                items.append((ints_d, floats_d, self.shrinkage_rate))
+            self._pending.append((self.iter_, items))
+            self.iter_ += 1
+            # materialize older iterations; the newest stays in flight so
+            # its fetch overlaps the next iteration's device work
+            self._flush_pending(keep_latest=1)
+            if self._stop_flag:
+                return True
+            return False
 
         should_stop = True
         infos = self.train_set.feature_infos()
@@ -323,10 +463,14 @@ class GBDT:
                 tree.set_leaf_values(self.objective.renew_tree_output(
                     tree.leaf_value, leaf_np, score_np))
             tree.apply_shrinkage(self.shrinkage_rate)
-            # device score update via the grower's leaf assignment
-            lv = jnp.asarray(tree.leaf_value, dtype=jnp.float32)
+            # device score update via the grower's leaf assignment; pad the
+            # leaf values to the static num_leaves so _add_tree_score
+            # compiles once, not once per distinct tree size
+            lv_np = np.zeros(self.grower_params.num_leaves, dtype=np.float32)
+            lv_np[:nl] = tree.leaf_value[:nl]
             self.train_score = self.train_score.at[k].set(
-                _add_tree_score(self.train_score[k], lv, leaf_id))
+                _add_tree_score(self.train_score[k], jnp.asarray(lv_np),
+                                leaf_id))
             for (vname, vset), vscore in zip(self.valid_sets,
                                              self.valid_scores):
                 vscore[k] += tree.predict_binned(vset.binned, infos)

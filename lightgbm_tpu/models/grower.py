@@ -102,9 +102,13 @@ def fetch_tree_arrays(t: TreeArrays) -> TreeArrays:
     """Device TreeArrays -> host (numpy) TreeArrays via two transfers."""
     import numpy as np
     ints_d, floats_d = _pack_tree_device(t)
-    ints = np.asarray(ints_d)
-    floats = np.asarray(floats_d)
-    L = t.leaf_value.shape[0]
+    return unpack_tree_buffers(np.asarray(ints_d), np.asarray(floats_d),
+                               t.leaf_value.shape[0])
+
+
+def unpack_tree_buffers(ints, floats, L: int) -> TreeArrays:
+    """Host-side inverse of _pack_tree_device."""
+    import numpy as np
     n = L - 1
 
     def take(buf, pos, count, shape=None):

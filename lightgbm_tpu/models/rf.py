@@ -16,6 +16,9 @@ from .gbdt import GBDT
 
 
 class RF(GBDT):
+
+    # mutates freshly-grown trees right after each iteration
+    _async_trees = False
     average_output = True
 
     def __init__(self, config, train_set, objective=None):
