@@ -70,13 +70,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
                                       for i in range(len(valid_sets))]
         for vs, name in zip(valid_sets, valid_names):
             if vs is train_set:
-                booster._valid_names.append("training")
-                booster.gbdt.valid_sets.append(("training", None))
-                booster.gbdt.valid_scores.append(None)
+                # the train set as a valid set is evaluated through the
+                # train-score buffer under the name "training" (reference
+                # engine.py:141-147); no separate score buffer exists
+                booster._train_in_valid = True
                 continue
             vs.reference = train_set
             booster.add_valid(vs, name)
-        # re-wire: 'training' placeholder handled during eval below
     callbacks = list(callbacks or [])
     if verbose_eval is True:
         callbacks.append(callback_mod.print_evaluation())
@@ -95,7 +95,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
-    train_in_valid = any(n == "training" for n in booster._valid_names)
+    train_in_valid = getattr(booster, "_train_in_valid", False)
 
     for i in range(num_boost_round):
         for cb in callbacks_before:
@@ -109,9 +109,6 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if booster._valid_names or train_in_valid:
             if train_in_valid:
                 evaluation_result_list.extend(booster.eval_train(feval))
-            for idx, name in enumerate(booster._valid_names):
-                if name == "training":
-                    continue
             evaluation_result_list.extend(booster.eval_valid(feval))
         try:
             for cb in callbacks_after:

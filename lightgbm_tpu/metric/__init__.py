@@ -193,7 +193,6 @@ class AUCMetric(Metric):
         if pos_w <= 0 or neg_w <= 0:
             log_warning("AUC is undefined with a single class")
             return 1.0
-        cum_neg = np.cumsum(w * (y <= 0))
         # handle ties: group by unique score, use half credit within a group
         _, first_idx, inv = np.unique(s, return_index=True, return_inverse=True)
         grp_neg = np.add.reduceat(w * (y <= 0), first_idx)

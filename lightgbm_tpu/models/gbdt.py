@@ -92,7 +92,7 @@ class GBDT:
             self.reset_train_data(train_set)
 
     # ----------------------------------------------------------------- setup
-    def _resolve_hist_backend(self) -> str:
+    def _resolve_hist_backend(self, parallel: bool) -> str:
         """auto -> pallas on TPU when the kernel supports the shape
         (ops/pallas_histogram.supported); parallel learners and explicit
         double-precision requests stay on the XLA one-hot path."""
@@ -100,9 +100,6 @@ class GBDT:
         choice = str(cfg.tpu_histogram_backend).strip().lower()
         if choice == "onehot":
             return "onehot"
-        tl = str(cfg.tree_learner).strip().lower()
-        parallel = tl in ("data", "data_parallel", "feature",
-                          "feature_parallel", "voting", "voting_parallel")
         if choice == "pallas" or choice == "auto":
             import jax
             from ..ops.pallas_histogram import supported
@@ -124,7 +121,6 @@ class GBDT:
         return "onehot"
 
     def reset_train_data(self, train_set: TpuDataset) -> None:
-        check(train_set.num_used_features > 0 or True, "")
         self.train_set = train_set
         self.num_data = train_set.num_data
         self.feature_names = list(train_set.feature_names)
@@ -133,7 +129,26 @@ class GBDT:
         self._row_pad = 0
         self.num_bins = _round_up_pow2(max(train_set.max_num_bin, 2))
         cfg = self.config
-        backend = self._resolve_hist_backend()
+        # Resolve the parallel layout FIRST so the histogram backend is
+        # chosen for the learner that actually runs: a parallel request on
+        # a single-device mesh falls back to the serial learner and must
+        # keep the pallas/segment fast path (ADVICE.md round 1).
+        tl = str(cfg.tree_learner).strip().lower()
+        parallel = tl in ("data", "data_parallel", "feature",
+                          "feature_parallel", "voting", "voting_parallel")
+        mesh = None
+        if parallel:
+            from ..parallel import network
+            # num_machines=1 (the default) means "use every device on the
+            # mesh" — the TPU runtime already knows the slice topology
+            mesh = network.init(cfg.num_machines if cfg.num_machines > 1
+                                else 0)
+            if mesh.devices.size <= 1:
+                log_warning("Only one device available; using the serial "
+                            "tree learner")
+                parallel = False
+                mesh = None
+        backend = self._resolve_hist_backend(parallel)
         if backend == "pallas":
             from ..ops.pallas_histogram import pick_block_rows
             rb = (cfg.tpu_row_chunk if cfg.tpu_row_chunk > 0 else
@@ -161,32 +176,26 @@ class GBDT:
                 min_data_per_group=cfg.min_data_per_group))
         impl = str(cfg.tpu_tree_impl).strip().lower()
         self._use_segment = (backend == "pallas" and impl != "fused")
-        tl = str(cfg.tree_learner).strip().lower()
-        if tl in ("data", "data_parallel", "feature", "feature_parallel",
-                  "voting", "voting_parallel"):
-            from ..parallel import network
-            from ..parallel.learners import make_parallel_grower
-            # num_machines=1 (the default) means "use every device on the
-            # mesh" — the TPU runtime already knows the slice topology
-            mesh = network.init(cfg.num_machines if cfg.num_machines > 1
-                                else 0)
-            if mesh.devices.size <= 1:
-                log_warning("Only one device available; using the serial "
-                            "tree learner")
-                self._grow_fn = make_grow_tree(self.num_bins,
-                                               self.grower_params)
+        if impl == "segment" and not self._use_segment:
+            if parallel:
+                log_warning("tpu_tree_impl=segment is serial-only; using "
+                            "the parallel tree learner's fused grower")
             else:
-                D = int(mesh.devices.size)
-                # pad rows to a multiple of the mesh size; pad rows carry
-                # zero membership weight so they never contribute
-                pad = (-self.num_data) % D
-                if pad:
-                    self.bins = jnp.pad(self.bins, ((0, pad), (0, 0)))
-                    self._row_pad = pad
-                self._grow_fn = make_parallel_grower(
-                    self.num_bins, self.grower_params, mesh, tl,
-                    top_k=cfg.top_k)
-                self._mesh = mesh
+                log_warning("tpu_tree_impl=segment requires the pallas "
+                            "histogram backend; using the fused grower")
+        if parallel:
+            from ..parallel.learners import make_parallel_grower
+            D = int(mesh.devices.size)
+            # pad rows to a multiple of the mesh size; pad rows carry
+            # zero membership weight so they never contribute
+            pad = (-self.num_data) % D
+            if pad:
+                self.bins = jnp.pad(self.bins, ((0, pad), (0, 0)))
+                self._row_pad = pad
+            self._grow_fn = make_parallel_grower(
+                self.num_bins, self.grower_params, mesh, tl,
+                top_k=cfg.top_k)
+            self._mesh = mesh
         elif self._use_segment and impl in ("auto", "segment"):
             from ..ops.pallas_histogram import pick_block_rows as _pbr
             from .grower_seg import make_grow_tree_segment
@@ -488,6 +497,7 @@ class GBDT:
 
     def rollback_one_iter(self) -> None:
         """Remove the last iteration's trees and scores (gbdt.cpp:553-576)."""
+        self._flush_pending()
         if self.iter_ <= 0:
             return
         C = self.num_tree_per_iteration
@@ -505,6 +515,9 @@ class GBDT:
 
     # ------------------------------------------------------------ prediction
     def current_iteration(self) -> int:
+        # flush in-flight trees first: a trailing all-constant iteration is
+        # detected (and iter_ lowered) only at materialization time
+        self._flush_pending()
         return self.iter_
 
     @property
@@ -513,6 +526,7 @@ class GBDT:
 
     def _raw_predict(self, X: np.ndarray, num_iteration: int = -1,
                      start_iteration: int = 0) -> np.ndarray:
+        self._flush_pending()
         C = self.num_tree_per_iteration
         n_iter = self.iter_ if num_iteration <= 0 else min(num_iteration,
                                                            self.iter_)
@@ -527,6 +541,7 @@ class GBDT:
     def predict(self, X: np.ndarray, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False) -> np.ndarray:
+        self._flush_pending()
         X = np.asarray(X, dtype=np.float64)
         if X.ndim == 1:
             X = X[None, :]
@@ -597,6 +612,7 @@ class GBDT:
                            iteration: int = -1) -> np.ndarray:
         """split counts or total gains per original feature
         (gbdt.h FeatureImportance)."""
+        self._flush_pending()
         n_feat = self.max_feature_idx + 1
         out = np.zeros(n_feat, dtype=np.float64)
         C = self.num_tree_per_iteration

@@ -223,6 +223,9 @@ class LoadedBoosting:
     def current_iteration(self) -> int:
         return self.iter_
 
+    def _flush_pending(self, keep_latest: int = 0) -> None:
+        """No async tree pipeline on a loaded model (GBDT API compat)."""
+
     def _raw_predict(self, X, num_iteration=-1, start_iteration=0):
         from .gbdt import GBDT
         return GBDT._raw_predict(self, X, num_iteration, start_iteration)

@@ -55,14 +55,18 @@ def supported(num_features: int, num_bins: int, dtype) -> bool:
         return False
     if num_bins > 256:
         return False
-    # accumulator [F, 8, B] f32 must fit VMEM alongside the streams
-    if num_features * NUM_CHANNELS * num_bins * 4 > 6 * 1024 * 1024:
+    # accumulator [F, 8, B] f32 must fit VMEM alongside the streams;
+    # size with F rounded up to a multiple of 4 — the segment grower pads
+    # features to pack them into sort words, so that is the real footprint
+    F4 = -(-num_features // 4) * 4
+    if F4 * NUM_CHANNELS * num_bins * 4 > 6 * 1024 * 1024:
         return False
     return True
 
 
 def pick_block_rows(num_features: int, num_bins: int) -> int:
     """Largest power-of-two row block whose VMEM working set fits budget."""
+    num_features = -(-num_features // 4) * 4
     acc = num_features * NUM_CHANNELS * num_bins * 4
     rb = DEFAULT_BLOCK_ROWS
     while rb > 512:

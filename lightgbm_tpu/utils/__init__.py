@@ -2,4 +2,29 @@ from .log import (LightGBMError, Timer, check, log_debug, log_fatal, log_info,
                   log_warning, register_log_callback, set_verbosity)
 
 __all__ = ["LightGBMError", "Timer", "check", "log_debug", "log_fatal",
-           "log_info", "log_warning", "register_log_callback", "set_verbosity"]
+           "log_info", "log_warning", "register_log_callback",
+           "set_verbosity", "cpu_subprocess_env"]
+
+
+def cpu_subprocess_env(n_virtual_devices: int = 0) -> dict:
+    """Environment for a child process that must run JAX on the CPU
+    platform, immune to the axon TPU sitecustomize (which registers the
+    TPU backend at interpreter start and pins JAX_PLATFORMS).
+
+    The child should additionally run ``jax.config.update('jax_platforms',
+    'cpu')`` before first backend use.  Shared by bench.py and
+    __graft_entry__.dryrun_multichip so the recipe lives in one place.
+    """
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip axon sitecustomize registration
+    flags = env.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if "xla_force_host_platform_device_count" not in f)
+    if n_virtual_devices > 0:
+        flags = (flags + " --xla_force_host_platform_device_count="
+                 f"{n_virtual_devices}").strip()
+    env["XLA_FLAGS"] = flags
+    return env
