@@ -113,11 +113,16 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
     jax.block_until_ready(booster.train_score)
     t_warm = time.time() - t0
 
+    from lightgbm_tpu.utils.phase import (GLOBAL_TIMER, maybe_start_profile,
+                                          maybe_stop_profile)
+    GLOBAL_TIMER.reset()   # phase summary covers only the measured window
+    maybe_start_profile()
     t0 = time.time()
     for _ in range(measure):
         booster.train_one_iter()
     jax.block_until_ready(booster.train_score)
     per_iter = (time.time() - t0) / measure
+    maybe_stop_profile()
 
     backend = jax.default_backend()
     impl = ("segment" if getattr(booster, "_use_segment", False)
@@ -126,6 +131,7 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
         f"bench phases [{backend}/{impl}, {n_rows} rows]: gen={t_gen:.1f}s "
         f"bin={t_bin:.1f}s setup={t_setup:.1f}s "
         f"warmup({warmup})={t_warm:.1f}s per_iter={per_iter:.4f}s\n")
+    sys.stderr.write("bench " + GLOBAL_TIMER.summary() + "\n")
     print(RESULT_TAG + json.dumps(
         {"per_iter": per_iter, "rows": n_rows, "backend": backend,
          "impl": impl}))

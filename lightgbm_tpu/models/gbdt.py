@@ -25,6 +25,7 @@ from ..config import Config
 from ..core.dataset import TpuDataset
 from ..ops.split import FeatureMeta, SplitParams
 from ..utils.log import check, log_fatal, log_info, log_warning
+from ..utils.phase import GLOBAL_TIMER as _PHASES
 from .grower import (GrowerParams, _pack_tree_device, fetch_tree_arrays,
                      make_grow_tree, unpack_tree_buffers)
 from .tree import Tree
@@ -148,21 +149,34 @@ class GBDT:
                             "tree learner")
                 parallel = False
                 mesh = None
-        backend = self._resolve_hist_backend(parallel)
+        # data-parallel keeps the segment fast path: rows shard cleanly and
+        # histograms reduce linearly; feature/voting (and an explicit
+        # fused-impl request) stay on the fused onehot grower, whose
+        # row-major sharded layout is incompatible with the feature-major
+        # pallas bins
+        impl = str(cfg.tpu_tree_impl).strip().lower()
+        data_mode = tl in ("data", "data_parallel") and impl != "fused"
+        D = int(mesh.devices.size) if parallel else 1
+        backend = self._resolve_hist_backend(parallel and not data_mode)
+        rb = 0
         if backend == "pallas":
             from ..ops.pallas_histogram import pick_block_rows
             rb = (cfg.tpu_row_chunk if cfg.tpu_row_chunk > 0 else
                   pick_block_rows(train_set.num_used_features,
-                                  self.num_bins))
-            self.bins = train_set.device_binned_T(rb)
+                                  self.num_bins, -(-self.num_data // D)))
+            # each shard's row count must be a whole number of blocks
+            self.bins = train_set.device_binned_T(rb * D)
             self._row_pad = int(self.bins.shape[1]) - self.num_data
         else:
             self.bins = train_set.device_binned()
+        # rb threads through as the single block size for BOTH the bin
+        # matrix padding and every kernel launch (grower + segment grower);
+        # re-picking it at a kernel call site could desync from the padding
         self.grower_params = GrowerParams(
             num_leaves=max(2, cfg.num_leaves),
             max_depth=cfg.max_depth,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
-            row_chunk=cfg.tpu_row_chunk,
+            row_chunk=rb,
             hist_backend=backend,
             split=SplitParams(
                 lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
@@ -173,19 +187,26 @@ class GBDT:
                 cat_smooth=cfg.cat_smooth, cat_l2=cfg.cat_l2,
                 max_cat_threshold=cfg.max_cat_threshold,
                 max_cat_to_onehot=cfg.max_cat_to_onehot,
-                min_data_per_group=cfg.min_data_per_group))
-        impl = str(cfg.tpu_tree_impl).strip().lower()
+                min_data_per_group=cfg.min_data_per_group,
+                has_cat=any(i.is_categorical
+                            for i in train_set.feature_infos())))
         self._use_segment = (backend == "pallas" and impl != "fused")
         if impl == "segment" and not self._use_segment:
             if parallel:
-                log_warning("tpu_tree_impl=segment is serial-only; using "
-                            "the parallel tree learner's fused grower")
+                log_warning("tpu_tree_impl=segment is unavailable for the "
+                            "feature/voting learners; using the fused "
+                            "grower")
             else:
                 log_warning("tpu_tree_impl=segment requires the pallas "
                             "histogram backend; using the fused grower")
-        if parallel:
+        if parallel and self._use_segment:
+            from ..parallel.learners import make_data_parallel_segment_grower
+            self._grow_fn = make_data_parallel_segment_grower(
+                self.num_bins, self.grower_params, mesh, rb,
+                train_set.num_used_features)
+            self._mesh = mesh
+        elif parallel:
             from ..parallel.learners import make_parallel_grower
-            D = int(mesh.devices.size)
             # pad rows to a multiple of the mesh size; pad rows carry
             # zero membership weight so they never contribute
             pad = (-self.num_data) % D
@@ -197,12 +218,9 @@ class GBDT:
                 top_k=cfg.top_k)
             self._mesh = mesh
         elif self._use_segment and impl in ("auto", "segment"):
-            from ..ops.pallas_histogram import pick_block_rows as _pbr
             from .grower_seg import make_grow_tree_segment
-            seg_rb = (cfg.tpu_row_chunk if cfg.tpu_row_chunk > 0 else
-                      _pbr(train_set.num_used_features, self.num_bins))
             self._grow_fn = make_grow_tree_segment(
-                self.num_bins, self.grower_params, seg_rb)
+                self.num_bins, self.grower_params, rb)
         else:
             self._grow_fn = make_grow_tree(self.num_bins, self.grower_params)
         C = self.num_tree_per_iteration
@@ -216,6 +234,10 @@ class GBDT:
         self._key = jax.random.PRNGKey(cfg.seed)
         self.bag_weight = jnp.ones(self.num_data, dtype=jnp.float32)
         self._boosted_from_average = False
+        self._full_fmask = jnp.ones(train_set.num_used_features,
+                                    dtype=jnp.float32)
+        self._fused_fns = None
+        self._obj_arrs = None
 
     def add_valid_data(self, name: str, valid_set: TpuDataset) -> None:
         C = self.num_tree_per_iteration
@@ -274,7 +296,7 @@ class GBDT:
         F = self.train_set.num_used_features
         frac = self.config.feature_fraction
         if frac >= 1.0:
-            return jnp.ones(F, dtype=jnp.float32)
+            return self._full_fmask
         k = max(1, int(F * frac))
         idx = self._feat_rng.choice(F, k, replace=False)
         mask = np.zeros(F, dtype=np.float32)
@@ -310,6 +332,76 @@ class GBDT:
     # needs them on the host mid-iteration; DART/RF mutate freshly-grown
     # trees and opt out
     _async_trees = True
+    # whole-iteration fusion (gradients + grow + score update in a single
+    # jitted dispatch per tree) — subclasses whose _bagging inspects or
+    # rewrites gradients on the host (GOSS) opt out
+    _fused_ok = True
+
+    def _build_fused_step(self):
+        """One jitted call per (gradient pass, per-class tree).  Keeping the
+        iteration to two dispatches matters on the remote-TPU transport,
+        where every eager op pays a round-trip; it is also the natural unit
+        for the driver's multichip dryrun."""
+        import functools
+        obj = self.objective
+        pad = self._row_pad
+        N = self.num_data
+        C = self.num_tree_per_iteration
+        grow_fn = self._grow_fn
+
+        # device-array state of the objective (labels, per-class weights,
+        # lambdarank bucket tables...) passed as explicit args: embedding
+        # them as jit constants would bloat the compiled program (and the
+        # remote-compile request) by O(N) bytes.  tree_flatten reaches
+        # arrays nested in lists/dicts (e.g. rank.py's bucket structures).
+        attr_leaves, attr_treedef = jax.tree_util.tree_flatten(
+            dict(vars(obj)),
+            is_leaf=lambda x: not isinstance(x, (list, tuple, dict)))
+        arr_pos = [i for i, x in enumerate(attr_leaves)
+                   if isinstance(x, jax.Array)]
+        self._obj_arrs = [attr_leaves[i] for i in arr_pos]
+
+        def _with_arrs(fn, arr_vals):
+            leaves = list(attr_leaves)
+            for i, v in zip(arr_pos, arr_vals):
+                leaves[i] = v
+            attrs = jax.tree_util.tree_unflatten(attr_treedef, leaves)
+            saved = {k: getattr(obj, k) for k in attrs}
+            for k, v in attrs.items():
+                setattr(obj, k, v)
+            try:
+                return fn()
+            finally:
+                for k, v in saved.items():
+                    setattr(obj, k, v)
+
+        @jax.jit
+        def fused_grad(score, arrs):
+            def run():
+                if C == 1:
+                    g, h = obj.get_gradients(score[0])
+                    return g[None], h[None]
+                return obj.get_gradients(score)
+            return _with_arrs(run, arrs)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fused_step(score, grads, hesss, member, bins, fmeta, fmask,
+                       sub, shrinkage, k):
+            g_k, h_k = grads[k], hesss[k]
+            if pad:
+                g_k = jnp.pad(g_k, (0, pad))
+                h_k = jnp.pad(h_k, (0, pad))
+                member = jnp.pad(member, (0, pad))
+            arrays, leaf_id = grow_fn(bins, g_k, h_k, member, fmeta,
+                                      fmask, sub)
+            if pad:
+                leaf_id = leaf_id[:N]
+            new_row = score[k] + shrinkage * arrays.leaf_value[leaf_id]
+            score = score.at[k].set(new_row)
+            ints_d, floats_d = _pack_tree_device(arrays)
+            return score, ints_d, floats_d
+
+        self._fused_fns = (fused_grad, fused_step)
 
     @property
     def models(self) -> List[Tree]:
@@ -392,20 +484,26 @@ class GBDT:
             return True
         self._boost_from_average()
         C = self.num_tree_per_iteration
-        if grad is None or hess is None:
-            if self.objective is None:
-                log_fatal("No objective and no custom gradients")
-            grads, hesss = self._gradients()
-        else:
-            grads = jnp.asarray(np.asarray(grad, dtype=np.float32)
-                                .reshape(C, self.num_data))
-            hesss = jnp.asarray(np.asarray(hess, dtype=np.float32)
-                                .reshape(C, self.num_data))
-        grads, hesss = self._bagging(self.iter_, grads, hesss)
-
         use_async = (self._async_trees and not self.valid_sets
                      and (self.objective is None
                           or not self.objective.is_renew_tree_output))
+        if (use_async and grad is None and self._fused_ok
+                and self.objective is not None):
+            return self._train_one_iter_fused()
+
+        with _PHASES.phase("boost") as box:
+            if grad is None or hess is None:
+                if self.objective is None:
+                    log_fatal("No objective and no custom gradients")
+                grads, hesss = self._gradients()
+            else:
+                grads = jnp.asarray(np.asarray(grad, dtype=np.float32)
+                                    .reshape(C, self.num_data))
+                hesss = jnp.asarray(np.asarray(hess, dtype=np.float32)
+                                    .reshape(C, self.num_data))
+            grads, hesss = self._bagging(self.iter_, grads, hesss)
+            box[0] = grads
+
         if use_async:
             items = []
             for k in range(C):
@@ -416,14 +514,18 @@ class GBDT:
                     g_k = jnp.pad(g_k, (0, self._row_pad))
                     h_k = jnp.pad(h_k, (0, self._row_pad))
                     member = jnp.pad(member, (0, self._row_pad))
-                arrays, leaf_id = self._grow_fn(
-                    self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
+                with _PHASES.phase("grow") as box:
+                    arrays, leaf_id = self._grow_fn(
+                        self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
+                    box[0] = leaf_id
                 if self._row_pad:
                     leaf_id = leaf_id[: self.num_data]
-                self.train_score = self.train_score.at[k].set(
-                    _apply_tree_score(self.train_score[k],
-                                      arrays.leaf_value, leaf_id,
-                                      jnp.float32(self.shrinkage_rate)))
+                with _PHASES.phase("score") as box:
+                    self.train_score = self.train_score.at[k].set(
+                        _apply_tree_score(self.train_score[k],
+                                          arrays.leaf_value, leaf_id,
+                                          jnp.float32(self.shrinkage_rate)))
+                    box[0] = self.train_score
                 ints_d, floats_d = _pack_tree_device(arrays)
                 for buf in (ints_d, floats_d):
                     copy_async = getattr(buf, "copy_to_host_async", None)
@@ -437,7 +539,8 @@ class GBDT:
             self.iter_ += 1
             # materialize older iterations; the newest stays in flight so
             # its fetch overlaps the next iteration's device work
-            self._flush_pending(keep_latest=1)
+            with _PHASES.phase("fetch"):
+                self._flush_pending(keep_latest=1)
             if self._stop_flag:
                 return True
             return False
@@ -494,6 +597,54 @@ class GBDT:
             return True
         self.iter_ += 1
         return False
+
+    def _train_one_iter_fused(self) -> bool:
+        """Async iteration with the whole device pipeline in two jitted
+        dispatches (gradients; per-class grow + score update)."""
+        C = self.num_tree_per_iteration
+        if self._fused_fns is None:
+            self._build_fused_step()
+        fused_grad, fused_step = self._fused_fns
+        with _PHASES.phase("boost") as box:
+            # plain bagging only updates the membership mask; gradient-
+            # rewriting baggings (GOSS) disable the fused path
+            self._bagging(self.iter_, None, None)
+            grads, hesss = fused_grad(self.train_score, self._obj_arrs)
+            box[0] = grads
+        items = []
+        for k in range(C):
+            fmask = self._tree_feature_mask()
+            # identical key stream to the eager path, so the same seed
+            # grows the same trees regardless of which path engages
+            self._key, sub = jax.random.split(self._key)
+            with _PHASES.phase("grow") as box:
+                self.train_score, ints_d, floats_d = fused_step(
+                    self.train_score, grads, hesss, self.bag_weight,
+                    self.bins, self.fmeta, fmask, sub,
+                    jnp.float32(self.shrinkage_rate), jnp.int32(k))
+                box[0] = self.train_score
+            for buf in (ints_d, floats_d):
+                copy_async = getattr(buf, "copy_to_host_async", None)
+                if copy_async is not None:
+                    try:
+                        copy_async()
+                    except Exception:
+                        pass
+            items.append((ints_d, floats_d, self.shrinkage_rate))
+        self._pending.append((self.iter_, items))
+        self.iter_ += 1
+        with _PHASES.phase("fetch"):
+            self._flush_pending(keep_latest=1)
+        return bool(self._stop_flag)
+
+    def refit(self, leaf_preds: np.ndarray) -> None:
+        """Refit leaf outputs on the current training data given per-row
+        leaf assignments [N, num_trees] (GBDT::RefitTree via
+        LGBM_BoosterRefit, reference c_api.cpp)."""
+        self._flush_pending()
+        from .refit import refit_model
+        refit_model(self, self.train_set.metadata, np.asarray(leaf_preds),
+                    self.config)
 
     def rollback_one_iter(self) -> None:
         """Remove the last iteration's trees and scores (gbdt.cpp:553-576)."""

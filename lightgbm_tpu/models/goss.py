@@ -16,6 +16,11 @@ from .gbdt import GBDT
 
 
 class GOSS(GBDT):
+    # _bagging inspects gradients on the host; the fused iteration computes
+    # them in-jit, so GOSS keeps the eager path (device-side GOSS sampling
+    # replaces this)
+    _fused_ok = False
+
     def __init__(self, config, train_set, objective=None):
         super().__init__(config, train_set, objective)
         check(config.top_rate + config.other_rate <= 1.0,

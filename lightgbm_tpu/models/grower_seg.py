@@ -40,13 +40,21 @@ from jax import lax
 from ..ops.pallas_histogram import (NUM_CHANNELS, histogram_segment,
                                     pack_channels, unpack_hist)
 from ..ops.split import NEG_INF, FeatureMeta, best_split
-from .grower import (GrowerParams, TreeArrays, _node_feature_mask,
-                     routed_left)
+from .grower import (CommHooks, GrowerParams, TreeArrays,
+                     _node_feature_mask, routed_left)
 
 # compact when the tree reaches these leaf counts (log-spaced: each epoch
 # roughly quarters the confinement intervals, so total scan waste stays
-# within ~2-3x of the ideal sum-of-leaf-sizes)
-COMPACT_AT_LEAVES = (4, 16, 64, 256)
+# within ~2-3x of the ideal sum-of-leaf-sizes).  Overridable for perf
+# experiments via LIGHTGBM_TPU_COMPACT_AT="4,16,64".
+import os as _os
+
+_compact_env = _os.environ.get("LIGHTGBM_TPU_COMPACT_AT")
+if _compact_env is not None:
+    COMPACT_AT_LEAVES = tuple(
+        int(x) for x in _compact_env.split(",") if x.strip())
+else:
+    COMPACT_AT_LEAVES = (4, 16, 64, 256)
 
 
 class _SegState(NamedTuple):
@@ -105,13 +113,19 @@ def _unpack_w8_words(words):
 
 
 def make_grow_tree_segment(num_bins: int, params: GrowerParams,
-                           block_rows: int):
+                           block_rows: int, comm: CommHooks = CommHooks(),
+                           wrap=None):
     """Build the jitted segment grower.
 
     Returned ``grow(binsT, grad, hess, member, fmeta, feature_mask, key)``
     takes feature-major bins [F, Npad] (Npad a multiple of block_rows; pad
     rows must carry member == 0) and returns ``(TreeArrays,
     leaf_id_original_order)`` exactly like the fused grower.
+
+    ``comm`` hooks make this the data-parallel learner's core under
+    ``shard_map`` (rows sharded; per-leaf cost stays O(leaf) per shard):
+    ``reduce_hist`` runs on every leaf histogram, ``reduce_stats`` on the
+    root scalars, ``merge_split`` on every per-leaf SplitInfo.
     """
     p = params
     L = p.num_leaves
@@ -123,31 +137,60 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         n_blk = st.leaf_hi[leaf] - lo
         out = histogram_segment(st.binsT, st.w8, st.leaf_id, lo, n_blk,
                                 leaf, B, rb)
-        return unpack_hist(out[:F])
+        h = unpack_hist(out[:F])
+        if comm.reduce_hist is not None:
+            h = comm.reduce_hist(h, None, None, None, None)
+        return h
+
+    def _one_scan(hist, g, h, c, depth, fmeta, fmask, key, step):
+        fmask_node = _node_feature_mask(fmask, key, step, p)
+        if comm.shard_feature_mask is not None:
+            fmask_node = comm.shard_feature_mask(fmask_node)
+        info = best_split(hist, g, h, c, fmeta, p.split, fmask_node)
+        gain = info.gain
+        if comm.merge_split is not None:
+            info, gain = comm.merge_split(info, gain)
+        if p.max_depth > 0:
+            gain = jnp.where(depth >= p.max_depth, NEG_INF, gain)
+        return info, gain
+
+    def _write_scans(st: _SegState, leaf_idx, infos, gains):
+        """leaf_idx/gains [k], infos batched SplitInfo; one scatter each."""
+        return st._replace(
+            best_gain=st.best_gain.at[leaf_idx].set(gains),
+            best_feature=st.best_feature.at[leaf_idx].set(infos.feature),
+            best_threshold=st.best_threshold.at[leaf_idx].set(
+                infos.threshold),
+            best_default_left=st.best_default_left.at[leaf_idx].set(
+                infos.default_left),
+            best_is_cat=st.best_is_cat.at[leaf_idx].set(infos.is_cat),
+            best_cat_bitset=st.best_cat_bitset.at[leaf_idx].set(
+                infos.cat_bitset),
+            best_left_g=st.best_left_g.at[leaf_idx].set(infos.left_g),
+            best_left_h=st.best_left_h.at[leaf_idx].set(infos.left_h),
+            best_left_c=st.best_left_c.at[leaf_idx].set(infos.left_c),
+            best_left_out=st.best_left_out.at[leaf_idx].set(infos.left_out),
+            best_right_out=st.best_right_out.at[leaf_idx].set(
+                infos.right_out),
+        )
 
     def scan_leaf(st: _SegState, leaf_idx, hist, g, h, c, depth, fmeta,
                   fmask, key, step):
-        fmask_node = _node_feature_mask(fmask, key, step, p)
-        info = best_split(hist, g, h, c, fmeta, p.split, fmask_node)
-        gain = info.gain
-        if p.max_depth > 0:
-            gain = jnp.where(depth >= p.max_depth, NEG_INF, gain)
-        return st._replace(
-            best_gain=st.best_gain.at[leaf_idx].set(gain),
-            best_feature=st.best_feature.at[leaf_idx].set(info.feature),
-            best_threshold=st.best_threshold.at[leaf_idx].set(info.threshold),
-            best_default_left=st.best_default_left.at[leaf_idx].set(
-                info.default_left),
-            best_is_cat=st.best_is_cat.at[leaf_idx].set(info.is_cat),
-            best_cat_bitset=st.best_cat_bitset.at[leaf_idx].set(
-                info.cat_bitset),
-            best_left_g=st.best_left_g.at[leaf_idx].set(info.left_g),
-            best_left_h=st.best_left_h.at[leaf_idx].set(info.left_h),
-            best_left_c=st.best_left_c.at[leaf_idx].set(info.left_c),
-            best_left_out=st.best_left_out.at[leaf_idx].set(info.left_out),
-            best_right_out=st.best_right_out.at[leaf_idx].set(
-                info.right_out),
-        )
+        info, gain = _one_scan(hist, g, h, c, depth, fmeta, fmask, key,
+                               step)
+        leaves = jnp.asarray(leaf_idx, jnp.int32)[None]
+        batched = jax.tree_util.tree_map(lambda x: x[None], info)
+        return _write_scans(st, leaves, batched, gain[None])
+
+    def scan_pair(st: _SegState, leaves2, hists2, g2, h2, c2, depth, fmeta,
+                  fmask, key, steps2):
+        """Both children of a split evaluated in ONE vmapped scan — halves
+        the per-split chain of small ops vs two sequential scans."""
+        infos, gains = jax.vmap(
+            lambda hi, g, h, c, s: _one_scan(hi, g, h, c, depth, fmeta,
+                                             fmask, key, s)
+        )(hists2, g2, h2, c2, steps2)
+        return _write_scans(st, leaves2, infos, gains)
 
     def compact(st: _SegState) -> _SegState:
         """Stable-sort the whole layout by leaf_id; leaves become
@@ -186,6 +229,11 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         G0 = jnp.sum(grad * member)
         H0 = jnp.sum(hess * member)
         C0 = jnp.sum(member)
+        if comm.reduce_stats is not None:
+            # allreduce of the root (cnt, sum_g, sum_h) tuple
+            # (data_parallel_tree_learner.cpp:311-357)
+            G0, H0, C0 = (comm.reduce_stats(G0), comm.reduce_stats(H0),
+                          comm.reduce_stats(C0))
 
         def do_split(st: _SegState, step):
             leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
@@ -280,17 +328,33 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                 leaf_c=st.leaf_c.at[leaf].set(Cl).at[new_leaf].set(Cr),
                 tree=tree,
             )
-            st = scan_leaf(st, leaf, hist_left, Gl, Hl, Cl, depth_child,
-                           fmeta, feature_mask, key, 2 * step)
-            st = scan_leaf(st, new_leaf, hist_right, Gr, Hr, Cr,
-                           depth_child, fmeta, feature_mask, key,
-                           2 * step + 1)
+            st = scan_pair(
+                st, jnp.stack([leaf, new_leaf]),
+                jnp.stack([hist_left, hist_right]),
+                jnp.stack([Gl, Gr]), jnp.stack([Hl, Hr]),
+                jnp.stack([Cl, Cr]), depth_child, fmeta, feature_mask, key,
+                jnp.stack([2 * step, 2 * step + 1]))
             return st
+
+        # compaction milestones: the leaf count after step s is s+2 while
+        # growth continues, so "compact at c leaves" = end of step c-2.
+        # Traced as a cond inside ONE fori_loop body: the body and the
+        # compaction each compile once, vs once per milestone segment with
+        # unrolled loops (round 2's layout compiled ~5 copies; cutting the
+        # program size is most of the jit-time win).
+        milestone_steps = [c - 2 for c in COMPACT_AT_LEAVES
+                           if 2 <= c <= L - 1]
 
         def body(step, st: _SegState):
             can_split = jnp.max(st.best_gain) > 0.0
-            return lax.cond(can_split, lambda s: do_split(s, step),
-                            lambda s: s, st)
+            st = lax.cond(can_split, lambda s: do_split(s, step),
+                          lambda s: s, st)
+            if milestone_steps:
+                is_m = jnp.zeros((), bool)
+                for m in milestone_steps:
+                    is_m |= step == m
+                st = lax.cond(is_m, compact, lambda s: s, st)
+            return st
 
         neg = jnp.full(L, NEG_INF, dtype=jnp.float32)
         zeros_l = jnp.zeros(L, dtype=jnp.float32)
@@ -340,22 +404,11 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         st = st._replace(leaf_hist=st.leaf_hist.at[0].set(root_hist))
         st = scan_leaf(st, 0, root_hist, G0, H0, C0, jnp.int32(0), fmeta,
                        feature_mask, key, 2 * L)
-        # growth split into static segments with a compaction between them
-        # (a per-step traced compaction cond would copy the full state every
-        # step; the leaf count at step s is exactly s+2 while growth
-        # continues, so milestone steps are static).  Compacting after
-        # growth stopped is a harmless stable re-sort.
-        # after step s the tree has s+2 leaves, so "compact at c leaves"
-        # means after step c-2, i.e. before step c-1
-        milestones = [c - 1 for c in COMPACT_AT_LEAVES if c < L]
-        lo_step = 0
-        for m in milestones:
-            st = lax.fori_loop(lo_step, m, body, st)
-            st = compact(st)
-            lo_step = m
-        st = lax.fori_loop(lo_step, L - 1, body, st)
+        st = lax.fori_loop(0, L - 1, body, st)
         # leaf ids back in original row order
         leaf_id_orig = jnp.zeros(n, jnp.int32).at[st.order].set(st.leaf_id)
         return st.tree, leaf_id_orig
 
+    if wrap is not None:
+        return wrap(grow)
     return jax.jit(grow)

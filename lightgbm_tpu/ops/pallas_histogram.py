@@ -6,14 +6,17 @@ loop (src/io/dense_bin.hpp:69-193) with a TPU-native formulation:
 
   * bins live feature-major ``[F, N]`` so each feature's stream is
     contiguous on the lane axis;
-  * the per-feature one-hot ``[B, rows]`` is built with int32 VPU compares
-    (v5e supports only 32-bit vector compares) and *never leaves VMEM*;
-  * the (grad, hess, count) contraction runs on the MXU as a bf16 matmul
-    with f32 accumulation.  Gradients/hessians are carried as bf16 hi+lo
-    channel pairs (``pack_channels``), giving ~16 mantissa bits — the same
-    single-precision stance as the reference GPU learner's default
-    ``gpu_use_dp=false`` (src/treelearner/gpu_tree_learner.cpp:677), with
-    the count channel exact in f32 accumulation.
+  * a COMBINED (feature, bin) one-hot ``[F*B, chunk]`` is built with int32
+    VPU compares and never leaves VMEM;
+  * ONE bf16 matmul per chunk contracts it against the ``[8, chunk]``
+    weight channels on the MXU with f32 accumulation — all features in a
+    single large-output matmul (round-2's per-feature ``[8, rb] x [rb, B]``
+    loop left >90% of the MXU idle; the combined form measures ~2.9 ns/row
+    for 28 features x 64 bins on v5e).  Gradients/hessians are carried as
+    bf16 hi+lo channel pairs (``pack_channels``), giving ~16 mantissa
+    bits — the same single-precision stance as the reference GPU learner's
+    default ``gpu_use_dp=false`` (src/treelearner/gpu_tree_learner.cpp:677),
+    with the count channel exact in f32 accumulation.
 
 Two kernels share the inner body:
 
@@ -28,8 +31,8 @@ Two kernels share the inner body:
     for them, and ``pl.when`` skips their compute.
 
 The 8 weight channels are ``[g_hi, g_lo, h_hi, h_lo, member, 0, 0, 0]``;
-``unpack_hist`` folds them back to the ``[F, B, 3]`` (sum_grad, sum_hess,
-count) layout the split scan consumes.
+``unpack_hist`` folds a kernel output ``[F, B, 8]`` back to the
+``[F, B, 3]`` (sum_grad, sum_hess, count) layout the split scan consumes.
 """
 
 from __future__ import annotations
@@ -43,9 +46,27 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NUM_CHANNELS = 8
-DEFAULT_BLOCK_ROWS = 8192
+DEFAULT_BLOCK_ROWS = 16384
+# inner sub-chunk of a row block: the one-hot [fblk*B, CHUNK] lives in
+# VMEM only for the duration of one matmul
+CHUNK = 512
+# feature sub-block: keep fblk*B*CHUNK*2B (one-hot) around 2MB
+_FBLK_BIN_BUDGET = 2048
 # VMEM working-set budget for auto block sizing (bytes, of ~16MB/core)
 _VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _fblk(num_bins: int) -> int:
+    return max(1, _FBLK_BIN_BUDGET // num_bins)
+
+
+def _pick_chunk(rb: int) -> int:
+    """Largest lane-aligned chunk <= CHUNK dividing the row block; falls
+    back to the whole block for odd user-chosen tpu_row_chunk values."""
+    for c in (CHUNK, 256, 128):
+        if rb % c == 0:
+            return c
+    return rb
 
 
 def supported(num_features: int, num_bins: int, dtype) -> bool:
@@ -55,24 +76,33 @@ def supported(num_features: int, num_bins: int, dtype) -> bool:
         return False
     if num_bins > 256:
         return False
-    # accumulator [F, 8, B] f32 must fit VMEM alongside the streams;
-    # size with F rounded up to a multiple of 4 — the segment grower pads
-    # features to pack them into sort words, so that is the real footprint
+    # accumulator [F*B, 8] f32 must stay well under VMEM; size with F
+    # rounded up to a multiple of 4 — the segment grower pads features to
+    # pack them into sort words, so that is the real footprint
     F4 = -(-num_features // 4) * 4
-    if F4 * NUM_CHANNELS * num_bins * 4 > 6 * 1024 * 1024:
+    if F4 * num_bins * NUM_CHANNELS * 4 > 4 * 1024 * 1024:
         return False
     return True
 
 
-def pick_block_rows(num_features: int, num_bins: int) -> int:
-    """Largest power-of-two row block whose VMEM working set fits budget."""
-    num_features = -(-num_features // 4) * 4
-    acc = num_features * NUM_CHANNELS * num_bins * 4
-    rb = DEFAULT_BLOCK_ROWS
-    while rb > 512:
-        # double-buffered input blocks + one-hot + onehot-int copy
-        streams = 2 * rb * (num_features + 2 * NUM_CHANNELS + 4)
-        onehot = rb * num_bins * (2 + 4)
+def pick_block_rows(num_features: int, num_bins: int,
+                    num_rows: int = 0) -> int:
+    """Largest power-of-two row block whose VMEM working set fits budget.
+
+    ``num_rows`` (when known) caps the block at the next power of two >=
+    the dataset, so small datasets are not padded to a huge block.
+    """
+    F4 = -(-num_features // 4) * 4
+    acc = F4 * num_bins * NUM_CHANNELS * 4
+    # one-hot chunk (bf16) + its integer compare intermediate
+    onehot = _fblk(num_bins) * num_bins * CHUNK * (2 + 4)
+    rb = 4 * DEFAULT_BLOCK_ROWS
+    if num_rows > 0:
+        cap = 1 << max(0, (num_rows - 1).bit_length())
+        rb = min(rb, max(CHUNK, cap))
+    while rb > CHUNK:
+        # double-buffered input blocks (bins u8, w8 bf16, leaf_id i32)
+        streams = 2 * rb * (F4 + 2 * NUM_CHANNELS + 4)
         if acc + streams + onehot <= _VMEM_BUDGET:
             return rb
         rb //= 2
@@ -100,23 +130,42 @@ def pack_channels(grad: jax.Array, hess: jax.Array,
 
 
 def unpack_hist(out: jax.Array) -> jax.Array:
-    """[F, 8, B] channel sums -> [F, B, 3] (sum_grad, sum_hess, count)."""
-    g = out[:, 0] + out[:, 1]
-    h = out[:, 2] + out[:, 3]
-    c = out[:, 4]
+    """[F, B, 8] channel sums -> [F, B, 3] (sum_grad, sum_hess, count)."""
+    g = out[..., 0] + out[..., 1]
+    h = out[..., 2] + out[..., 3]
+    c = out[..., 4]
     return jnp.stack([g, h, c], axis=-1)
 
 
-def _accumulate_block(binsT_ref, w, acc_ref, num_bins):
-    """Shared inner body: one [F, rb] bin block x [8, rb] weights."""
+def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins):
+    """Shared inner body: one [F, rb] bin block into the [F*B, 8]
+    accumulator, one combined-one-hot matmul per (chunk, fblock).
+
+    ``wfn(c)`` returns the [8, chunk] weight channels of chunk ``c``.
+    Chunks are walked with an in-kernel ``fori_loop`` so the Mosaic program
+    size is independent of the row-block size (a fully unrolled 64-chunk
+    body made kernel compilation a large share of the jit time).
+    """
     F, rb = binsT_ref.shape
-    b = binsT_ref[:].astype(jnp.int32)
-    iota = lax.broadcasted_iota(jnp.int32, (num_bins, rb), 0)
-    for f in range(F):
-        onehot = (b[f:f + 1, :] == iota).astype(jnp.bfloat16)  # [B, rb]
-        acc_ref[f] += lax.dot_general(
-            w, onehot, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    B = num_bins
+    fblk = _fblk(B)
+    chunk = _pick_chunk(rb)
+
+    def one_chunk(c, carry):
+        wc = wfn(c, chunk)                                  # [8, chunk]
+        for f0 in range(0, F, fblk):
+            nf = min(fblk, F - f0)
+            b = binsT_ref[f0:f0 + nf, pl.ds(c * chunk, chunk)].astype(
+                jnp.int32)
+            iota = lax.broadcasted_iota(jnp.int32, (nf, B, chunk), 1)
+            onehot = (b[:, None, :] == iota).astype(
+                jnp.bfloat16).reshape(nf * B, chunk)
+            acc_ref[f0 * B:(f0 + nf) * B] += lax.dot_general(
+                onehot, wc, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return carry
+
+    lax.fori_loop(0, rb // chunk, one_chunk, 0)
 
 
 def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref):
@@ -126,7 +175,11 @@ def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref):
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    _accumulate_block(binsT_ref, w_ref[:], acc_ref, acc_ref.shape[2])
+    def wfn(c, chunk):
+        return w_ref[:, pl.ds(c * chunk, chunk)]
+
+    _accumulate_block(binsT_ref, wfn, acc_ref,
+                      acc_ref.shape[0] // binsT_ref.shape[0])
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -143,9 +196,13 @@ def _kernel_segment(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref):
 
     @pl.when(i < sref[1])
     def _():
-        mask = (lid_ref[:] == sref[2]).astype(jnp.bfloat16)    # [1, rb]
-        _accumulate_block(binsT_ref, w_ref[:] * mask, acc_ref,
-                          acc_ref.shape[2])
+        def wfn(c, chunk):
+            wc = w_ref[:, pl.ds(c * chunk, chunk)]
+            lc = lid_ref[:, pl.ds(c * chunk, chunk)]
+            return wc * (lc == sref[2]).astype(jnp.bfloat16)
+
+        _accumulate_block(binsT_ref, wfn, acc_ref,
+                          acc_ref.shape[0] // binsT_ref.shape[0])
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -161,7 +218,7 @@ def _interpret_default() -> bool:
 def histogram_all(binsT: jax.Array, w8: jax.Array, num_bins: int,
                   block_rows: int = 0,
                   interpret: bool | None = None) -> jax.Array:
-    """Full-data histogram: [F, Npad] bins x [8, Npad] channels -> [F, 8, B].
+    """Full-data histogram: [F, Npad] bins x [8, Npad] channels -> [F, B, 8].
 
     Npad must be a multiple of ``block_rows``; pad rows must carry zero
     weight channels (the bin values there may be anything).
@@ -172,21 +229,22 @@ def histogram_all(binsT: jax.Array, w8: jax.Array, num_bins: int,
     if interpret is None:
         interpret = _interpret_default()
     assert n % block_rows == 0, (n, block_rows)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _kernel_all,
-        out_shape=jax.ShapeDtypeStruct((F, NUM_CHANNELS, num_bins),
+        out_shape=jax.ShapeDtypeStruct((F * num_bins, NUM_CHANNELS),
                                        jnp.float32),
         grid=(n // block_rows,),
         in_specs=[
             pl.BlockSpec((F, block_rows), lambda i: (0, i)),
             pl.BlockSpec((NUM_CHANNELS, block_rows), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((F, NUM_CHANNELS, num_bins),
-                               lambda i: (0, 0, 0)),
-        scratch_shapes=[pltpu.VMEM((F, NUM_CHANNELS, num_bins),
+        out_specs=pl.BlockSpec((F * num_bins, NUM_CHANNELS),
+                               lambda i: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((F * num_bins, NUM_CHANNELS),
                                    jnp.float32)],
         interpret=interpret,
     )(binsT, w8)
+    return out.reshape(F, num_bins, NUM_CHANNELS)
 
 
 @functools.partial(jax.jit,
@@ -200,7 +258,7 @@ def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
 
     ``leaf_id`` is [Npad] i32 row->leaf; rows outside the leaf (or padding,
     which must carry zero weights) contribute nothing.  DMA and compute are
-    proportional to ``n_blocks``, not N.
+    proportional to ``n_blocks``, not N.  Returns [F, B, 8].
     """
     F, n = binsT.shape
     if block_rows <= 0:
@@ -225,18 +283,19 @@ def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
             pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
             pl.BlockSpec((1, block_rows), im_data),
         ],
-        out_specs=pl.BlockSpec((F, NUM_CHANNELS, num_bins),
-                               lambda i, s: (0, 0, 0)),
-        scratch_shapes=[pltpu.VMEM((F, NUM_CHANNELS, num_bins),
+        out_specs=pl.BlockSpec((F * num_bins, NUM_CHANNELS),
+                               lambda i, s: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((F * num_bins, NUM_CHANNELS),
                                    jnp.float32)],
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _kernel_segment,
-        out_shape=jax.ShapeDtypeStruct((F, NUM_CHANNELS, num_bins),
+        out_shape=jax.ShapeDtypeStruct((F * num_bins, NUM_CHANNELS),
                                        jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
     )(scalars, binsT, w8, leaf_id.reshape(1, -1))
+    return out.reshape(F, num_bins, NUM_CHANNELS)
 
 
 def leaf_histogram_pallas(binsT: jax.Array, grad: jax.Array,

@@ -55,6 +55,10 @@ class SplitParams(NamedTuple):
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
     min_data_per_group: int = 100
+    # static: dataset has categorical features at all.  False lets jit drop
+    # the categorical candidate scans (incl. a per-call [F, B] argsort) from
+    # the traced program — a large per-split saving on numerical datasets.
+    has_cat: bool = True
 
 
 class SplitInfo(NamedTuple):
@@ -286,7 +290,21 @@ def _all_candidates(hist, parent_g, parent_h, parent_c, fmeta: FeatureMeta,
                            p.lambda_l1, p.lambda_l2, p.max_delta_step)
     min_gain_shift = gain_shift + p.min_gain_to_split
 
+    def fam_best(gain_flat):
+        idx = jnp.argmax(gain_flat, axis=1)
+        return idx, jnp.take_along_axis(gain_flat, idx[:, None], axis=1)[:, 0]
+
     num_gain, num_left = _numerical_candidates(hist, parent, fmeta, p, lo, hi)
+    ni, ng = fam_best(num_gain.reshape(F, -1))
+
+    if not p.has_cat:
+        z = jnp.zeros(F, dtype=jnp.int32)
+        fgain_out = jnp.where(ng > min_gain_shift,
+                              (ng - min_gain_shift) * fmeta.penalty, NEG_INF)
+        return dict(parent=parent, num_left=num_left, oh_left=None,
+                    so_left=None, so_order=None, ni=ni, oi=z, si=z,
+                    fam=z, fgain_out=fgain_out)
+
     oh_gain, oh_left = _categorical_onehot_candidates(hist, parent, fmeta,
                                                       p, lo, hi)
     so_gain, so_left, so_order = _categorical_sorted_candidates(
@@ -297,11 +315,6 @@ def _all_candidates(hist, parent_g, parent_h, parent_c, fmeta: FeatureMeta,
     oh_gain = jnp.where(use_onehot, oh_gain, NEG_INF)
     so_gain = jnp.where(use_onehot[:, :, None], NEG_INF, so_gain)
 
-    def fam_best(gain_flat):
-        idx = jnp.argmax(gain_flat, axis=1)
-        return idx, jnp.take_along_axis(gain_flat, idx[:, None], axis=1)[:, 0]
-
-    ni, ng = fam_best(num_gain.reshape(F, -1))
     oi, og = fam_best(oh_gain)
     si, sg = fam_best(so_gain.reshape(F, -1))
 
@@ -357,35 +370,43 @@ def best_split(hist: jax.Array, parent_g, parent_h, parent_c,
     n_t = (ni[best_f] // 2).astype(jnp.int32)
     n_dir = (ni[best_f] % 2).astype(jnp.int32)
     left_num = num_left[best_f, n_t, n_dir]
-    left_oh = oh_left[best_f, oi[best_f]]
-    s_k = (si[best_f] // 2).astype(jnp.int32)
-    s_dir = (si[best_f] % 2).astype(jnp.int32)
-    left_so = so_left[best_f, s_k, s_dir]
-
-    left_stats = jnp.where(fam_f == 0, left_num,
-                           jnp.where(fam_f == 1, left_oh, left_so))
+    if p.has_cat:
+        left_oh = oh_left[best_f, oi[best_f]]
+        s_k = (si[best_f] // 2).astype(jnp.int32)
+        s_dir = (si[best_f] % 2).astype(jnp.int32)
+        left_so = so_left[best_f, s_k, s_dir]
+        left_stats = jnp.where(fam_f == 0, left_num,
+                               jnp.where(fam_f == 1, left_oh, left_so))
+        threshold = jnp.where(
+            fam_f == 0, n_t,
+            jnp.where(fam_f == 1, oi[best_f], s_k)).astype(jnp.int32)
+    else:
+        left_stats = left_num
+        threshold = n_t
     is_cat = fam_f > 0
-    threshold = jnp.where(fam_f == 0, n_t,
-                          jnp.where(fam_f == 1, oi[best_f], s_k)).astype(jnp.int32)
     # default_left: numerical dir 0 = missing left; 2-bin NaN edge forces right
     dl = (fam_f == 0) & (n_dir == 0)
     nb_f = fmeta.num_bin[best_f]
     mt_f = fmeta.missing_type[best_f]
     dl = jnp.where((fam_f == 0) & (nb_f <= 2) & (mt_f == MISSING_NAN), False, dl)
 
-    # categorical bitset of left-going bins
-    b_idx = jnp.arange(B, dtype=jnp.int32)
-    onehot_mask = b_idx == threshold
-    order_f = so_order[best_f]
-    pos = jnp.arange(B, dtype=jnp.int32)
-    cnt_row = hist[best_f, :, 2]
-    used_mask_f = _cat_used_bin_mask(hist, fmeta)[best_f]
-    valid_bins = used_mask_f & (cnt_row >= p.cat_smooth)
-    nvalid = valid_bins.sum().astype(jnp.int32)
-    sel_sorted = jnp.where(s_dir == 0, pos <= s_k, (pos >= s_k) & (pos < nvalid))
-    sorted_mask = jnp.zeros(B, dtype=bool).at[order_f].set(sel_sorted)
-    cat_mask = jnp.where(fam_f == 1, onehot_mask, sorted_mask & valid_bins)
-    cat_bitset = build_cat_bitset(jnp.where(is_cat, cat_mask, False))
+    if p.has_cat:
+        # categorical bitset of left-going bins
+        b_idx = jnp.arange(B, dtype=jnp.int32)
+        onehot_mask = b_idx == threshold
+        order_f = so_order[best_f]
+        pos = jnp.arange(B, dtype=jnp.int32)
+        cnt_row = hist[best_f, :, 2]
+        used_mask_f = _cat_used_bin_mask(hist, fmeta)[best_f]
+        valid_bins = used_mask_f & (cnt_row >= p.cat_smooth)
+        nvalid = valid_bins.sum().astype(jnp.int32)
+        sel_sorted = jnp.where(s_dir == 0, pos <= s_k,
+                               (pos >= s_k) & (pos < nvalid))
+        sorted_mask = jnp.zeros(B, dtype=bool).at[order_f].set(sel_sorted)
+        cat_mask = jnp.where(fam_f == 1, onehot_mask, sorted_mask & valid_bins)
+        cat_bitset = build_cat_bitset(jnp.where(is_cat, cat_mask, False))
+    else:
+        cat_bitset = jnp.zeros(8, dtype=jnp.uint32)
 
     Gl, Hl, Cl = left_stats[0], left_stats[1], left_stats[2]
     Gr, Hr, Cr = parent[0] - Gl, parent[1] - Hl, parent[2] - Cl

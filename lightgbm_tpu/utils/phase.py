@@ -1,0 +1,91 @@
+"""Per-phase wall-clock accounting for the training loop.
+
+The reference ships three tracing mechanisms — easy_profiler blocks
+(src/main.cpp:13-39), TIMETAG per-phase accumulators printed at learner
+destruction (src/treelearner/serial_tree_learner.cpp:20-47), and network
+byte/time counters (src/network/linkers.h:114-117).  This module is the
+TPU build's equivalent of the TIMETAG accumulators: named phases
+accumulate wall-clock across iterations and are printed on demand
+(bench.py prints them every run; ``Log`` prints at verbosity>=debug).
+
+Because device work is dispatched asynchronously, a phase's wall time
+normally measures only host-side dispatch.  Set
+``LIGHTGBM_TPU_SYNC_TIMERS=1`` to block on device results at each phase
+boundary — slower, but attributes device time to the phase that spent it
+(the jax-profiler trace, ``LIGHTGBM_TPU_PROFILE_DIR``, is the zero-skew
+alternative).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+def _sync_enabled() -> bool:
+    return os.environ.get("LIGHTGBM_TPU_SYNC_TIMERS", "") not in ("", "0")
+
+
+class PhaseTimer:
+    """Accumulates (count, seconds) per named phase."""
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextmanager
+    def phase(self, name: str, sync_obj=None):
+        sync = _sync_enabled()
+        if sync and sync_obj is not None:
+            import jax
+            jax.block_until_ready(sync_obj)
+        t0 = time.perf_counter()
+        box = [None]
+        try:
+            yield box
+        finally:
+            if sync and box[0] is not None:
+                import jax
+                jax.block_until_ready(box[0])
+            self.seconds[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def reset(self) -> None:
+        self.seconds.clear()
+        self.counts.clear()
+
+    def summary(self) -> str:
+        total = sum(self.seconds.values())
+        parts = []
+        for name, sec in sorted(self.seconds.items(), key=lambda kv: -kv[1]):
+            n = self.counts[name]
+            parts.append(f"{name}={sec:.3f}s/{n}")
+        mode = "sync" if _sync_enabled() else "dispatch"
+        return f"phases[{mode}] total={total:.3f}s " + " ".join(parts)
+
+
+# process-global timer used by GBDT unless one is injected
+GLOBAL_TIMER = PhaseTimer()
+
+_profile_session: Optional[object] = None
+
+
+def maybe_start_profile() -> None:
+    """Start a jax-profiler trace if LIGHTGBM_TPU_PROFILE_DIR is set."""
+    global _profile_session
+    path = os.environ.get("LIGHTGBM_TPU_PROFILE_DIR")
+    if path and _profile_session is None:
+        import jax
+        jax.profiler.start_trace(path)
+        _profile_session = path
+
+
+def maybe_stop_profile() -> None:
+    global _profile_session
+    if _profile_session is not None:
+        import jax
+        jax.profiler.stop_trace()
+        _profile_session = None

@@ -1,0 +1,98 @@
+"""AOT compile-stage timing of grower components on the TPU backend.
+
+Usage: python tools/compile_probe.py [variant ...]
+variants: seg seg_nocompact fused kernel scan
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+N = 65536
+F, B, L = 28, 64, 255
+RB = 8192
+
+
+def stage_time(name, make_lowered):
+    t0 = time.perf_counter()
+    lowered = make_lowered()
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    print(f"{name}: trace={t1-t0:.1f}s compile={t2-t1:.1f}s")
+    return compiled
+
+
+def main():
+    variants = sys.argv[1:] or ["seg", "kernel", "scan", "fused"]
+    rng = np.random.RandomState(0)
+    binsT = jnp.asarray(rng.randint(0, B, size=(F, N)).astype(np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    member = jnp.ones(N, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    from lightgbm_tpu.models.grower import GrowerParams
+    from lightgbm_tpu.ops.split import FeatureMeta, SplitParams
+    fmeta = FeatureMeta(
+        num_bin=jnp.full(F, B, jnp.int32),
+        missing_type=jnp.zeros(F, jnp.int32),
+        default_bin=jnp.zeros(F, jnp.int32),
+        is_cat=jnp.zeros(F, bool),
+        monotone=jnp.zeros(F, jnp.int32),
+        penalty=jnp.ones(F, jnp.float32))
+    fmask = jnp.ones(F, jnp.float32)
+    params = GrowerParams(num_leaves=L, hist_backend="pallas",
+                          split=SplitParams(min_sum_hessian_in_leaf=100.0,
+                                            has_cat=False))
+
+    if "seg" in variants:
+        from lightgbm_tpu.models.grower_seg import make_grow_tree_segment
+        grow = make_grow_tree_segment(B, params, RB)
+        stage_time("segment grower", lambda: grow.lower(
+            binsT, g, g, member, fmeta, fmask, key))
+
+    if "seg_nocompact" in variants:
+        import lightgbm_tpu.models.grower_seg as gs
+        saved = gs.COMPACT_AT_LEAVES
+        gs.COMPACT_AT_LEAVES = ()
+        grow = gs.make_grow_tree_segment(B, params, RB)
+        stage_time("segment grower (no compaction)", lambda: grow.lower(
+            binsT, g, g, member, fmeta, fmask, key))
+        gs.COMPACT_AT_LEAVES = saved
+
+    if "fused" in variants:
+        from lightgbm_tpu.models.grower import make_grow_tree
+        grow = make_grow_tree(B, params)
+        stage_time("fused grower (pallas hist)", lambda: grow.lower(
+            binsT, g, g, member, fmeta, fmask, key))
+
+    if "kernel" in variants:
+        from lightgbm_tpu.ops.pallas_histogram import (histogram_segment,
+                                                       pack_channels)
+        w8 = pack_channels(g, g, member)
+        lid = jnp.zeros(N, jnp.int32)
+
+        @jax.jit
+        def seg(binsT, w8, lid):
+            return histogram_segment(binsT, w8, lid, jnp.int32(0),
+                                     jnp.int32(2), jnp.int32(0), B, RB)
+
+        stage_time("segment kernel alone", lambda: seg.lower(binsT, w8, lid))
+
+    if "scan" in variants:
+        from lightgbm_tpu.ops.split import best_split
+
+        @jax.jit
+        def scan2(hist2):
+            return jax.vmap(
+                lambda h: best_split(h, jnp.float32(1.0), jnp.float32(2.0),
+                                     jnp.float32(1e5), fmeta,
+                                     params.split, fmask))(hist2)
+
+        hist2 = jnp.ones((2, F, B, 3), jnp.float32)
+        stage_time("vmapped pair best_split", lambda: scan2.lower(hist2))
+
+
+main()

@@ -1,0 +1,136 @@
+"""Microbenchmark: current pallas histogram kernel vs combined-onehot
+prototype, plus compaction-sort cost.  Run on the real TPU.
+
+Usage: python tools/kernel_probe.py [n_rows]
+"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+F = 28
+B = 64
+CH = 8
+
+
+def timeit(fn, *args, iters=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    rng = np.random.RandomState(0)
+    from lightgbm_tpu.ops.pallas_histogram import (
+        histogram_all, pack_channels, pick_block_rows)
+
+    rb_old = pick_block_rows(F, B)
+    npad = -(-N // rb_old) * rb_old
+    bins = rng.randint(0, B, size=(F, npad)).astype(np.uint8)
+    binsT = jnp.asarray(bins)
+    grad = jnp.asarray(rng.normal(size=npad).astype(np.float32))
+    hess = jnp.asarray(rng.uniform(0.1, 1, size=npad).astype(np.float32))
+    member = jnp.ones(npad, jnp.float32)
+    w8 = pack_channels(grad, hess, member)
+
+    t = timeit(lambda: histogram_all(binsT, w8, B, rb_old))
+    print(f"old histogram_all rb={rb_old}: {t*1e3:.2f} ms "
+          f"({t/npad*1e9:.2f} ns/row)")
+
+    # ---- prototype: combined (f, bin) one-hot, single matmul per chunk
+    def make_proto(rb, chunk):
+        def kernel(binsT_ref, w_ref, out_ref, acc_ref):
+            i = pl.program_id(0)
+
+            @pl.when(i == 0)
+            def _():
+                acc_ref[:] = jnp.zeros_like(acc_ref)
+
+            n_chunks = rb // chunk
+            for c in range(n_chunks):
+                b = binsT_ref[:, c * chunk:(c + 1) * chunk].astype(jnp.int32)
+                iota = lax.broadcasted_iota(jnp.int32, (F, B, chunk), 1)
+                onehot = (b[:, None, :] == iota).astype(
+                    jnp.bfloat16).reshape(F * B, chunk)
+                w = w_ref[:, c * chunk:(c + 1) * chunk]
+                acc_ref[:] += lax.dot_general(
+                    onehot, w, dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+            @pl.when(i == pl.num_programs(0) - 1)
+            def _():
+                out_ref[:] = acc_ref[:]
+
+        @jax.jit
+        def run(binsT, w8):
+            n = binsT.shape[1]
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((F * B, CH), jnp.float32),
+                grid=(n // rb,),
+                in_specs=[
+                    pl.BlockSpec((F, rb), lambda i: (0, i)),
+                    pl.BlockSpec((CH, rb), lambda i: (0, i)),
+                ],
+                out_specs=pl.BlockSpec((F * B, CH), lambda i: (0, 0)),
+                scratch_shapes=[pltpu.VMEM((F * B, CH), jnp.float32)],
+            )(binsT, w8)
+        return run
+
+    for rb, chunk in [(8192, 512), (16384, 512), (32768, 512),
+                      (32768, 1024), (32768, 2048), (65536, 2048)]:
+        if npad % rb:
+            continue
+        try:
+            fn = make_proto(rb, chunk)
+            t = timeit(lambda: fn(binsT, w8))
+            print(f"proto combined rb={rb} chunk={chunk}: {t*1e3:.2f} ms "
+                  f"({t/npad*1e9:.2f} ns/row)")
+        except Exception as e:
+            print(f"proto rb={rb} chunk={chunk} FAILED: "
+                  f"{type(e).__name__}: {str(e)[:200]}")
+
+    # numerical check old vs proto
+    fn = make_proto(8192, 512)
+    ref = histogram_all(binsT, w8, B, rb_old)  # [F, 8, B]
+    got = fn(binsT, w8).reshape(F, B, CH).transpose(0, 2, 1)
+    print("max abs diff old-vs-proto:", float(jnp.max(jnp.abs(ref - got))))
+
+    # ---- compaction sort cost
+    lid = jnp.asarray(rng.randint(0, 255, size=npad).astype(np.int32))
+    payload = [jnp.asarray(rng.randint(-2**31, 2**31 - 1, size=npad,
+                                       dtype=np.int64).astype(np.int32))
+               for _ in range(12)]
+
+    @jax.jit
+    def do_sort(lid, *pay):
+        return lax.sort((lid,) + pay, num_keys=1, is_stable=True)
+
+    t = timeit(lambda: do_sort(lid, *payload), iters=3)
+    print(f"stable sort 12-word payload: {t*1e3:.1f} ms")
+
+    # ---- O(N) per-split routing cost (fcol gather + where)
+    @jax.jit
+    def route(binsT, lid, f):
+        fcol = lax.dynamic_slice_in_dim(binsT, f, 1, axis=0)[0]
+        go_left = fcol <= 31
+        return jnp.where((lid == 3) & ~go_left, 77, lid)
+
+    t = timeit(lambda: route(binsT, lid, jnp.int32(5)), iters=20)
+    print(f"full-N route step: {t*1e3:.2f} ms")
+
+
+main()

@@ -1,0 +1,189 @@
+"""Careful re-measurement: serialized timing via dependency chains.
+
+Measures:
+  1. combined-onehot kernel [FB, 8] out (exact-mode shape)
+  2. wave kernel [FB, K*8] out with leaf masking (wave-mode shape)
+  3. sort with 2-word payload + row gather (compaction alternative)
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
+F = 28
+B = 64
+CH = 8
+K = 16
+
+
+def timeit_chain(fn, x, extra, iters=30):
+    """fn(x, *extra) -> y with y feeding back via a scalar nudge, forcing
+    serialization."""
+    out = fn(x, *extra)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x, *extra)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    rng = np.random.RandomState(0)
+    rb = 16384
+    npad = -(-N // rb) * rb
+    bins = rng.randint(0, B, size=(F, npad)).astype(np.uint8)
+    binsT = jnp.asarray(bins)
+    g = jnp.asarray(rng.normal(size=npad).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, size=npad).astype(np.float32))
+    from lightgbm_tpu.ops.pallas_histogram import pack_channels
+    w8 = pack_channels(g, h, jnp.ones(npad, jnp.float32))
+    lid = jnp.asarray(rng.randint(0, 255, size=npad).astype(np.int32))
+
+    # ---------- 1. combined one-hot [FB, CH] ----------
+    def make_exact(rb, chunk):
+        def kernel(binsT_ref, w_ref, out_ref, acc_ref):
+            i = pl.program_id(0)
+
+            @pl.when(i == 0)
+            def _():
+                acc_ref[:] = jnp.zeros_like(acc_ref)
+
+            for c in range(rb // chunk):
+                b = binsT_ref[:, c * chunk:(c + 1) * chunk].astype(jnp.int32)
+                iota = lax.broadcasted_iota(jnp.int32, (F, B, chunk), 1)
+                onehot = (b[:, None, :] == iota).astype(
+                    jnp.bfloat16).reshape(F * B, chunk)
+                acc_ref[:] += lax.dot_general(
+                    onehot, w_ref[:, c * chunk:(c + 1) * chunk],
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+            @pl.when(i == pl.num_programs(0) - 1)
+            def _():
+                out_ref[:] = acc_ref[:]
+
+        @jax.jit
+        def run(binsT, w8):
+            n = binsT.shape[1]
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((F * B, CH), jnp.float32),
+                grid=(n // rb,),
+                in_specs=[pl.BlockSpec((F, rb), lambda i: (0, i)),
+                          pl.BlockSpec((CH, rb), lambda i: (0, i))],
+                out_specs=pl.BlockSpec((F * B, CH), lambda i: (0, 0)),
+                scratch_shapes=[pltpu.VMEM((F * B, CH), jnp.float32)],
+            )(binsT, w8)
+        return run
+
+    fn = make_exact(rb, 512)
+    t = timeit_chain(fn, binsT, (w8,))
+    print(f"exact [FB,8] rb={rb}: {t*1e3:.3f} ms/pass "
+          f"({14.3e9*(npad/1e6)/t/1e12:.1f} eff TMAC/s)")
+
+    # ---------- 2. wave kernel [FB, K*8] with leaf masking ----------
+    def make_wave(rb, chunk):
+        def kernel(tgt_ref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref):
+            i = pl.program_id(0)
+
+            @pl.when(i == 0)
+            def _():
+                acc_ref[:] = jnp.zeros_like(acc_ref)
+
+            for c in range(rb // chunk):
+                sl = slice(c * chunk, (c + 1) * chunk)
+                b = binsT_ref[:, sl].astype(jnp.int32)
+                iota = lax.broadcasted_iota(jnp.int32, (F, B, chunk), 1)
+                onehot = (b[:, None, :] == iota).astype(
+                    jnp.bfloat16).reshape(F * B, chunk)
+                l = lid_ref[:, sl]                      # [1, chunk]
+                w = w_ref[:, sl]                        # [CH, chunk]
+                # [K*CH, chunk]: channel block k = w8 masked to leaf tgt[k]
+                tk = tgt_ref[:]                          # [K] scalars
+                masks = [(l == tk[k]).astype(jnp.bfloat16) for k in range(K)]
+                wk = jnp.concatenate([w * m for m in masks], axis=0)
+                acc_ref[:] += lax.dot_general(
+                    onehot, wk,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+            @pl.when(i == pl.num_programs(0) - 1)
+            def _():
+                out_ref[:] = acc_ref[:]
+
+        @jax.jit
+        def run(binsT, w8, lid, targets):
+            n = binsT.shape[1]
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n // rb,),
+                in_specs=[pl.BlockSpec((F, rb), lambda i, s: (0, i)),
+                          pl.BlockSpec((CH, rb), lambda i, s: (0, i)),
+                          pl.BlockSpec((1, rb), lambda i, s: (0, i))],
+                out_specs=pl.BlockSpec((F * B, K * CH), lambda i, s: (0, 0)),
+                scratch_shapes=[pltpu.VMEM((F * B, K * CH), jnp.float32)],
+            )
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((F * B, K * CH), jnp.float32),
+                grid_spec=grid_spec,
+            )(targets, binsT, w8, lid.reshape(1, -1))
+        return run
+
+    targets = jnp.arange(K, dtype=jnp.int32)
+    for chunk in (512, 1024):
+        fnw = make_wave(rb, chunk)
+        t = timeit_chain(fnw, binsT, (w8, lid, targets))
+        print(f"wave [FB,{K*CH}] rb={rb} chunk={chunk}: {t*1e3:.3f} ms/pass "
+              f"({229e9*(npad/1e6)/t/1e12:.1f} eff TMAC/s)")
+
+    # correctness of wave kernel vs numpy for one leaf
+    out = np.asarray(fnw(binsT, w8, lid, targets))
+    got = out.reshape(F, B, K, CH)[..., 3, 0] + out.reshape(F, B, K, CH)[..., 3, 1]
+    sel = np.asarray(lid) == 3
+    want = np.zeros((F, B))
+    gn = np.asarray(g)
+    for f in range(F):
+        np.add.at(want[f], bins[f][sel], gn[sel])
+    print("wave leaf-3 grad max rel err:",
+          float(np.max(np.abs(got - want)) / (np.abs(want).max() + 1e-9)))
+
+    # ---------- 3. light sort + gather ----------
+    @jax.jit
+    def sort2(lid, order):
+        return lax.sort((lid, order), num_keys=1, is_stable=True)
+
+    order = jnp.arange(npad, dtype=jnp.int32)
+    t = timeit_chain(lambda l, o: sort2(l, o), lid, (order,), iters=10)
+    print(f"sort 2-word: {t*1e3:.2f} ms")
+
+    rows = jnp.asarray(
+        rng.randint(-2**31, 2**31 - 1, size=(npad, 7), dtype=np.int64)
+        .astype(np.int32))
+    perm = jnp.asarray(rng.permutation(npad).astype(np.int32))
+
+    @jax.jit
+    def gat(rows, perm):
+        return jnp.take(rows, perm, axis=0)
+
+    t = timeit_chain(gat, rows, (perm,), iters=10)
+    print(f"row gather [N,7] i32: {t*1e3:.2f} ms")
+
+    @jax.jit
+    def gat_lane(binsT, perm):
+        return jnp.take(binsT, perm, axis=1)
+
+    t = timeit_chain(gat_lane, binsT, (perm,), iters=3)
+    print(f"lane gather [F,N] u8: {t*1e3:.2f} ms")
+
+
+main()

@@ -1,0 +1,159 @@
+"""Scaling + calibration probe: is the combined-onehot kernel measurement
+real?  Time vs N must scale linearly; calibrate with a dense matmul."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+F, B, CH, K = 28, 64, 8, 16
+
+
+def timeit(fn, args, iters=30):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def make_exact(rb, chunk):
+    def kernel(binsT_ref, w_ref, out_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        for c in range(rb // chunk):
+            b = binsT_ref[:, c * chunk:(c + 1) * chunk].astype(jnp.int32)
+            iota = lax.broadcasted_iota(jnp.int32, (F, B, chunk), 1)
+            onehot = (b[:, None, :] == iota).astype(
+                jnp.bfloat16).reshape(F * B, chunk)
+            acc_ref[:] += lax.dot_general(
+                onehot, w_ref[:, c * chunk:(c + 1) * chunk],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _():
+            out_ref[:] = acc_ref[:]
+
+    @jax.jit
+    def run(binsT, w8):
+        n = binsT.shape[1]
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((F * B, CH), jnp.float32),
+            grid=(n // rb,),
+            in_specs=[pl.BlockSpec((F, rb), lambda i: (0, i)),
+                      pl.BlockSpec((CH, rb), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((F * B, CH), lambda i: (0, 0)),
+            scratch_shapes=[pltpu.VMEM((F * B, CH), jnp.float32)],
+        )(binsT, w8)
+    return run
+
+
+def make_wave(rb, chunk):
+    def kernel(tgt_ref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        for c in range(rb // chunk):
+            sl = slice(c * chunk, (c + 1) * chunk)
+            b = binsT_ref[:, sl].astype(jnp.int32)
+            iota = lax.broadcasted_iota(jnp.int32, (F, B, chunk), 1)
+            onehot = (b[:, None, :] == iota).astype(
+                jnp.bfloat16).reshape(F * B, chunk)
+            l = lid_ref[:, sl]
+            w = w_ref[:, sl]
+            wk = jnp.concatenate(
+                [w * (l == tgt_ref[k]).astype(jnp.bfloat16)
+                 for k in range(K)], axis=0)
+            acc_ref[:] += lax.dot_general(
+                onehot, wk,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        @pl.when(i == pl.num_programs(0) - 1)
+        def _():
+            out_ref[:] = acc_ref[:]
+
+    @jax.jit
+    def run(binsT, w8, lid, targets):
+        n = binsT.shape[1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n // rb,),
+            in_specs=[pl.BlockSpec((F, rb), lambda i, s: (0, i)),
+                      pl.BlockSpec((CH, rb), lambda i, s: (0, i)),
+                      pl.BlockSpec((1, rb), lambda i, s: (0, i))],
+            out_specs=pl.BlockSpec((F * B, K * CH), lambda i, s: (0, 0)),
+            scratch_shapes=[pltpu.VMEM((F * B, K * CH), jnp.float32)],
+        )
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((F * B, K * CH), jnp.float32),
+            grid_spec=grid_spec,
+        )(targets, binsT, w8, lid.reshape(1, -1))
+    return run
+
+
+def main():
+    rng = np.random.RandomState(0)
+    from lightgbm_tpu.ops.pallas_histogram import pack_channels
+
+    # calibration: dense bf16 matmul [4096,4096]x[4096,4096] = 68.7 GMAC
+    a = jnp.asarray(rng.normal(size=(4096, 4096)).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    bm = jnp.asarray(rng.normal(size=(4096, 4096)).astype(np.float32),
+                     dtype=jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+    t = timeit(mm, (a, bm))
+    print(f"calib 4096^3 matmul: {t*1e3:.3f} ms -> {68.7e9/t/1e12:.1f} TMAC/s")
+
+    rb = 16384
+    for n_m in (1, 4, 16):
+        n = n_m * 1_048_576
+        bins = rng.randint(0, B, size=(F, n)).astype(np.uint8)
+        binsT = jnp.asarray(bins)
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        w8 = pack_channels(g, g * g, jnp.ones(n, jnp.float32))
+        lid = jnp.asarray(rng.randint(0, 255, size=n).astype(np.int32))
+        fn = make_exact(rb, 512)
+        t = timeit(fn, (binsT, w8), iters=10)
+        print(f"exact [FB,8] n={n_m}M: {t*1e3:.3f} ms "
+              f"({t/n*1e9:.3f} ns/row)")
+        fnw = make_wave(rb, 512)
+        targets = jnp.arange(K, dtype=jnp.int32)
+        t = timeit(fnw, (binsT, w8, lid, targets), iters=10)
+        print(f"wave [FB,{K*CH}] n={n_m}M: {t*1e3:.3f} ms "
+              f"({t/n*1e9:.3f} ns/row)")
+        if n_m == 1:
+            out = np.asarray(fnw(binsT, w8, lid, targets))
+            oh = out.reshape(F, B, K, CH)
+            got = oh[..., 3, 0] + oh[..., 3, 1]
+            sel = np.asarray(lid) == 3
+            want = np.zeros((F, B))
+            gn = np.asarray(g)
+            for f in range(F):
+                np.add.at(want[f], bins[f][sel], gn[sel])
+            print("  wave leaf-3 grad max abs err:",
+                  float(np.max(np.abs(got - want))))
+
+
+main()

@@ -1,0 +1,135 @@
+"""Chained, dtype-stable probe at 8M rows: sorts, gathers, kernels.
+
+Chaining rule: every step consumes the previous step's output arrays
+unchanged in dtype/shape, so no recompiles and no dispatch dedupe.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, ".")
+F, B, CH, K = 28, 64, 8, 16
+N = 8 * 1024 * 1024
+RB = 16384
+
+
+def chain_time(step, state, iters=8, label=""):
+    state = step(*state)          # compile + warm
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = step(*state)
+    jax.block_until_ready(state)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label}: {dt*1e3:.2f} ms")
+    return dt
+
+
+def main():
+    rng = np.random.RandomState(0)
+    from lightgbm_tpu.ops.pallas_histogram import pack_channels
+    from tools.kernel_probe3 import make_exact, make_wave
+
+    lid = jnp.asarray(rng.randint(0, 255, size=N).astype(np.int32))
+    words12 = [jnp.asarray(rng.randint(-2**31, 2**31 - 1, size=N,
+                                       dtype=np.int64).astype(np.int32))
+               for _ in range(12)]
+    order = jnp.arange(N, dtype=jnp.int32)
+
+    # (a) 12-word stable sort
+    @jax.jit
+    def s12(lid, *pay):
+        out = lax.sort((lid,) + pay, num_keys=1, is_stable=True)
+        # rotate so next call's key differs
+        return (out[1],) + out[2:] + (out[0],)
+
+    chain_time(s12, (lid, *words12), iters=5, label="sort 12-word")
+
+    # (b) 2-word stable sort (argsort)
+    @jax.jit
+    def s2(lid, order):
+        k, v = lax.sort((lid, order), num_keys=1, is_stable=True)
+        return v, k
+
+    chain_time(s2, (lid, order), iters=8, label="sort 2-word")
+
+    # (c) row gather [N, 12] i32 by permutation
+    rows = jnp.stack(words12, axis=1)          # [N, 12]
+    perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+    @jax.jit
+    def rowgat(rows, perm):
+        out = jnp.take(rows, perm, axis=0)
+        return out, out[:, 0].astype(jnp.int32) % N
+
+    chain_time(rowgat, (rows, perm), iters=8, label="row gather [N,12] i32")
+
+    # (d) transpose [F,N] u8 <-> [N,F]
+    binsT = jnp.asarray(rng.randint(0, B, size=(F, N)).astype(np.uint8))
+
+    @jax.jit
+    def tr(binsT):
+        r = binsT.T                            # [N, F]
+        return (r.T,)
+
+    chain_time(lambda b: tr(b), (binsT,), iters=5,
+               label="transpose u8 [F,N]->[N,F]->[F,N] (x2)")
+
+    # (e) lane gather [F, N] u8 by permutation
+    @jax.jit
+    def lanegat(binsT, perm):
+        out = jnp.take(binsT, perm, axis=1)
+        return out, out[0].astype(jnp.int32) % N
+
+    chain_time(lanegat, (binsT, perm), iters=3, label="lane gather [F,N] u8")
+
+    # (f) kernels, dtype-stable chaining (bf16 stays bf16)
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    w8 = pack_channels(g, g * g, jnp.ones(N, jnp.float32))
+    exact = make_exact(RB, 512)
+    wave = make_wave(RB, 512)
+    targets = jnp.arange(K, dtype=jnp.int32)
+
+    @jax.jit
+    def ex_step(w8):
+        out = exact(binsT, w8)
+        nudge = (1.0 + 1e-12 * out[0, 0]).astype(jnp.bfloat16)
+        return (w8 * nudge,)
+
+    chain_time(lambda w: ex_step(w), (w8,), iters=8,
+               label="exact [FB,8] kernel+nudge")
+
+    @jax.jit
+    def wv_step(w8):
+        out = wave(binsT, w8, lid, targets)
+        nudge = (1.0 + 1e-12 * out[0, 0]).astype(jnp.bfloat16)
+        return (w8 * nudge,)
+
+    chain_time(lambda w: wv_step(w), (w8,), iters=8,
+               label="wave [FB,128] kernel+nudge")
+
+    # (g) the nudge alone, to subtract its cost
+    @jax.jit
+    def nudge_only(w8):
+        return (w8 * jnp.bfloat16(1.0),)
+
+    chain_time(lambda w: nudge_only(w), (w8,), iters=8, label="nudge alone")
+
+    # (h) old per-feature kernel for comparison
+    from lightgbm_tpu.ops.pallas_histogram import histogram_all
+
+    @jax.jit
+    def old_step(w8):
+        out = histogram_all(binsT, w8, B, 8192)
+        nudge = (1.0 + 1e-12 * out[0, 0, 0]).astype(jnp.bfloat16)
+        return (w8 * nudge,)
+
+    chain_time(lambda w: old_step(w), (w8,), iters=8,
+               label="OLD per-feature kernel+nudge")
+
+
+main()

@@ -1,0 +1,158 @@
+"""Chained probes of remaining per-iteration suspects at 1M rows, plus
+AOT compile-stage timing of the segment grower."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, ".")
+N = 1_048_576
+F, B = 28, 64
+
+
+def chain_time(step, state, iters=20, label=""):
+    state = step(*state)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = step(*state)
+    jax.block_until_ready(state)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label}: {dt*1e3:.2f} ms")
+    return dt
+
+
+def main():
+    rng = np.random.RandomState(0)
+    lid = jnp.asarray(rng.randint(0, 255, size=N).astype(np.int32))
+    order = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+    # 1. the final inverse-permute scatter
+    @jax.jit
+    def inv_scatter(lid, order):
+        out = jnp.zeros(N, jnp.int32).at[order].set(lid)
+        return out, order
+
+    chain_time(inv_scatter, (lid, order), iters=10,
+               label="scatter zeros.at[order].set(lid) 1M")
+
+    # 2. 254 sequential routing steps in one fori_loop (no hist, no scan)
+    binsT = jnp.asarray(rng.randint(0, B, size=(F, N)).astype(np.uint8))
+
+    @jax.jit
+    def route_loop(lid, binsT):
+        def body(step, lid):
+            f = step % F
+            fcol = lax.dynamic_slice_in_dim(binsT, f, 1, axis=0)[0]
+            go_left = fcol.astype(jnp.int32) <= (step % 31)
+            in_leaf = lid == (step % 17)
+            return jnp.where(in_leaf & ~go_left, step + 300, lid)
+        return lax.fori_loop(0, 254, body, lid), binsT
+
+    chain_time(route_loop, (lid, binsT), iters=5,
+               label="254x routing steps (fori_loop)")
+
+    # 3. 254 sequential best_split pair-scans on tiny hists
+    from lightgbm_tpu.ops.split import (FeatureMeta, SplitParams, best_split)
+    fmeta = FeatureMeta(
+        num_bin=jnp.full(F, B, jnp.int32),
+        missing_type=jnp.zeros(F, jnp.int32),
+        default_bin=jnp.zeros(F, jnp.int32),
+        is_cat=jnp.zeros(F, bool),
+        monotone=jnp.zeros(F, jnp.int32),
+        penalty=jnp.ones(F, jnp.float32))
+    sp = SplitParams(has_cat=False)
+    fmask = jnp.ones(F, jnp.float32)
+
+    @jax.jit
+    def scan_loop(hist0):
+        def body(step, carry):
+            hist, acc = carry
+            infos, gains = jax.vmap(
+                lambda h: best_split(h, jnp.float32(100.0),
+                                     jnp.float32(200.0), jnp.float32(5e5),
+                                     fmeta, sp, fmask)
+            )(hist), None
+            g = infos.gain.sum()
+            return (hist * (1.0 + 1e-9 * g), acc + g)
+        return lax.fori_loop(0, 254, body, (hist0, jnp.float32(0.0)))
+
+    hist0 = jnp.asarray(np.abs(rng.normal(size=(2, F, B, 3))
+                               ).astype(np.float32)) * 10
+    chain_time(lambda h, a: scan_loop(h), (hist0, 0), iters=5,
+               label="254x vmapped pair best_split (has_cat=False)")
+
+    sp_cat = SplitParams(has_cat=True)
+
+    @jax.jit
+    def scan_loop_cat(hist0):
+        def body(step, carry):
+            hist, acc = carry
+            infos, _ = jax.vmap(
+                lambda h: best_split(h, jnp.float32(100.0),
+                                     jnp.float32(200.0), jnp.float32(5e5),
+                                     fmeta, sp_cat, fmask)
+            )(hist), None
+            g = infos.gain.sum()
+            return (hist * (1.0 + 1e-9 * g), acc + g)
+        return lax.fori_loop(0, 254, body, (hist0, jnp.float32(0.0)))
+
+    chain_time(lambda h, a: scan_loop_cat(h), (hist0, 0), iters=5,
+               label="254x vmapped pair best_split (has_cat=True)")
+
+    # 4. 4x compaction sort at 1M (12-word payload)
+    words = [jnp.asarray(rng.randint(-2**31, 2**31 - 1, size=N,
+                                     dtype=np.int64).astype(np.int32))
+             for _ in range(12)]
+
+    @jax.jit
+    def four_sorts(lid, *pay):
+        for _ in range(4):
+            out = lax.sort((lid,) + pay, num_keys=1, is_stable=True)
+            lid, pay = out[1], out[2:] + (out[0],)
+        return (lid,) + pay
+
+    chain_time(four_sorts, (lid, *words), iters=5, label="4x 12-word sort 1M")
+
+    # 5. 254 segment-kernel launches with ~1.5-block intervals, rb=32768
+    from lightgbm_tpu.ops.pallas_histogram import (histogram_segment,
+                                                   pack_channels)
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    w8 = pack_channels(g, g * g + 0.5, jnp.ones(N, jnp.float32))
+    for rb in (8192, 32768):
+        nblk = N // rb
+
+        @jax.jit
+        def seg_loop(w8, lid):
+            def body(step, acc):
+                lo = step % (nblk - 2)
+                out = histogram_segment(binsT, w8, lid, lo, 2,
+                                        step % 255, B, rb)
+                return acc + out[0, 0, 0]
+            return lax.fori_loop(0, 254, body, jnp.float32(0.0)), lid
+
+        chain_time(seg_loop, (w8, lid), iters=3,
+                   label=f"254x segment launches 2-block intervals rb={rb}")
+
+    # 6. AOT compile-stage timing of the grower
+    from lightgbm_tpu.models.grower import GrowerParams
+    from lightgbm_tpu.models.grower_seg import make_grow_tree_segment
+    from lightgbm_tpu.ops.split import SplitParams as SP
+    params = GrowerParams(num_leaves=255, hist_backend="pallas",
+                          split=SP(min_sum_hessian_in_leaf=100.0,
+                                   has_cat=False))
+    grow = make_grow_tree_segment(B, params, 8192)
+    member = jnp.ones(N, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    t0 = time.perf_counter()
+    lowered = grow.lower(binsT, g, g, member, fmeta, fmask, key)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    print(f"grower trace/lower: {t1-t0:.1f}s   compile: {t2-t1:.1f}s")
+
+
+main()

@@ -1,0 +1,29 @@
+"""Parse a jax-profiler xplane dump into top-op self-time table.
+
+Usage: python tools/parse_profile.py <logdir>
+"""
+import glob
+import json
+import sys
+
+
+def main():
+    logdir = sys.argv[1]
+    paths = sorted(glob.glob(logdir + "/**/*.xplane.pb", recursive=True))
+    if not paths:
+        print("no xplane.pb under", logdir)
+        return
+    path = paths[-1]
+    from tensorboard_plugin_profile.convert import raw_to_tool_data
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        [path], "framework_op_stats^", {"tqx": "out:csv"})
+    if isinstance(data, bytes):
+        data = data.decode()
+    lines = data.splitlines()
+    print(lines[0])
+    for ln in lines[1:40]:
+        print(ln)
+
+
+if __name__ == "__main__":
+    main()
