@@ -1,0 +1,65 @@
+"""Build lib_lightgbm_tpu.so — the native C API shared library.
+
+The reference ships lib_lightgbm.so built by CMake
+(CMakeLists.txt); here the equivalent artifact is compiled from
+src/capi/c_api.cpp with the system g++, embedding CPython so the library
+works both linked into a C host program and loaded via ctypes from
+Python (the python package's own binding path).
+
+Usage:
+    python -m lightgbm_tpu.build_capi [output_dir]
+or programmatically: build_capi() -> path to the .so (cached).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+
+def _source_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(here, "src", "capi", "c_api.cpp")
+
+
+def lib_path(out_dir: str | None = None) -> str:
+    if out_dir is None:
+        out_dir = os.path.join(os.path.dirname(_source_path()))
+    return os.path.join(out_dir, "lib_lightgbm_tpu.so")
+
+
+def build_capi(out_dir: str | None = None, force: bool = False) -> str:
+    src = _source_path()
+    out = lib_path(out_dir)
+    if (not force and os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    include = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ldlib = sysconfig.get_config_var("LDLIBRARY") or ""
+    pyver = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    cmd = [
+        "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+        f"-I{include}", src, "-o", out,
+    ]
+    if libdir:
+        cmd.insert(-2, f"-L{libdir}")
+        cmd.insert(-2, f"-Wl,-rpath,{libdir}")
+    # link libpython so a pure-C host gets the interpreter; when loaded
+    # from Python via ctypes the symbols are already present and the
+    # dependency is satisfied trivially
+    abiflags = sysconfig.get_config_var("ABIFLAGS") or ""
+    cmd.insert(-2, f"-l{pyver}{abiflags}")
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"building lib_lightgbm_tpu.so failed:\n{' '.join(cmd)}\n"
+            f"{proc.stderr[-2000:]}")
+    return out
+
+
+if __name__ == "__main__":
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else None
+    print(build_capi(out_dir, force=True))

@@ -1,0 +1,716 @@
+"""Python side of the C API (the bridge behind lib_lightgbm_tpu.so).
+
+src/capi/c_api.cpp marshals every LGBM_* call into this module: raw
+pointers arrive as integer addresses and are wrapped with zero-copy numpy
+views; handles are integer ids minted here.  Semantics follow the
+reference implementation (src/c_api.cpp:98-1831): the internal Booster
+wrapper (c_api.cpp:98) maps onto basic.Booster, datasets onto
+basic.Dataset.
+
+This module is also directly importable for in-process testing — the C
+layer adds only the ABI, error ring and GIL handling.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .utils.log import LightGBMError
+
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
+C_API_DTYPE_INT8 = 4
+
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+C_API_PREDICT_CONTRIB = 3
+
+_DTYPES = {
+    C_API_DTYPE_FLOAT32: np.float32,
+    C_API_DTYPE_FLOAT64: np.float64,
+    C_API_DTYPE_INT32: np.int32,
+    C_API_DTYPE_INT64: np.int64,
+    C_API_DTYPE_INT8: np.int8,
+}
+
+_handles: Dict[int, object] = {}
+_next_id = [1]
+
+
+def _register(obj) -> int:
+    hid = _next_id[0]
+    _next_id[0] += 1
+    _handles[hid] = obj
+    return hid
+
+
+def _get(hid: int):
+    if hid == 0:
+        return None
+    try:
+        return _handles[hid]
+    except KeyError:
+        raise LightGBMError(f"Invalid handle {hid}")
+
+
+def free_handle(hid: int) -> None:
+    _handles.pop(hid, None)
+
+
+def _view(addr: int, count: int, dtype_code: int) -> np.ndarray:
+    """Zero-copy numpy view over caller memory."""
+    dt = np.dtype(_DTYPES[dtype_code])
+    if addr == 0 or count == 0:
+        return np.empty(0, dtype=dt)
+    buf = (ctypes.c_char * (count * dt.itemsize)).from_address(addr)
+    return np.frombuffer(buf, dtype=dt, count=count)
+
+
+def _params_dict(parameters: Optional[str]) -> dict:
+    from .config import str2map
+    return str2map(parameters or "")
+
+
+# ===================================================================
+# Dataset
+# ===================================================================
+
+def _finish_dataset(ds: Dataset) -> int:
+    ds.construct()
+    return _register(ds)
+
+
+def dataset_create_from_file(filename: str, parameters: str,
+                             ref_id: int) -> int:
+    ref = _get(ref_id)
+    ds = Dataset(filename, params=_params_dict(parameters), reference=ref)
+    return _finish_dataset(ds)
+
+
+def dataset_create_from_mat(addr: int, data_type: int, nrow: int, ncol: int,
+                            is_row_major: int, parameters: str,
+                            ref_id: int) -> int:
+    flat = _view(addr, nrow * ncol, data_type)
+    mat = (flat.reshape(nrow, ncol) if is_row_major
+           else flat.reshape(ncol, nrow).T)
+    ds = Dataset(np.array(mat, dtype=np.float64),
+                 params=_params_dict(parameters), reference=_get(ref_id))
+    return _finish_dataset(ds)
+
+
+def dataset_create_from_mats(nmat: int, data_addr: int, data_type: int,
+                             nrow_addr: int, ncol: int, is_row_major: int,
+                             parameters: str, ref_id: int) -> int:
+    ptrs = _view(data_addr, nmat, C_API_DTYPE_INT64)
+    nrows = _view(nrow_addr, nmat, C_API_DTYPE_INT32)
+    parts = []
+    for i in range(nmat):
+        flat = _view(int(ptrs[i]), int(nrows[i]) * ncol, data_type)
+        parts.append(flat.reshape(int(nrows[i]), ncol) if is_row_major
+                     else flat.reshape(ncol, int(nrows[i])).T)
+    mat = np.concatenate(parts, axis=0).astype(np.float64)
+    ds = Dataset(mat, params=_params_dict(parameters), reference=_get(ref_id))
+    return _finish_dataset(ds)
+
+
+def _csr_to_dense(indptr_addr, indptr_type, indices_addr, data_addr,
+                  data_type, nindptr, nelem, num_col):
+    import scipy.sparse as sp
+    indptr = np.array(_view(indptr_addr, nindptr, indptr_type))
+    indices = np.array(_view(indices_addr, nelem, C_API_DTYPE_INT32))
+    data = np.array(_view(data_addr, nelem, data_type), dtype=np.float64)
+    return sp.csr_matrix((data, indices, indptr),
+                         shape=(nindptr - 1, num_col)).toarray()
+
+
+def dataset_create_from_csr(indptr_addr: int, indptr_type: int,
+                            indices_addr: int, data_addr: int,
+                            data_type: int, nindptr: int, nelem: int,
+                            num_col: int, parameters: str,
+                            ref_id: int) -> int:
+    import scipy.sparse as sp
+    indptr = np.array(_view(indptr_addr, nindptr, indptr_type))
+    indices = np.array(_view(indices_addr, nelem, C_API_DTYPE_INT32))
+    data = np.array(_view(data_addr, nelem, data_type), dtype=np.float64)
+    csr = sp.csr_matrix((data, indices, indptr),
+                        shape=(nindptr - 1, num_col))
+    ds = Dataset(csr, params=_params_dict(parameters), reference=_get(ref_id))
+    return _finish_dataset(ds)
+
+
+def dataset_create_from_csc(col_ptr_addr: int, col_ptr_type: int,
+                            indices_addr: int, data_addr: int,
+                            data_type: int, ncol_ptr: int, nelem: int,
+                            num_row: int, parameters: str,
+                            ref_id: int) -> int:
+    import scipy.sparse as sp
+    col_ptr = np.array(_view(col_ptr_addr, ncol_ptr, col_ptr_type))
+    indices = np.array(_view(indices_addr, nelem, C_API_DTYPE_INT32))
+    data = np.array(_view(data_addr, nelem, data_type), dtype=np.float64)
+    csc = sp.csc_matrix((data, indices, col_ptr),
+                        shape=(num_row, ncol_ptr - 1))
+    ds = Dataset(csc, params=_params_dict(parameters), reference=_get(ref_id))
+    return _finish_dataset(ds)
+
+
+def dataset_create_from_sampled_column(sample_data_addr: int,
+                                       sample_indices_addr: int, ncol: int,
+                                       num_per_col_addr: int,
+                                       num_sample_row: int,
+                                       num_total_row: int,
+                                       parameters: str) -> int:
+    """Bin mappers from sampled columns + empty dataset awaiting PushRows
+    (reference c_api.cpp:446: CostructFromSampleData)."""
+    data_ptrs = _view(sample_data_addr, ncol, C_API_DTYPE_INT64)
+    idx_ptrs = _view(sample_indices_addr, ncol, C_API_DTYPE_INT64)
+    num_per_col = _view(num_per_col_addr, ncol, C_API_DTYPE_INT32)
+    # materialize the sampled matrix (missing entries = nan so bin bounds
+    # come only from present values; push fills real values later)
+    sample = np.full((num_sample_row, ncol), np.nan, dtype=np.float64)
+    for c in range(ncol):
+        n = int(num_per_col[c])
+        vals = _view(int(data_ptrs[c]), n, C_API_DTYPE_FLOAT64)
+        idxs = _view(int(idx_ptrs[c]), n, C_API_DTYPE_INT32)
+        sample[idxs, c] = vals
+    ds = Dataset(sample, params=_params_dict(parameters))
+    ds.construct()
+    handle = ds._handle
+    pushed = _PushTarget(handle, num_total_row, ncol,
+                         _params_dict(parameters))
+    return _register(pushed)
+
+
+class _PushTarget:
+    """Dataset under streaming construction (PushRows*).
+
+    Bin boundaries come from the alignment source, never from the pushed
+    rows themselves (reference: CostructFromSampleData builds mappers from
+    the sample, c_api.cpp:446; CreateByReference aligns with the reference
+    dataset) — ``reference`` is a basic.Dataset to align with, or
+    ``sampled`` a TpuDataset holding mappers built from sampled columns.
+    """
+
+    def __init__(self, sampled_handle, num_total_row: int, ncol: int,
+                 params: dict, reference: Optional[Dataset] = None):
+        self.sampled = sampled_handle        # TpuDataset with bin mappers
+        self.reference = reference
+        self.num_total_row = num_total_row
+        self.ncol = ncol
+        self.params = params
+        self.rows = np.zeros((num_total_row, ncol), dtype=np.float64)
+        self.pushed = 0
+        self.dataset: Optional[Dataset] = None
+
+    def push(self, mat: np.ndarray, start_row: int) -> None:
+        n = mat.shape[0]
+        self.rows[start_row:start_row + n] = mat
+        self.pushed += n
+        if self.pushed >= self.num_total_row:
+            self.finish()
+
+    def finish(self) -> None:
+        if self.reference is not None:
+            ds = Dataset(self.rows, params=self.params,
+                         reference=self.reference)
+            ds.construct()
+        else:
+            from .config import Config
+            from .core.dataset import TpuDataset
+            handle = TpuDataset.from_numpy(
+                self.rows, config=Config.from_params(self.params),
+                reference=self.sampled)
+            ds = Dataset(self.rows, params=self.params)
+            ds._handle = handle
+        self.dataset = ds
+
+    def as_dataset(self) -> Dataset:
+        if self.dataset is None:
+            self.finish()
+        return self.dataset
+
+
+def _resolve_dataset(hid: int) -> Dataset:
+    obj = _get(hid)
+    if isinstance(obj, _PushTarget):
+        ds = obj.as_dataset()
+        _handles[hid] = ds
+        return ds
+    return obj
+
+
+def dataset_create_by_reference(ref_id: int, num_total_row: int) -> int:
+    ref = _resolve_dataset(ref_id)
+    tgt = _PushTarget(ref.construct()._handle, num_total_row,
+                      ref.num_feature(), dict(ref.params), reference=ref)
+    return _register(tgt)
+
+
+def dataset_push_rows(hid: int, data_addr: int, data_type: int, nrow: int,
+                      ncol: int, start_row: int) -> None:
+    tgt = _get(hid)
+    if not isinstance(tgt, _PushTarget):
+        raise LightGBMError("PushRows on a finished dataset")
+    flat = _view(data_addr, nrow * ncol, data_type)
+    tgt.push(np.array(flat.reshape(nrow, ncol), dtype=np.float64), start_row)
+
+
+def dataset_push_rows_by_csr(hid: int, indptr_addr: int, indptr_type: int,
+                             indices_addr: int, data_addr: int,
+                             data_type: int, nindptr: int, nelem: int,
+                             num_col: int, start_row: int) -> None:
+    tgt = _get(hid)
+    if not isinstance(tgt, _PushTarget):
+        raise LightGBMError("PushRowsByCSR on a finished dataset")
+    mat = _csr_to_dense(indptr_addr, indptr_type, indices_addr, data_addr,
+                        data_type, nindptr, nelem, num_col)
+    tgt.push(mat, start_row)
+
+
+def dataset_get_subset(hid: int, indices_addr: int, num_indices: int,
+                       parameters: str) -> int:
+    ds = _resolve_dataset(hid)
+    idx = np.array(_view(indices_addr, num_indices, C_API_DTYPE_INT32))
+    sub = ds.subset(idx.tolist(), params=_params_dict(parameters))
+    sub.construct()
+    return _register(sub)
+
+
+def dataset_set_feature_names(hid: int, names: List[str]) -> None:
+    ds = _resolve_dataset(hid)
+    ds.feature_name = list(names)
+    if ds._handle is not None:
+        ds._handle.feature_names = list(names)
+
+
+def dataset_get_feature_names(hid: int) -> List[str]:
+    ds = _resolve_dataset(hid)
+    ds.construct()
+    return list(ds._handle.feature_names)
+
+
+def dataset_save_binary(hid: int, filename: str) -> None:
+    _resolve_dataset(hid).save_binary(filename)
+
+
+def dataset_dump_text(hid: int, filename: str) -> None:
+    ds = _resolve_dataset(hid)
+    ds.construct()
+    h = ds._handle
+    with open(filename, "w") as fh:
+        fh.write(f"num_data: {h.num_data}\n")
+        fh.write(f"num_feature: {h.num_total_features}\n")
+        for i, bm in enumerate(h.bin_mappers):
+            fh.write(f"feature {i} num_bin={bm.num_bin}\n")
+        np.savetxt(fh, h.binned[: min(h.num_data, 100)], fmt="%d")
+
+
+_FIELD_SET_DTYPE = {"label": np.float32, "weight": np.float32,
+                    "init_score": np.float64, "group": np.int32,
+                    "query": np.int32}
+
+
+def dataset_set_field(hid: int, field_name: str, data_addr: int,
+                      num_element: int, dtype_code: int) -> None:
+    ds = _resolve_dataset(hid)
+    vals = np.array(_view(data_addr, num_element, dtype_code))
+    if field_name in ("group", "query"):
+        ds.set_field("group", vals)
+    else:
+        ds.set_field(field_name, vals)
+
+
+def dataset_get_field(hid: int, field_name: str):
+    ds = _resolve_dataset(hid)
+    vals = ds.get_field(field_name)
+    if vals is None:
+        return (0, 0, C_API_DTYPE_FLOAT32)
+    if field_name in ("label", "weight"):
+        arr = np.ascontiguousarray(np.asarray(vals), dtype=np.float32)
+        code = C_API_DTYPE_FLOAT32
+    elif field_name == "init_score":
+        arr = np.ascontiguousarray(np.asarray(vals), dtype=np.float64)
+        code = C_API_DTYPE_FLOAT64
+    else:
+        arr = np.ascontiguousarray(np.asarray(vals), dtype=np.int32)
+        code = C_API_DTYPE_INT32
+    # keep the buffer alive on the python Dataset (reference keeps the
+    # pointer into Metadata's vectors, dataset.h:118)
+    if not hasattr(ds, "_field_buffers"):
+        ds._field_buffers = {}
+    ds._field_buffers[field_name] = arr
+    return (arr.ctypes.data, int(arr.size), code)
+
+
+def dataset_update_param(hid: int, parameters: str) -> None:
+    ds = _resolve_dataset(hid)
+    ds.params.update(_params_dict(parameters))
+
+
+def dataset_get_num_data(hid: int) -> int:
+    return _resolve_dataset(hid).num_data()
+
+
+def dataset_get_num_feature(hid: int) -> int:
+    return _resolve_dataset(hid).num_feature()
+
+
+def dataset_add_features_from(tgt_id: int, src_id: int) -> None:
+    tgt = _resolve_dataset(tgt_id)
+    src = _resolve_dataset(src_id)
+    tgt.construct()
+    src.construct()
+    tgt._handle.add_features_from(src._handle)
+
+
+# ===================================================================
+# Booster
+# ===================================================================
+
+def booster_create(train_id: int, parameters: str) -> int:
+    train = _resolve_dataset(train_id)
+    bst = Booster(params=_params_dict(parameters), train_set=train)
+    bst._valid_handles = []       # parallel to gbdt valid sets
+    return _register(bst)
+
+
+def booster_create_from_modelfile(filename: str):
+    bst = Booster(model_file=filename)
+    return (_register(bst), bst.gbdt.current_iteration())
+
+
+def booster_load_model_from_string(model_str: str):
+    bst = Booster(model_str=model_str)
+    return (_register(bst), bst.gbdt.current_iteration())
+
+
+def booster_shuffle_models(hid: int, start_iter: int, end_iter: int) -> None:
+    bst = _get(hid)
+    models = bst.gbdt.models
+    n = len(models)
+    s = max(start_iter, 0)
+    e = n if end_iter <= 0 else min(end_iter, n)
+    seg = models[s:e]
+    rng = np.random.RandomState(bst.gbdt.config.seed)
+    rng.shuffle(seg)
+    bst.gbdt.models = models[:s] + list(seg) + models[e:]
+
+
+def booster_merge(hid: int, other_id: int) -> None:
+    bst, other = _get(hid), _get(other_id)
+    bst.gbdt.models = list(bst.gbdt.models) + list(other.gbdt.models)
+    bst.gbdt.iter_ += other.gbdt.current_iteration()
+
+
+def booster_add_valid_data(hid: int, valid_id: int) -> None:
+    bst = _get(hid)
+    valid = _resolve_dataset(valid_id)
+    name = f"valid_{len(bst._valid_names)}"
+    bst.add_valid(valid, name)
+
+
+def booster_reset_training_data(hid: int, train_id: int) -> None:
+    bst = _get(hid)
+    train = _resolve_dataset(train_id)
+    train.construct()
+    if bst.objective is not None:
+        bst.objective.init(train._handle.metadata, train._handle.num_data)
+    bst.gbdt.reset_train_data(train._handle)
+    bst.train_set = train
+
+
+def booster_reset_parameter(hid: int, parameters: str) -> None:
+    from .config import Config
+    bst = _get(hid)
+    merged = dict(bst.params)
+    merged.update(_params_dict(parameters))
+    bst.params = merged
+    bst.config = Config.from_params(merged)
+    bst.gbdt.config = bst.config
+    bst.gbdt.shrinkage_rate = bst.config.learning_rate
+    bst.gbdt._fused_fns = None    # params may change the traced step
+    bst._setup_metrics()
+
+
+def booster_get_num_classes(hid: int) -> int:
+    return max(1, _get(hid).config.num_class)
+
+
+def booster_update_one_iter(hid: int) -> int:
+    return int(bool(_get(hid).update()))
+
+
+def booster_update_one_iter_custom(hid: int, grad_addr: int,
+                                   hess_addr: int) -> int:
+    bst = _get(hid)
+    n = bst.gbdt.num_data * bst.gbdt.num_tree_per_iteration
+    grad = np.array(_view(grad_addr, n, C_API_DTYPE_FLOAT32))
+    hess = np.array(_view(hess_addr, n, C_API_DTYPE_FLOAT32))
+    return int(bool(bst.gbdt.train_one_iter(grad, hess)))
+
+
+def booster_refit(hid: int, leaf_preds_addr: int, nrow: int,
+                  ncol: int) -> None:
+    bst = _get(hid)
+    leaf_preds = np.array(_view(leaf_preds_addr, nrow * ncol,
+                                C_API_DTYPE_INT32)).reshape(nrow, ncol)
+    bst.gbdt.refit(leaf_preds)
+
+
+def booster_rollback_one_iter(hid: int) -> None:
+    _get(hid).rollback_one_iter()
+
+
+def booster_get_current_iteration(hid: int) -> int:
+    return _get(hid).gbdt.current_iteration()
+
+
+def booster_num_model_per_iteration(hid: int) -> int:
+    return _get(hid).num_model_per_iteration()
+
+
+def booster_number_of_total_model(hid: int) -> int:
+    return _get(hid).num_trees()
+
+
+def booster_get_eval_counts(hid: int) -> int:
+    return len(_get(hid)._metric_names_expanded())
+
+
+def booster_get_eval_names(hid: int) -> List[str]:
+    return _get(hid)._metric_names_expanded()
+
+
+def booster_get_feature_names(hid: int) -> List[str]:
+    return _get(hid).feature_name()
+
+
+def booster_get_num_feature(hid: int) -> int:
+    return _get(hid).gbdt.max_feature_idx + 1
+
+
+def booster_get_eval(hid: int, data_idx: int, out_addr: int) -> int:
+    bst = _get(hid)
+    if data_idx == 0:
+        res = bst.gbdt.eval_train()
+    else:
+        res = bst.gbdt.eval_valid(data_idx - 1)
+    vals = np.array([v for (_, v, _) in res], dtype=np.float64)
+    out = _view(out_addr, len(vals), C_API_DTYPE_FLOAT64)
+    out[:] = vals
+    return len(vals)
+
+
+def _inner_scores(bst: Booster, data_idx: int) -> np.ndarray:
+    if data_idx == 0:
+        return np.asarray(bst.gbdt.train_score, dtype=np.float64)
+    return np.asarray(bst.gbdt.valid_scores[data_idx - 1], dtype=np.float64)
+
+
+def booster_get_num_predict(hid: int, data_idx: int) -> int:
+    return int(_inner_scores(_get(hid), data_idx).size)
+
+
+def booster_get_predict(hid: int, data_idx: int, out_addr: int) -> int:
+    """Raw scores of train/valid set, row-major [N, C]
+    (reference Booster::GetPredictAt, gbdt.cpp:GetPredictAt)."""
+    bst = _get(hid)
+    score = _inner_scores(bst, data_idx)        # [C, N]
+    flat = score.T.reshape(-1)
+    out = _view(out_addr, flat.size, C_API_DTYPE_FLOAT64)
+    out[:] = flat
+    return flat.size
+
+
+def booster_calc_num_predict(hid: int, num_row: int, predict_type: int,
+                             num_iteration: int) -> int:
+    bst = _get(hid)
+    C = bst.num_model_per_iteration()
+    n_iter = bst.gbdt.current_iteration()
+    if num_iteration > 0:
+        n_iter = min(n_iter, num_iteration)
+    if predict_type == C_API_PREDICT_LEAF_INDEX:
+        return num_row * C * n_iter
+    if predict_type == C_API_PREDICT_CONTRIB:
+        return num_row * C * (bst.gbdt.max_feature_idx + 2)
+    return num_row * C
+
+
+def _predict_common(bst: Booster, X: np.ndarray, predict_type: int,
+                    num_iteration: int, out_addr: int) -> int:
+    kwargs = dict(num_iteration=num_iteration if num_iteration > 0 else -1)
+    if predict_type == C_API_PREDICT_RAW_SCORE:
+        res = bst.predict(X, raw_score=True, **kwargs)
+    elif predict_type == C_API_PREDICT_LEAF_INDEX:
+        res = bst.predict(X, pred_leaf=True, **kwargs)
+    elif predict_type == C_API_PREDICT_CONTRIB:
+        res = bst.predict(X, pred_contrib=True, **kwargs)
+    else:
+        res = bst.predict(X, **kwargs)
+    flat = np.asarray(res, dtype=np.float64).reshape(-1)
+    out = _view(out_addr, flat.size, C_API_DTYPE_FLOAT64)
+    out[:] = flat
+    return flat.size
+
+
+def booster_predict_for_mat(hid: int, data_addr: int, data_type: int,
+                            nrow: int, ncol: int, is_row_major: int,
+                            predict_type: int, num_iteration: int,
+                            parameter: str, out_addr: int) -> int:
+    bst = _get(hid)
+    flat = _view(data_addr, nrow * ncol, data_type)
+    X = (flat.reshape(nrow, ncol) if is_row_major
+         else flat.reshape(ncol, nrow).T)
+    return _predict_common(bst, np.array(X, dtype=np.float64), predict_type,
+                           num_iteration, out_addr)
+
+
+def booster_predict_for_mats(hid: int, data_addr: int, data_type: int,
+                             nrow: int, ncol: int, predict_type: int,
+                             num_iteration: int, parameter: str,
+                             out_addr: int) -> int:
+    ptrs = _view(data_addr, nrow, C_API_DTYPE_INT64)
+    X = np.zeros((nrow, ncol), dtype=np.float64)
+    for i in range(nrow):
+        X[i] = _view(int(ptrs[i]), ncol, data_type)
+    return _predict_common(_get(hid), X, predict_type, num_iteration,
+                           out_addr)
+
+
+def booster_predict_for_csr(hid: int, indptr_addr: int, indptr_type: int,
+                            indices_addr: int, data_addr: int,
+                            data_type: int, nindptr: int, nelem: int,
+                            num_col: int, predict_type: int,
+                            num_iteration: int, parameter: str,
+                            out_addr: int) -> int:
+    X = _csr_to_dense(indptr_addr, indptr_type, indices_addr, data_addr,
+                      data_type, nindptr, nelem, num_col)
+    return _predict_common(_get(hid), X, predict_type, num_iteration,
+                           out_addr)
+
+
+def booster_predict_for_csc(hid: int, col_ptr_addr: int, col_ptr_type: int,
+                            indices_addr: int, data_addr: int,
+                            data_type: int, ncol_ptr: int, nelem: int,
+                            num_row: int, predict_type: int,
+                            num_iteration: int, parameter: str,
+                            out_addr: int) -> int:
+    col_ptr = _view(col_ptr_addr, ncol_ptr, col_ptr_type)
+    indices = _view(indices_addr, nelem, C_API_DTYPE_INT32)
+    data = _view(data_addr, nelem, data_type)
+    X = np.zeros((num_row, ncol_ptr - 1), dtype=np.float64)
+    for c in range(ncol_ptr - 1):
+        lo, hi = int(col_ptr[c]), int(col_ptr[c + 1])
+        X[indices[lo:hi], c] = data[lo:hi]
+    return _predict_common(_get(hid), X, predict_type, num_iteration,
+                           out_addr)
+
+
+def booster_predict_for_file(hid: int, data_filename: str,
+                             data_has_header: int, predict_type: int,
+                             num_iteration: int, parameter: str,
+                             result_filename: str) -> None:
+    from .core.parser import parse_file_to_matrix
+    bst = _get(hid)
+    X, _ = parse_file_to_matrix(data_filename, bool(data_has_header),
+                                bst.gbdt.max_feature_idx + 1)
+    kwargs = dict(num_iteration=num_iteration if num_iteration > 0 else -1)
+    if predict_type == C_API_PREDICT_RAW_SCORE:
+        res = bst.predict(X, raw_score=True, **kwargs)
+    elif predict_type == C_API_PREDICT_LEAF_INDEX:
+        res = bst.predict(X, pred_leaf=True, **kwargs)
+    elif predict_type == C_API_PREDICT_CONTRIB:
+        res = bst.predict(X, pred_contrib=True, **kwargs)
+    else:
+        res = bst.predict(X, **kwargs)
+    res = np.asarray(res)
+    if res.ndim == 1:
+        res = res[:, None]
+    with open(result_filename, "w") as fh:
+        for row in res:
+            fh.write("\t".join(repr(float(v)) for v in row) + "\n")
+
+
+def booster_save_model(hid: int, start_iteration: int, num_iteration: int,
+                       filename: str) -> None:
+    _get(hid).save_model(filename, num_iteration=num_iteration,
+                         start_iteration=start_iteration)
+
+
+def booster_save_model_to_string(hid: int, start_iteration: int,
+                                 num_iteration: int) -> str:
+    return _get(hid).model_to_string(num_iteration=num_iteration,
+                                     start_iteration=start_iteration)
+
+
+def booster_dump_model(hid: int, start_iteration: int,
+                       num_iteration: int) -> str:
+    return json.dumps(_get(hid).dump_model(num_iteration=num_iteration))
+
+
+def booster_get_leaf_value(hid: int, tree_idx: int, leaf_idx: int) -> float:
+    bst = _get(hid)
+    return float(bst.gbdt.models[tree_idx].leaf_value[leaf_idx])
+
+
+def booster_set_leaf_value(hid: int, tree_idx: int, leaf_idx: int,
+                           val: float) -> None:
+    bst = _get(hid)
+    bst.gbdt.models[tree_idx].leaf_value[leaf_idx] = val
+
+
+def booster_feature_importance(hid: int, num_iteration: int,
+                               importance_type: int, out_addr: int) -> None:
+    bst = _get(hid)
+    kind = "split" if importance_type == 0 else "gain"
+    imp = bst.feature_importance(kind, num_iteration)
+    out = _view(out_addr, len(imp), C_API_DTYPE_FLOAT64)
+    out[:] = imp
+
+
+# ===================================================================
+# Network
+# ===================================================================
+
+def network_init(machines: str, local_listen_port: int,
+                 listen_time_out: int, num_machines: int) -> None:
+    from .parallel import network
+    network.init_from_machines(machines, num_machines)
+
+
+def network_free() -> None:
+    from .parallel import network
+    network.dispose()
+
+
+def network_init_with_functions(num_machines: int, rank: int,
+                                reduce_scatter_addr: int,
+                                allgather_addr: int) -> None:
+    """External-collective seam (LGBM_NetworkInitWithFunctions,
+    c_api.cpp:1572).  On the TPU build collectives are XLA ops over the
+    mesh, so the function pointers are recorded for introspection and the
+    logical (num_machines, rank) registered with the network layer."""
+    from .parallel import network
+    network.init_with_functions(reduce_scatter_addr, allgather_addr,
+                                rank, num_machines)
+
+
+# helper used by basic.Booster metric names
+def _metric_names_expanded(self: Booster) -> List[str]:
+    names = []
+    for m in self.gbdt.metrics:
+        if hasattr(m, "eval_multi"):
+            names.extend(f"{m.name}@{k}" for k in m.eval_at)
+        else:
+            names.append(m.name)
+    return names
+
+
+Booster._metric_names_expanded = _metric_names_expanded

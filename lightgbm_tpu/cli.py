@@ -155,33 +155,10 @@ class Application:
 
     def _load_predict_matrix(self, booster):
         cfg = self.config
-        from .core.parser import (_detect_format, _parse_dense,
-                                  _parse_libsvm, _column_index)
-        with open(cfg.data) as fh:
-            lines = fh.readlines()
-        header_names = None
-        if cfg.header and lines:
-            sep = "\t" if "\t" in lines[0] else ","
-            header_names = lines[0].strip().split(sep)
-            lines = lines[1:]
-        fmt = _detect_format(lines[:32])
-        if fmt == "libsvm":
-            mat = _parse_libsvm(lines)
-            label_col = 0
-        else:
-            sep = "\t" if fmt == "tsv" else ","
-            mat = _parse_dense(lines, sep)
-            label_col = (_column_index(cfg.label_column, header_names)
-                         if cfg.label_column else 0)
-        label = mat[:, label_col]
-        X = np.delete(mat, label_col, axis=1)
-        # align width with the trained model
-        n_feat = booster.gbdt.max_feature_idx + 1
-        if X.shape[1] < n_feat:
-            X = np.pad(X, ((0, 0), (0, n_feat - X.shape[1])))
-        elif X.shape[1] > n_feat:
-            X = X[:, :n_feat]
-        return X, label
+        from .core.parser import parse_file_to_matrix
+        return parse_file_to_matrix(
+            cfg.data, bool(cfg.header), booster.gbdt.max_feature_idx + 1,
+            label_column=cfg.label_column)
 
     # ---------------------------------------------------------- model convert
     def convert_model(self) -> None:
@@ -206,10 +183,15 @@ class Application:
         from .basic import Booster as PyBooster
         booster = PyBooster(model_file=cfg.input_model)
         X, label = self._load_predict_matrix(booster)
+        if label is None:
+            log_fatal("Refit requires labeled data; the data file has no "
+                      "label column")
         leaf_preds = booster.predict(X, pred_leaf=True)
+        from .core.metadata import Metadata
         from .models.refit import refit_model
-        refit_model(booster.gbdt, X, label, np.asarray(leaf_preds),
-                    cfg)
+        meta = Metadata(len(label))
+        meta.set_label(np.asarray(label))
+        refit_model(booster.gbdt, meta, np.asarray(leaf_preds), cfg)
         self._save_model(booster.gbdt, cfg.output_model)
         log_info(f"Finished refit, saved model to {cfg.output_model}")
 

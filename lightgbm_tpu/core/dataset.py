@@ -220,6 +220,44 @@ class TpuDataset:
                      **kwargs) -> "TpuDataset":
         return TpuDataset.from_numpy(data, label=label, reference=self, **kwargs)
 
+    def add_features_from(self, other: "TpuDataset") -> None:
+        """Merge another dataset's feature columns into this one
+        (Dataset::addFeaturesFrom, src/io/dataset.cpp:AddFeaturesFrom;
+        LGBM_DatasetAddFeaturesFrom).  Row counts must match; the source's
+        metadata (labels etc.) is ignored, as in the reference."""
+        from ..utils.log import check
+        check(self.num_data == other.num_data,
+              "Cannot add features from other Dataset with a different "
+              "number of rows")
+        offset = self.num_total_features
+        self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
+        self.used_feature_indices = np.concatenate([
+            self.used_feature_indices,
+            np.asarray(other.used_feature_indices, dtype=np.int32) + offset,
+        ]).astype(np.int32)
+        self.num_total_features += other.num_total_features
+        self.feature_names = list(self.feature_names) + [
+            (n if n not in self.feature_names else f"{n}_dup")
+            for n in other.feature_names]
+        if self.monotone_constraints is not None \
+                or other.monotone_constraints is not None:
+            a = self.monotone_constraints or [0] * offset
+            b = other.monotone_constraints or [0] * other.num_total_features
+            self.monotone_constraints = list(a) + list(b)
+        if self.feature_penalty is not None \
+                or other.feature_penalty is not None:
+            a = self.feature_penalty or [1.0] * offset
+            b = other.feature_penalty or [1.0] * other.num_total_features
+            self.feature_penalty = list(a) + list(b)
+        dtype = (np.uint16 if (self.binned.dtype == np.uint16
+                               or other.binned.dtype == np.uint16)
+                 else np.uint8)
+        self.binned = np.concatenate(
+            [self.binned.astype(dtype), other.binned.astype(dtype)], axis=1)
+        self.max_num_bin = max(self.max_num_bin, other.max_num_bin)
+        self._device_binned = None
+        self._device_binned_T_key = None
+
     # ----------------------------------------------------------- binary cache
     def save_binary(self, filename: str) -> None:
         """Binary dataset cache (reference Dataset::SaveBinaryFile,

@@ -180,3 +180,42 @@ def _is_binary(filename: str) -> bool:
     with open(filename, "rb") as fh:
         head = fh.read(len(_BINARY_MAGIC))
     return head == _BINARY_MAGIC
+
+
+def parse_file_to_matrix(filename: str, has_header: bool,
+                         num_features: int, label_column: str = ""):
+    """Parse a prediction input file into (X [N, num_features], label).
+
+    Matches the CLI predict path's handling (Predictor file pipeline,
+    reference src/application/predictor.hpp:69-110): auto-detected
+    CSV/TSV/LibSVM, label column stripped (column 0 unless
+    ``label_column`` names another, as in the CLI config), width aligned
+    to the model's feature count.  Dense files whose width already equals
+    the model's feature count are treated as label-free; LibSVM always
+    carries a leading label.
+    """
+    with open(filename) as fh:
+        lines = fh.readlines()
+    header_names = None
+    if has_header and lines:
+        sep = "\t" if "\t" in lines[0] else ","
+        header_names = lines[0].strip().split(sep)
+        lines = lines[1:]
+    fmt = _detect_format(lines[:32])
+    if fmt == "libsvm":
+        mat = _parse_libsvm(lines)
+        label_col = 0
+    else:
+        sep = "\t" if fmt == "tsv" else ","
+        mat = _parse_dense(lines, sep)
+        if mat.shape[1] == num_features:   # no label column present
+            return mat, None
+        label_col = (_column_index(label_column, header_names)
+                     if label_column else 0)
+    label = mat[:, label_col]
+    X = np.delete(mat, label_col, axis=1)
+    if X.shape[1] < num_features:
+        X = np.pad(X, ((0, 0), (0, num_features - X.shape[1])))
+    elif X.shape[1] > num_features:
+        X = X[:, :num_features]
+    return X, label

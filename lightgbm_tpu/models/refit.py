@@ -15,15 +15,15 @@ from ..objective import create_objective
 from ..ops.split import K_EPSILON
 
 
-def refit_model(gbdt, X: np.ndarray, label: np.ndarray,
-                leaf_preds: np.ndarray, config) -> None:
+def refit_model(gbdt, metadata, leaf_preds: np.ndarray, config) -> None:
+    """``metadata`` carries label/weights/query boundaries — pass the full
+    training Metadata where available so weighted and ranking objectives
+    refit correctly."""
     objective = create_objective(config)
     if objective is None:
         objective = gbdt.objective
-    from ..core.metadata import Metadata
-    meta = Metadata(len(label))
-    meta.set_label(label)
-    objective.init(meta, len(label))
+    label = np.asarray(metadata.label)
+    objective.init(metadata, len(label))
 
     C = gbdt.num_tree_per_iteration
     decay = float(config.refit_decay_rate)
@@ -51,4 +51,7 @@ def refit_model(gbdt, X: np.ndarray, label: np.ndarray,
             opt = -sum_g / (sum_h + lam + K_EPSILON) * tree.shrinkage
             new_values[leaf] = decay * new_values[leaf] + (1 - decay) * opt
         tree.leaf_value = new_values
-        score[k] += tree.predict_raw(X)
+        # leaf assignments are given, so the tree's contribution is a
+        # direct gather — no feature matrix needed (matches GBDT::RefitTree
+        # updating scores from leaf outputs)
+        score[k] += new_values[leaves]

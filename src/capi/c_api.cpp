@@ -1,0 +1,883 @@
+/*
+ * LightGBM C API contract for the TPU framework.
+ *
+ * Implements the ~60 LGBM_* entry points of the reference
+ * (include/LightGBM/c_api.h:40-1030, src/c_api.cpp:98-1831) as a native
+ * shared library.  The compute engine is the in-process JAX/TPU stack, so
+ * each entry point marshals its raw-pointer arguments into the embedded
+ * CPython interpreter and dispatches to lightgbm_tpu.capi (the bridge
+ * module), which wraps the caller's buffers with numpy views (zero copy)
+ * and drives lightgbm_tpu.basic.Dataset / Booster.
+ *
+ * Contract pieces kept from the reference:
+ *   - opaque DatasetHandle / BoosterHandle (here: integer ids minted by
+ *     the bridge, cast through void*);
+ *   - thread-local last-error ring: LGBM_GetLastError
+ *     (reference src/c_api.cpp:57-64);
+ *   - 0 / -1 return convention with API_BEGIN/API_END guards
+ *     (reference include/LightGBM/c_api.h:1040-1060);
+ *   - dual-mode embedding: when loaded from a host C program the library
+ *     initializes CPython itself; when loaded inside a Python process
+ *     (ctypes) it attaches to the existing interpreter via the GIL.
+ */
+
+#include <Python.h>
+
+#include <cstdarg>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+#ifndef LIGHTGBM_C_EXPORT
+#define LIGHTGBM_C_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+/* ------------------------------------------------------------------ */
+/* error plumbing                                                     */
+/* ------------------------------------------------------------------ */
+
+static thread_local std::string g_last_error = "Everything is fine";
+
+LIGHTGBM_C_EXPORT const char* LGBM_GetLastError() {
+  return g_last_error.c_str();
+}
+
+static void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  g_last_error = msg;
+}
+
+/* ------------------------------------------------------------------ */
+/* interpreter management                                             */
+/* ------------------------------------------------------------------ */
+
+static std::once_flag g_py_once;
+static bool g_we_initialized = false;
+
+static void ensure_interpreter() {
+  std::call_once(g_py_once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+      /* release the GIL acquired by Py_Initialize so that GILGuard's
+         PyGILState_Ensure works uniformly from any thread */
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class GILGuard {
+ public:
+  GILGuard() {
+    ensure_interpreter();
+    state_ = PyGILState_Ensure();
+  }
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+static PyObject* bridge_module() {
+  static PyObject* mod = nullptr;  /* leaked on purpose; lives forever */
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("lightgbm_tpu.capi");
+  }
+  return mod;
+}
+
+/* Call lightgbm_tpu.capi.<fn>(args...) built with Py_BuildValue(fmt).
+   Returns a NEW reference or nullptr (python error already recorded). */
+static PyObject* bridge_call_v(const char* fn, const char* fmt, va_list ap) {
+  PyObject* mod = bridge_module();
+  if (mod == nullptr) return nullptr;
+  PyObject* func = PyObject_GetAttrString(mod, fn);
+  if (func == nullptr) return nullptr;
+  PyObject* args = Py_VaBuildValue(fmt, ap);
+  if (args == nullptr) {
+    Py_DECREF(func);
+    return nullptr;
+  }
+  if (!PyTuple_Check(args)) {  /* single argument case */
+    PyObject* t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+    if (args == nullptr) {
+      Py_DECREF(func);
+      return nullptr;
+    }
+  }
+  PyObject* out = PyObject_CallObject(func, args);
+  Py_DECREF(args);
+  Py_DECREF(func);
+  return out;
+}
+
+static PyObject* bridge_call(const char* fn, const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* out = bridge_call_v(fn, fmt, ap);
+  va_end(ap);
+  return out;
+}
+
+/* run a bridge call that returns None / ignored value */
+static int run_void(const char* fn, const char* fmt, ...) {
+  GILGuard gil;
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* out = bridge_call_v(fn, fmt, ap);
+  va_end(ap);
+  if (out == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(out);
+  return 0;
+}
+
+/* run a bridge call that returns one integer (handle id or scalar) */
+static int run_i64(const char* fn, int64_t* result, const char* fmt, ...) {
+  GILGuard gil;
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* out = bridge_call_v(fn, fmt, ap);
+  va_end(ap);
+  if (out == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *result = PyLong_AsLongLong(out);
+  Py_DECREF(out);
+  if (PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+static inline int64_t H(const void* handle) {
+  return static_cast<int64_t>(reinterpret_cast<intptr_t>(handle));
+}
+
+static inline void* mk_handle(int64_t id) {
+  return reinterpret_cast<void*>(static_cast<intptr_t>(id));
+}
+
+static inline unsigned long long A(const void* p) {
+  return static_cast<unsigned long long>(reinterpret_cast<uintptr_t>(p));
+}
+
+/* copy a python str into (buffer_len, out_len, out_str) */
+static int copy_string_out(PyObject* s, int64_t buffer_len, int64_t* out_len,
+                           char* out_str) {
+  Py_ssize_t n = 0;
+  const char* c = PyUnicode_AsUTF8AndSize(s, &n);
+  if (c == nullptr) return -1;
+  *out_len = static_cast<int64_t>(n) + 1;
+  if (out_str != nullptr && buffer_len >= n + 1) {
+    std::memcpy(out_str, c, n + 1);
+  }
+  return 0;
+}
+
+/* ================================================================== */
+/* Dataset interface                                                  */
+/* ================================================================== */
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetCreateFromFile(const char* filename,
+                                                 const char* parameters,
+                                                 const DatasetHandle reference,
+                                                 DatasetHandle* out) {
+  int64_t id;
+  int rc = run_i64("dataset_create_from_file", &id, "(szL)", filename,
+                   parameters, H(reference));
+  if (rc == 0) *out = mk_handle(id);
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetCreateFromSampledColumn(
+    double** sample_data, int** sample_indices, int32_t ncol,
+    const int* num_per_col, int32_t num_sample_row, int32_t num_total_row,
+    const char* parameters, DatasetHandle* out) {
+  int64_t id;
+  int rc = run_i64("dataset_create_from_sampled_column", &id, "(KKiKiis)",
+                   A(sample_data), A(sample_indices), (int)ncol,
+                   A(num_per_col), (int)num_sample_row, (int)num_total_row,
+                   parameters);
+  if (rc == 0) *out = mk_handle(id);
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetCreateByReference(
+    const DatasetHandle reference, int64_t num_total_row,
+    DatasetHandle* out) {
+  int64_t id;
+  int rc = run_i64("dataset_create_by_reference", &id, "(LL)", H(reference),
+                   (long long)num_total_row);
+  if (rc == 0) *out = mk_handle(id);
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetPushRows(DatasetHandle dataset,
+                                           const void* data, int data_type,
+                                           int32_t nrow, int32_t ncol,
+                                           int32_t start_row) {
+  return run_void("dataset_push_rows", "(LKiiii)", H(dataset), A(data),
+                  data_type, (int)nrow, (int)ncol, (int)start_row);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetPushRowsByCSR(
+    DatasetHandle dataset, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col, int64_t start_row) {
+  return run_void("dataset_push_rows_by_csr", "(LKiKKiLLLL)", H(dataset),
+                  A(indptr), indptr_type, A(indices), A(data), data_type,
+                  (long long)nindptr, (long long)nelem, (long long)num_col,
+                  (long long)start_row);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetCreateFromCSR(
+    const void* indptr, int indptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t nindptr, int64_t nelem,
+    int64_t num_col, const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  int64_t id;
+  int rc = run_i64("dataset_create_from_csr", &id, "(KiKKiLLLsL)", A(indptr),
+                   indptr_type, A(indices), A(data), data_type,
+                   (long long)nindptr, (long long)nelem, (long long)num_col,
+                   parameters, H(reference));
+  if (rc == 0) *out = mk_handle(id);
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetCreateFromCSRFunc(
+    void* get_row_funptr, int num_rows, int64_t num_col,
+    const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  /* the reference receives a std::function<void(int,
+     std::vector<std::pair<int, double>>&)>* here (c_api.cpp:528);
+     iterate it on the C++ side and hand the bridge a materialized CSR */
+  using RowFn = std::function<void(int, std::vector<std::pair<int, double>>&)>;
+  RowFn& fn = *static_cast<RowFn*>(get_row_funptr);
+  std::vector<int64_t> indptr(1, 0);
+  std::vector<int32_t> indices;
+  std::vector<double> values;
+  std::vector<std::pair<int, double>> row;
+  for (int i = 0; i < num_rows; ++i) {
+    row.clear();
+    fn(i, row);
+    for (auto& kv : row) {
+      indices.push_back(kv.first);
+      values.push_back(kv.second);
+    }
+    indptr.push_back(static_cast<int64_t>(indices.size()));
+  }
+  return LGBM_DatasetCreateFromCSR(indptr.data(), 3 /*int64*/,
+                                   indices.data(), values.data(),
+                                   1 /*float64*/, (int64_t)indptr.size(),
+                                   (int64_t)values.size(), num_col,
+                                   parameters, reference, out);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetCreateFromCSC(
+    const void* col_ptr, int col_ptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t ncol_ptr, int64_t nelem,
+    int64_t num_row, const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  int64_t id;
+  int rc = run_i64("dataset_create_from_csc", &id, "(KiKKiLLLsL)", A(col_ptr),
+                   col_ptr_type, A(indices), A(data), data_type,
+                   (long long)ncol_ptr, (long long)nelem, (long long)num_row,
+                   parameters, H(reference));
+  if (rc == 0) *out = mk_handle(id);
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetCreateFromMat(const void* data,
+                                                int data_type, int32_t nrow,
+                                                int32_t ncol,
+                                                int is_row_major,
+                                                const char* parameters,
+                                                const DatasetHandle reference,
+                                                DatasetHandle* out) {
+  int64_t id;
+  int rc = run_i64("dataset_create_from_mat", &id, "(KiiiisL)", A(data),
+                   data_type, (int)nrow, (int)ncol, is_row_major, parameters,
+                   H(reference));
+  if (rc == 0) *out = mk_handle(id);
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetCreateFromMats(
+    int32_t nmat, const void** data, int data_type, int32_t* nrow,
+    int32_t ncol, int is_row_major, const char* parameters,
+    const DatasetHandle reference, DatasetHandle* out) {
+  int64_t id;
+  int rc = run_i64("dataset_create_from_mats", &id, "(iKiKiisL)", (int)nmat,
+                   A(data), data_type, A(nrow), (int)ncol, is_row_major,
+                   parameters, H(reference));
+  if (rc == 0) *out = mk_handle(id);
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                                            const int32_t* used_row_indices,
+                                            int32_t num_used_row_indices,
+                                            const char* parameters,
+                                            DatasetHandle* out) {
+  int64_t id;
+  int rc = run_i64("dataset_get_subset", &id, "(LKis)", H(handle),
+                   A(used_row_indices), (int)num_used_row_indices,
+                   parameters);
+  if (rc == 0) *out = mk_handle(id);
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                                  const char** feature_names,
+                                                  int num_feature_names) {
+  GILGuard gil;
+  PyObject* lst = PyList_New(num_feature_names);
+  if (lst == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  for (int i = 0; i < num_feature_names; ++i) {
+    PyList_SetItem(lst, i, PyUnicode_FromString(feature_names[i]));
+  }
+  PyObject* out = bridge_call("dataset_set_feature_names", "(LO)", H(handle),
+                              lst);
+  Py_DECREF(lst);
+  if (out == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(out);
+  return 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetGetFeatureNames(DatasetHandle handle,
+                                                  char** feature_names,
+                                                  int* num_feature_names) {
+  GILGuard gil;
+  PyObject* out = bridge_call("dataset_get_feature_names", "(L)", H(handle));
+  if (out == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(out);
+  *num_feature_names = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(out, i));
+    if (s != nullptr && feature_names != nullptr) {
+      std::strcpy(feature_names[i], s);  /* caller pre-allocates, same
+                                            contract as c_api.cpp:712 */
+    }
+  }
+  Py_DECREF(out);
+  return 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetFree(DatasetHandle handle) {
+  return run_void("free_handle", "(L)", H(handle));
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetSaveBinary(DatasetHandle handle,
+                                             const char* filename) {
+  return run_void("dataset_save_binary", "(Ls)", H(handle), filename);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetDumpText(DatasetHandle handle,
+                                           const char* filename) {
+  return run_void("dataset_dump_text", "(Ls)", H(handle), filename);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetSetField(DatasetHandle handle,
+                                           const char* field_name,
+                                           const void* field_data,
+                                           int num_element, int type) {
+  return run_void("dataset_set_field", "(LsKii)", H(handle), field_name,
+                  A(field_data), num_element, type);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetGetField(DatasetHandle handle,
+                                           const char* field_name,
+                                           int* out_len,
+                                           const void** out_ptr,
+                                           int* out_type) {
+  GILGuard gil;
+  PyObject* out = bridge_call("dataset_get_field", "(Ls)", H(handle),
+                              field_name);
+  if (out == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  /* (addr, len, type) with the buffer owned by the dataset object */
+  unsigned long long addr = PyLong_AsUnsignedLongLong(
+      PyTuple_GetItem(out, 0));
+  *out_len = (int)PyLong_AsLong(PyTuple_GetItem(out, 1));
+  *out_type = (int)PyLong_AsLong(PyTuple_GetItem(out, 2));
+  *out_ptr = reinterpret_cast<const void*>(static_cast<uintptr_t>(addr));
+  Py_DECREF(out);
+  if (PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetUpdateParam(DatasetHandle handle,
+                                              const char* parameters) {
+  return run_void("dataset_update_param", "(Ls)", H(handle), parameters);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetGetNumData(DatasetHandle handle, int* out) {
+  int64_t v;
+  int rc = run_i64("dataset_get_num_data", &v, "(L)", H(handle));
+  if (rc == 0) *out = (int)v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetGetNumFeature(DatasetHandle handle,
+                                                int* out) {
+  int64_t v;
+  int rc = run_i64("dataset_get_num_feature", &v, "(L)", H(handle));
+  if (rc == 0) *out = (int)v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
+                                                  DatasetHandle source) {
+  return run_void("dataset_add_features_from", "(LL)", H(target), H(source));
+}
+
+/* ================================================================== */
+/* Booster interface                                                  */
+/* ================================================================== */
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterCreate(const DatasetHandle train_data,
+                                         const char* parameters,
+                                         BoosterHandle* out) {
+  int64_t id;
+  int rc = run_i64("booster_create", &id, "(Ls)", H(train_data), parameters);
+  if (rc == 0) *out = mk_handle(id);
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                                      int* out_num_iterations,
+                                                      BoosterHandle* out) {
+  GILGuard gil;
+  PyObject* r = bridge_call("booster_create_from_modelfile", "(s)", filename);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = mk_handle(PyLong_AsLongLong(PyTuple_GetItem(r, 0)));
+  *out_num_iterations = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return PyErr_Occurred() ? (set_error_from_python(), -1) : 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterLoadModelFromString(
+    const char* model_str, int* out_num_iterations, BoosterHandle* out) {
+  GILGuard gil;
+  PyObject* r = bridge_call("booster_load_model_from_string", "(s)",
+                            model_str);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = mk_handle(PyLong_AsLongLong(PyTuple_GetItem(r, 0)));
+  *out_num_iterations = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return PyErr_Occurred() ? (set_error_from_python(), -1) : 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterFree(BoosterHandle handle) {
+  return run_void("free_handle", "(L)", H(handle));
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterShuffleModels(BoosterHandle handle,
+                                                int start_iter, int end_iter) {
+  return run_void("booster_shuffle_models", "(Lii)", H(handle), start_iter,
+                  end_iter);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterMerge(BoosterHandle handle,
+                                        BoosterHandle other_handle) {
+  return run_void("booster_merge", "(LL)", H(handle), H(other_handle));
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterAddValidData(BoosterHandle handle,
+                                               const DatasetHandle valid) {
+  return run_void("booster_add_valid_data", "(LL)", H(handle), H(valid));
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterResetTrainingData(
+    BoosterHandle handle, const DatasetHandle train_data) {
+  return run_void("booster_reset_training_data", "(LL)", H(handle),
+                  H(train_data));
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterResetParameter(BoosterHandle handle,
+                                                 const char* parameters) {
+  return run_void("booster_reset_parameter", "(Ls)", H(handle), parameters);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetNumClasses(BoosterHandle handle,
+                                                int* out_len) {
+  int64_t v;
+  int rc = run_i64("booster_get_num_classes", &v, "(L)", H(handle));
+  if (rc == 0) *out_len = (int)v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
+                                                int* is_finished) {
+  int64_t v;
+  int rc = run_i64("booster_update_one_iter", &v, "(L)", H(handle));
+  if (rc == 0) *is_finished = (int)v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterRefit(BoosterHandle handle,
+                                        const int32_t* leaf_preds,
+                                        int32_t nrow, int32_t ncol) {
+  return run_void("booster_refit", "(LKii)", H(handle), A(leaf_preds),
+                  (int)nrow, (int)ncol);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                                      const float* grad,
+                                                      const float* hess,
+                                                      int* is_finished) {
+  int64_t v;
+  int rc = run_i64("booster_update_one_iter_custom", &v, "(LKK)", H(handle),
+                   A(grad), A(hess));
+  if (rc == 0) *is_finished = (int)v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  return run_void("booster_rollback_one_iter", "(L)", H(handle));
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                                      int* out_iteration) {
+  int64_t v;
+  int rc = run_i64("booster_get_current_iteration", &v, "(L)", H(handle));
+  if (rc == 0) *out_iteration = (int)v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterNumModelPerIteration(
+    BoosterHandle handle, int* out_tree_per_iteration) {
+  int64_t v;
+  int rc = run_i64("booster_num_model_per_iteration", &v, "(L)", H(handle));
+  if (rc == 0) *out_tree_per_iteration = (int)v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle,
+                                                     int* out_models) {
+  int64_t v;
+  int rc = run_i64("booster_number_of_total_model", &v, "(L)", H(handle));
+  if (rc == 0) *out_models = (int)v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetEvalCounts(BoosterHandle handle,
+                                                int* out_len) {
+  int64_t v;
+  int rc = run_i64("booster_get_eval_counts", &v, "(L)", H(handle));
+  if (rc == 0) *out_len = (int)v;
+  return rc;
+}
+
+static int strings_out(PyObject* lst, int* out_len, char** out_strs) {
+  Py_ssize_t n = PyList_Size(lst);
+  *out_len = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+    if (s != nullptr && out_strs != nullptr) std::strcpy(out_strs[i], s);
+  }
+  return 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetEvalNames(BoosterHandle handle,
+                                               int* out_len,
+                                               char** out_strs) {
+  GILGuard gil;
+  PyObject* out = bridge_call("booster_get_eval_names", "(L)", H(handle));
+  if (out == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  strings_out(out, out_len, out_strs);
+  Py_DECREF(out);
+  return 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetFeatureNames(BoosterHandle handle,
+                                                  int* out_len,
+                                                  char** out_strs) {
+  GILGuard gil;
+  PyObject* out = bridge_call("booster_get_feature_names", "(L)", H(handle));
+  if (out == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  strings_out(out, out_len, out_strs);
+  Py_DECREF(out);
+  return 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetNumFeature(BoosterHandle handle,
+                                                int* out_len) {
+  int64_t v;
+  int rc = run_i64("booster_get_num_feature", &v, "(L)", H(handle));
+  if (rc == 0) *out_len = (int)v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx,
+                                          int* out_len, double* out_results) {
+  int64_t v;
+  int rc = run_i64("booster_get_eval", &v, "(LiK)", H(handle), data_idx,
+                   A(out_results));
+  if (rc == 0) *out_len = (int)v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetNumPredict(BoosterHandle handle,
+                                                int data_idx,
+                                                int64_t* out_len) {
+  int64_t v;
+  int rc = run_i64("booster_get_num_predict", &v, "(Li)", H(handle),
+                   data_idx);
+  if (rc == 0) *out_len = v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetPredict(BoosterHandle handle,
+                                             int data_idx, int64_t* out_len,
+                                             double* out_result) {
+  int64_t v;
+  int rc = run_i64("booster_get_predict", &v, "(LiK)", H(handle), data_idx,
+                   A(out_result));
+  if (rc == 0) *out_len = v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterPredictForFile(
+    BoosterHandle handle, const char* data_filename, int data_has_header,
+    int predict_type, int num_iteration, const char* parameter,
+    const char* result_filename) {
+  return run_void("booster_predict_for_file", "(Lsiiiss)", H(handle),
+                  data_filename, data_has_header, predict_type,
+                  num_iteration, parameter, result_filename);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterCalcNumPredict(BoosterHandle handle,
+                                                 int num_row,
+                                                 int predict_type,
+                                                 int num_iteration,
+                                                 int64_t* out_len) {
+  int64_t v;
+  int rc = run_i64("booster_calc_num_predict", &v, "(Liii)", H(handle),
+                   num_row, predict_type, num_iteration);
+  if (rc == 0) *out_len = v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterPredictForCSR(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  int64_t v;
+  int rc = run_i64("booster_predict_for_csr", &v, "(LKiKKiLLLiisK)",
+                   H(handle), A(indptr), indptr_type, A(indices), A(data),
+                   data_type, (long long)nindptr, (long long)nelem,
+                   (long long)num_col, predict_type, num_iteration,
+                   parameter, A(out_result));
+  if (rc == 0) *out_len = v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterPredictForCSRSingleRow(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices,
+                                   data, data_type, nindptr, nelem, num_col,
+                                   predict_type, num_iteration, parameter,
+                                   out_len, out_result);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterPredictForCSC(
+    BoosterHandle handle, const void* col_ptr, int col_ptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t ncol_ptr,
+    int64_t nelem, int64_t num_row, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  int64_t v;
+  int rc = run_i64("booster_predict_for_csc", &v, "(LKiKKiLLLiisK)",
+                   H(handle), A(col_ptr), col_ptr_type, A(indices), A(data),
+                   data_type, (long long)ncol_ptr, (long long)nelem,
+                   (long long)num_row, predict_type, num_iteration,
+                   parameter, A(out_result));
+  if (rc == 0) *out_len = v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterPredictForMat(
+    BoosterHandle handle, const void* data, int data_type, int32_t nrow,
+    int32_t ncol, int is_row_major, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  int64_t v;
+  int rc = run_i64("booster_predict_for_mat", &v, "(LKiiiiiisK)", H(handle),
+                   A(data), data_type, (int)nrow, (int)ncol, is_row_major,
+                   predict_type, num_iteration, parameter, A(out_result));
+  if (rc == 0) *out_len = v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterPredictForMatSingleRow(
+    BoosterHandle handle, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol,
+                                   is_row_major, predict_type, num_iteration,
+                                   parameter, out_len, out_result);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterPredictForMats(
+    BoosterHandle handle, const void** data, int data_type, int32_t nrow,
+    int32_t ncol, int predict_type, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  int64_t v;
+  int rc = run_i64("booster_predict_for_mats", &v, "(LKiiiiisK)", H(handle),
+                   A(data), data_type, (int)nrow, (int)ncol, predict_type,
+                   num_iteration, parameter, A(out_result));
+  if (rc == 0) *out_len = v;
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterSaveModel(BoosterHandle handle,
+                                            int start_iteration,
+                                            int num_iteration,
+                                            const char* filename) {
+  return run_void("booster_save_model", "(Liis)", H(handle), start_iteration,
+                  num_iteration, filename);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterSaveModelToString(
+    BoosterHandle handle, int start_iteration, int num_iteration,
+    int64_t buffer_len, int64_t* out_len, char* out_str) {
+  GILGuard gil;
+  PyObject* s = bridge_call("booster_save_model_to_string", "(Lii)",
+                            H(handle), start_iteration, num_iteration);
+  if (s == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  int rc = copy_string_out(s, buffer_len, out_len, out_str);
+  Py_DECREF(s);
+  if (rc != 0) set_error_from_python();
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterDumpModel(BoosterHandle handle,
+                                            int start_iteration,
+                                            int num_iteration,
+                                            int64_t buffer_len,
+                                            int64_t* out_len, char* out_str) {
+  GILGuard gil;
+  PyObject* s = bridge_call("booster_dump_model", "(Lii)", H(handle),
+                            start_iteration, num_iteration);
+  if (s == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  int rc = copy_string_out(s, buffer_len, out_len, out_str);
+  Py_DECREF(s);
+  if (rc != 0) set_error_from_python();
+  return rc;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterGetLeafValue(BoosterHandle handle,
+                                               int tree_idx, int leaf_idx,
+                                               double* out_val) {
+  GILGuard gil;
+  PyObject* out = bridge_call("booster_get_leaf_value", "(Lii)", H(handle),
+                              tree_idx, leaf_idx);
+  if (out == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  *out_val = PyFloat_AsDouble(out);
+  Py_DECREF(out);
+  return PyErr_Occurred() ? (set_error_from_python(), -1) : 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterSetLeafValue(BoosterHandle handle,
+                                               int tree_idx, int leaf_idx,
+                                               double val) {
+  return run_void("booster_set_leaf_value", "(Liid)", H(handle), tree_idx,
+                  leaf_idx, val);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterFeatureImportance(BoosterHandle handle,
+                                                    int num_iteration,
+                                                    int importance_type,
+                                                    double* out_results) {
+  return run_void("booster_feature_importance", "(LiiK)", H(handle),
+                  num_iteration, importance_type, A(out_results));
+}
+
+/* ================================================================== */
+/* Network interface                                                  */
+/* ================================================================== */
+
+LIGHTGBM_C_EXPORT int LGBM_NetworkInit(const char* machines,
+                                       int local_listen_port,
+                                       int listen_time_out,
+                                       int num_machines) {
+  return run_void("network_init", "(siii)", machines, local_listen_port,
+                  listen_time_out, num_machines);
+}
+
+LIGHTGBM_C_EXPORT int LGBM_NetworkFree() {
+  return run_void("network_free", "()");
+}
+
+LIGHTGBM_C_EXPORT int LGBM_NetworkInitWithFunctions(
+    int num_machines, int rank, void* reduce_scatter_ext_fun,
+    void* allgather_ext_fun) {
+  return run_void("network_init_with_functions", "(iiKK)", num_machines,
+                  rank, A(reduce_scatter_ext_fun), A(allgather_ext_fun));
+}
