@@ -91,3 +91,36 @@ def test_data_parallel_binary(rng):
                     verbose_eval=False)
     acc = np.mean((bst.predict(X) > 0.5) == yb)
     assert acc > 0.9
+
+
+def test_data_parallel_segment_matches_serial_segment(rng):
+    """The distributed segment grower (psum_scatter stripes + max-gain
+    SplitInfo merge) must grow the same trees as the serial segment grower
+    (VERDICT r2 item 3: O(leaf) per-split cost must survive sharding)."""
+    X, y = make_data(rng, n=3000, f=9)
+    serial = _train(X, y, "serial", tpu_histogram_backend="pallas",
+                    tpu_tree_impl="segment", tpu_row_chunk=256)
+    assert serial.gbdt._use_segment
+    data = _train(X, y, "data", tpu_histogram_backend="pallas",
+                  tpu_tree_impl="segment", tpu_row_chunk=256)
+    assert data.gbdt._use_segment
+    np.testing.assert_allclose(serial.predict(X), data.predict(X),
+                               rtol=1e-3, atol=1e-4)
+    # same tree shapes — the split decisions matched, not just the fit
+    for ts, td in zip(serial.gbdt.models, data.gbdt.models):
+        assert ts.num_leaves == td.num_leaves
+
+
+def test_data_parallel_segment_binary_uneven(rng):
+    X, y = make_data(rng, n=2507, f=6)
+    yb = (y > np.median(y)).astype(float)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 15, "tree_learner": "data",
+              "max_bin": 31, "tpu_histogram_backend": "pallas",
+              "tpu_tree_impl": "segment", "tpu_row_chunk": 128}
+    ds = lgb.Dataset(X, yb)
+    bst = lgb.train(params, ds, num_boost_round=15, verbose_eval=False)
+    assert bst.gbdt._use_segment
+    p = bst.predict(X)
+    ll = -np.mean(yb * np.log(p + 1e-9) + (1 - yb) * np.log(1 - p + 1e-9))
+    assert ll < 0.6   # better than chance on a learnable target
