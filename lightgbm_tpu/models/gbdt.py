@@ -35,8 +35,60 @@ def _round_up_pow2(x: int) -> int:
     return 1 << max(0, (x - 1).bit_length())
 
 
-def build_feature_meta(dataset: TpuDataset) -> FeatureMeta:
+def _build_forced_plan(train_set: TpuDataset, filename: str,
+                       num_leaves: int) -> tuple:
+    """forcedsplits_filename JSON -> static BFS plan of
+    (leaf, inner_feature, threshold_bin) triples (ForceSplits,
+    serial_tree_learner.cpp:642: breadth-first, left child keeps the
+    parent's leaf id, right child takes the new one)."""
+    import json
+    from collections import deque
+    with open(filename) as fh:
+        root = json.load(fh)
+    plan = []
+    q = deque([(root, 0)])
+    while q and len(plan) < num_leaves - 1:
+        node, leaf = q.popleft()
+        if not isinstance(node, dict) or "feature" not in node:
+            continue
+        real_f = int(node["feature"])
+        inner = train_set.inner_feature_index(real_f)
+        if inner < 0:
+            log_warning(f"forced split on unused feature {real_f}; skipped")
+            continue
+        thr = float(node["threshold"])
+        t_bin = int(np.asarray(train_set.bin_mappers[real_f].value_to_bin(
+            np.asarray([thr], dtype=np.float64)))[0])
+        step = len(plan)
+        plan.append((int(leaf), int(inner), t_bin))
+        if isinstance(node.get("left"), dict):
+            q.append((node["left"], leaf))
+        if isinstance(node.get("right"), dict):
+            q.append((node["right"], step + 1))
+    return tuple(plan)
+
+
+def build_feature_meta(dataset: TpuDataset, config=None,
+                       used_in_split=None) -> FeatureMeta:
     infos = dataset.feature_infos()
+    F = len(infos)
+
+    def per_feature(vals, default):
+        """Real-feature-indexed config list -> [F] inner-feature array."""
+        out = np.full(F, default, dtype=np.float64)
+        if vals:
+            for j, real in enumerate(dataset.used_feature_indices):
+                if int(real) < len(vals):
+                    out[j] = float(vals[int(real)])
+        return jnp.asarray(out, dtype=jnp.float32)
+
+    cegb_coupled = cegb_lazy = used0 = None
+    if config is not None and (config.cegb_penalty_feature_coupled
+                               or config.cegb_penalty_feature_lazy):
+        cegb_coupled = per_feature(config.cegb_penalty_feature_coupled, 0.0)
+        cegb_lazy = per_feature(config.cegb_penalty_feature_lazy, 0.0)
+        used0 = jnp.asarray(used_in_split if used_in_split is not None
+                            else np.zeros(F), dtype=jnp.float32)
     return FeatureMeta(
         num_bin=jnp.asarray([i.num_bin for i in infos], dtype=jnp.int32),
         missing_type=jnp.asarray([i.missing_type for i in infos],
@@ -46,6 +98,9 @@ def build_feature_meta(dataset: TpuDataset) -> FeatureMeta:
         is_cat=jnp.asarray([i.is_categorical for i in infos], dtype=bool),
         monotone=jnp.asarray([i.monotone for i in infos], dtype=jnp.int32),
         penalty=jnp.asarray([i.penalty for i in infos], dtype=jnp.float32),
+        cegb_coupled=cegb_coupled,
+        cegb_lazy=cegb_lazy,
+        cegb_used0=used0,
     )
 
 
@@ -126,7 +181,10 @@ class GBDT:
         self.num_data = train_set.num_data
         self.feature_names = list(train_set.feature_names)
         self.max_feature_idx = train_set.num_total_features - 1
-        self.fmeta = build_feature_meta(train_set)
+        self._cegb_used = np.zeros(train_set.num_used_features,
+                                   dtype=np.float64)
+        self.fmeta = build_feature_meta(train_set, self.config,
+                                        self._cegb_used)
         self._row_pad = 0
         self.num_bins = _round_up_pow2(max(train_set.max_num_bin, 2))
         cfg = self.config
@@ -172,12 +230,31 @@ class GBDT:
         # rb threads through as the single block size for BOTH the bin
         # matrix padding and every kernel launch (grower + segment grower);
         # re-picking it at a kernel call site could desync from the padding
+        infos = train_set.feature_infos()
+        use_monotone = any(i.monotone != 0 for i in infos)
+        use_cegb_coupled = bool(cfg.cegb_penalty_feature_coupled)
+        use_cegb_lazy = bool(cfg.cegb_penalty_feature_lazy)
+        if use_cegb_lazy and parallel:
+            log_warning("cegb_penalty_feature_lazy is not supported by the "
+                        "distributed learners; ignoring it")
+            use_cegb_lazy = False
+        forced_plan = ()
+        if cfg.forcedsplits_filename:
+            forced_plan = _build_forced_plan(train_set,
+                                             cfg.forcedsplits_filename,
+                                             max(2, cfg.num_leaves))
         self.grower_params = GrowerParams(
             num_leaves=max(2, cfg.num_leaves),
             max_depth=cfg.max_depth,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
             row_chunk=rb,
             hist_backend=backend,
+            use_monotone=use_monotone,
+            cegb_tradeoff=float(cfg.cegb_tradeoff),
+            cegb_penalty_split=float(cfg.cegb_penalty_split),
+            use_cegb_coupled=use_cegb_coupled,
+            use_cegb_lazy=use_cegb_lazy,
+            forced_plan=forced_plan,
             split=SplitParams(
                 lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
                 max_delta_step=cfg.max_delta_step,
@@ -188,9 +265,10 @@ class GBDT:
                 max_cat_threshold=cfg.max_cat_threshold,
                 max_cat_to_onehot=cfg.max_cat_to_onehot,
                 min_data_per_group=cfg.min_data_per_group,
-                has_cat=any(i.is_categorical
-                            for i in train_set.feature_infos())))
-        self._use_segment = (backend == "pallas" and impl != "fused")
+                has_cat=any(i.is_categorical for i in infos)))
+        # forced splits and CEGB-lazy are fused-grower features
+        self._use_segment = (backend == "pallas" and impl != "fused"
+                             and not forced_plan and not use_cegb_lazy)
         if impl == "segment" and not self._use_segment:
             if parallel:
                 log_warning("tpu_tree_impl=segment is unavailable for the "
@@ -446,6 +524,24 @@ class GBDT:
                             "leaves that meet the split requirements")
                 return
             self._models.extend(trees)
+            self._note_trees(trees)
+
+    def _note_trees(self, trees) -> None:
+        """Record which features the model has split on, feeding the next
+        iteration's CEGB coupled penalty (is_feature_used_in_split_,
+        serial_tree_learner.h:169 — persists across trees)."""
+        if not self.grower_params.use_cegb_coupled:
+            return
+        changed = False
+        for t in trees:
+            if t.num_leaves > 1:
+                for f in np.unique(t.split_feature_inner[: t.num_leaves - 1]):
+                    if not self._cegb_used[f]:
+                        self._cegb_used[f] = 1.0
+                        changed = True
+        if changed:
+            self.fmeta = self.fmeta._replace(
+                cegb_used0=jnp.asarray(self._cegb_used, dtype=jnp.float32))
 
     def _materialize_rest(self):
         out = []
@@ -595,6 +691,7 @@ class GBDT:
             for _ in range(C):
                 self.models.pop()
             return True
+        self._note_trees(self._models[-C:])
         self.iter_ += 1
         return False
 
@@ -634,7 +731,10 @@ class GBDT:
         self._pending.append((self.iter_, items))
         self.iter_ += 1
         with _PHASES.phase("fetch"):
-            self._flush_pending(keep_latest=1)
+            # CEGB coupled penalties need this iteration's splits noted
+            # before the next grow call, so forgo the one-deep pipeline
+            keep = 0 if self.grower_params.use_cegb_coupled else 1
+            self._flush_pending(keep_latest=keep)
         return bool(self._stop_flag)
 
     def refit(self, leaf_preds: np.ndarray) -> None:
@@ -684,6 +784,35 @@ class GBDT:
         out = np.zeros((C, X.shape[0]), dtype=np.float64)
         for k in range(C):
             out[k] += self.init_scores[k]
+        cfg = self.config
+        freq = int(cfg.pred_early_stop_freq)
+        # the reference only instantiates early stop for binary/multiclass
+        # predictors; regression and ranking need every tree
+        es_type_ok = (C > 1 or (self.objective is not None
+                                and getattr(self.objective, "name", "")
+                                in ("binary", "cross_entropy", "xentropy")))
+        if bool(cfg.pred_early_stop) and freq > 0 and es_type_ok:
+            # margin-based per-row early stop every `freq` trees
+            # (prediction_early_stop.cpp:54-73 binary margin = 2|raw|,
+            # :30-49 multiclass margin = top1 - top2)
+            thr = float(cfg.pred_early_stop_margin)
+            active = np.ones(X.shape[0], dtype=bool)
+            for it in range(start_iteration, n_iter):
+                if not active.any():
+                    break
+                Xa = X[active]
+                for k in range(C):
+                    out[k, active] += self.models[it * C + k].predict_raw(Xa)
+                if (it + 1 - start_iteration) % freq == 0:
+                    sub = out[:, active]
+                    if C == 1:
+                        margin = 2.0 * np.abs(sub[0])
+                    else:
+                        top2 = np.partition(sub, C - 2, axis=0)
+                        margin = top2[-1] - top2[-2]
+                    idx = np.nonzero(active)[0]
+                    active[idx[margin > thr]] = False
+            return out
         for it in range(start_iteration, n_iter):
             for k in range(C):
                 out[k] += self.models[it * C + k].predict_raw(X)

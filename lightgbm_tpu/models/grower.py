@@ -34,7 +34,8 @@ from jax import lax
 
 from ..core.binning import MISSING_NAN, MISSING_ZERO
 from ..ops.histogram import histogram_chunked
-from ..ops.split import (NEG_INF, FeatureMeta, SplitParams, best_split)
+from ..ops.split import (NEG_INF, FeatureMeta, SplitParams, best_split,
+                         leaf_gain, leaf_output)
 
 
 class GrowerParams(NamedTuple):
@@ -47,6 +48,21 @@ class GrowerParams(NamedTuple):
     # "pallas": feature-major [F, Npad] bins, TPU pallas kernel
     # (ops/pallas_histogram.py)
     hist_backend: str = "onehot"
+    # static: any feature carries a monotone constraint — enables per-leaf
+    # [min, max] output-bound propagation (LeafSplits::SetValueConstraint,
+    # src/treelearner/leaf_splits.hpp:50-53 + the mid-split handoff in
+    # serial_tree_learner.cpp:892-903)
+    use_monotone: bool = False
+    # CEGB penalties (serial_tree_learner.cpp:527-618); split/coupled are
+    # in-grower gain adjustments, lazy is handled by the fused grower only
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    use_cegb_coupled: bool = False
+    use_cegb_lazy: bool = False
+    # forced splits (ForceSplits, serial_tree_learner.cpp:642): static
+    # BFS-ordered plan of (leaf, inner_feature, threshold_bin) applied to
+    # the leading growth steps before best-gain growth
+    forced_plan: tuple = ()
     split: SplitParams = SplitParams()
 
     @property
@@ -156,6 +172,14 @@ class _GrowState(NamedTuple):
     leaf_g: jax.Array              # [L]
     leaf_h: jax.Array
     leaf_c: jax.Array
+    # per-leaf monotone output bounds (LeafSplits min_val_/max_val_)
+    leaf_mono_lo: jax.Array        # [L]
+    leaf_mono_hi: jax.Array        # [L]
+    # CEGB bookkeeping: features used by any split so far ([F] 0/1), and
+    # per-(feature, row) "row has paid for feature" marks ([F, N] i8 when
+    # cegb_penalty_feature_lazy is active, else [1, 1])
+    feat_used: jax.Array
+    seen: jax.Array
     # per-leaf best-split cache (best_split_per_leaf_,
     # serial_tree_learner.h:153)
     best_gain: jax.Array
@@ -201,9 +225,53 @@ def _node_feature_mask(base_mask, key, step, p: GrowerParams):
     return jnp.where(m.sum() > 0, m, base_mask)
 
 
-def _leaf_scan(hist, g, h, c, depth, fmeta, fmask, p: GrowerParams):
+def _cegb_split_coupled_adjust(feat_used, c, fmeta, p: GrowerParams):
+    """[F] additive CEGB penalty: per-row split cost + coupled feature cost
+    for not-yet-used features (serial_tree_learner.cpp:582-607)."""
+    F = feat_used.shape[0]
+    adjust = jnp.full(F, p.cegb_tradeoff * p.cegb_penalty_split,
+                      jnp.float32) * c
+    if p.use_cegb_coupled:
+        adjust = adjust + p.cegb_tradeoff * fmeta.cegb_coupled * \
+            (1.0 - feat_used)
+    return adjust
+
+
+def _cegb_gain_adjust(st: "_GrowState", leaf, c, in_leaf, fmeta,
+                      p: GrowerParams):
+    """Full CEGB penalty incl. the lazy per-(feature,row) cost for rows
+    that have not yet paid for the feature (CalculateOndemandCosts,
+    serial_tree_learner.cpp:527-547)."""
+    if not (p.cegb_penalty_split > 0.0 or p.use_cegb_coupled
+            or p.use_cegb_lazy):
+        return None
+    adjust = _cegb_split_coupled_adjust(st.feat_used, c, fmeta, p)
+    if p.use_cegb_lazy:
+        unseen = jnp.sum((1 - st.seen) * in_leaf[None, :].astype(jnp.int8),
+                         axis=1).astype(jnp.float32)          # [F]
+        adjust = adjust + p.cegb_tradeoff * fmeta.cegb_lazy * unseen
+    return adjust
+
+
+def mono_handoff(lo_p, hi_p, out_l, out_r, mono_f, cat):
+    """Children's [lo, hi] output bounds after a split at
+    mid=(left+right)/2 (serial_tree_learner.cpp:892-903).  Returns
+    (lo_l, hi_l, lo_r, hi_r)."""
+    mid = (out_l + out_r) / 2.0
+    pos = ~cat & (mono_f > 0)
+    neg = ~cat & (mono_f < 0)
+    lo_l = jnp.where(neg, mid, lo_p)
+    hi_l = jnp.where(pos, mid, hi_p)
+    lo_r = jnp.where(pos, mid, lo_p)
+    hi_r = jnp.where(neg, mid, hi_p)
+    return lo_l, hi_l, lo_r, hi_r
+
+
+def _leaf_scan(hist, g, h, c, depth, fmeta, fmask, p: GrowerParams,
+               lo=None, hi=None, gain_adjust=None):
     """best_split for one leaf + depth gating."""
-    info = best_split(hist, g, h, c, fmeta, p.split, fmask)
+    info = best_split(hist, g, h, c, fmeta, p.split, fmask,
+                      mono_lo=lo, mono_hi=hi, gain_adjust=gain_adjust)
     gain = info.gain
     if p.max_depth > 0:
         gain = jnp.where(depth >= p.max_depth, NEG_INF, gain)
@@ -265,7 +333,14 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
 
     def scan_leaf(st: _GrowState, leaf_idx, hist, g, h, c, depth, fmeta,
                   fmask):
-        info, gain = _leaf_scan(hist, g, h, c, depth, fmeta, fmask, p)
+        lo = hi = None
+        if p.use_monotone:
+            lo = st.leaf_mono_lo[leaf_idx]
+            hi = st.leaf_mono_hi[leaf_idx]
+        adjust = _cegb_gain_adjust(st, leaf_idx, c, st.leaf_id == leaf_idx,
+                                   fmeta, p)
+        info, gain = _leaf_scan(hist, g, h, c, depth, fmeta, fmask, p,
+                                lo=lo, hi=hi, gain_adjust=adjust)
         if comm.merge_split is not None:
             info, gain = comm.merge_split(info, gain)
         return st._replace(
@@ -291,16 +366,67 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
         if comm.shard_feature_mask is not None:
             feature_mask = comm.shard_feature_mask(feature_mask)
 
-        def do_split(st: _GrowState, step):
-            leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+        def do_split(st: _GrowState, step, forced=None):
             new_leaf = st.num_leaves
             node = st.num_leaves - 1
 
-            f = st.best_feature[leaf]
-            t = st.best_threshold[leaf]
-            dl = st.best_default_left[leaf]
-            cat = st.best_is_cat[leaf]
-            bitset = st.best_cat_bitset[leaf]
+            if forced is None:
+                leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+                f = st.best_feature[leaf]
+                t = st.best_threshold[leaf]
+                dl = st.best_default_left[leaf]
+                cat = st.best_is_cat[leaf]
+                bitset = st.best_cat_bitset[leaf]
+                Gl, Hl, Cl = (st.best_left_g[leaf], st.best_left_h[leaf],
+                              st.best_left_c[leaf])
+                Gp, Hp, Cp = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
+                Gr, Hr, Cr = Gp - Gl, Hp - Hl, Cp - Cl
+                out_l = st.best_left_out[leaf]
+                out_r = st.best_right_out[leaf]
+                gain = st.best_gain[leaf]
+            else:
+                # forced numerical split (ForceSplits,
+                # serial_tree_learner.cpp:642): stats from the leaf's
+                # retained histogram at the given threshold bin
+                leaf = jnp.int32(forced[0])
+                f = jnp.int32(forced[1])
+                t = jnp.int32(forced[2])
+                dl = jnp.asarray(False)
+                cat = jnp.asarray(False)
+                bitset = jnp.zeros(8, dtype=jnp.uint32)
+                hist_row = st.leaf_hist[forced[0], forced[1]]
+                cum = jnp.cumsum(hist_row, axis=0)
+                Gl, Hl, Cl = cum[forced[2], 0], cum[forced[2], 1], \
+                    cum[forced[2], 2]
+                # keep stats consistent with routed_left(dl=False): zero-
+                # missing default-bin rows route RIGHT, so drop them from
+                # the left sums when the default bin falls under the
+                # threshold
+                db = fmeta.default_bin[forced[1]]
+                drop = ((fmeta.missing_type[forced[1]] == MISSING_ZERO)
+                        & (db <= t))
+                dbh = hist_row[db]
+                Gl = jnp.where(drop, Gl - dbh[0], Gl)
+                Hl = jnp.where(drop, Hl - dbh[1], Hl)
+                Cl = jnp.where(drop, Cl - dbh[2], Cl)
+                Gp, Hp, Cp = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
+                Gr, Hr, Cr = Gp - Gl, Hp - Hl, Cp - Cl
+                lo_f, hi_f = -jnp.inf, jnp.inf
+                if p.use_monotone:
+                    lo_f = st.leaf_mono_lo[leaf]
+                    hi_f = st.leaf_mono_hi[leaf]
+                out_l = jnp.clip(leaf_output(Gl, Hl, sp.lambda_l1,
+                                             sp.lambda_l2,
+                                             sp.max_delta_step), lo_f, hi_f)
+                out_r = jnp.clip(leaf_output(Gr, Hr, sp.lambda_l1,
+                                             sp.lambda_l2,
+                                             sp.max_delta_step), lo_f, hi_f)
+                gain = (leaf_gain(Gl, Hl, sp.lambda_l1, sp.lambda_l2,
+                                  sp.max_delta_step)
+                        + leaf_gain(Gr, Hr, sp.lambda_l1, sp.lambda_l2,
+                                    sp.max_delta_step)
+                        - leaf_gain(Gp, Hp, sp.lambda_l1, sp.lambda_l2,
+                                    sp.max_delta_step))
 
             if p.feature_major:
                 # contiguous [1, N] stream — far cheaper than the strided
@@ -314,10 +440,23 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             in_leaf = st.leaf_id == leaf
             leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, st.leaf_id)
 
-            Gl, Hl, Cl = (st.best_left_g[leaf], st.best_left_h[leaf],
-                          st.best_left_c[leaf])
-            Gp, Hp, Cp = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
-            Gr, Hr, Cr = Gp - Gl, Hp - Hl, Cp - Cl
+            # monotone constraint handoff (serial_tree_learner.cpp:892-903)
+            if p.use_monotone:
+                lo_l, hi_l, lo_r, hi_r = mono_handoff(
+                    st.leaf_mono_lo[leaf], st.leaf_mono_hi[leaf],
+                    out_l, out_r, fmeta.monotone[f], cat)
+                st = st._replace(
+                    leaf_mono_lo=st.leaf_mono_lo
+                    .at[leaf].set(lo_l).at[new_leaf].set(lo_r),
+                    leaf_mono_hi=st.leaf_mono_hi
+                    .at[leaf].set(hi_l).at[new_leaf].set(hi_r),
+                )
+            if p.use_cegb_coupled:
+                st = st._replace(feat_used=st.feat_used.at[f].set(1.0))
+            if p.use_cegb_lazy:
+                st = st._replace(seen=st.seen.at[f].set(
+                    jnp.maximum(st.seen[f],
+                                in_leaf.astype(st.seen.dtype))))
 
             if comm.no_subtract:
                 mem_l = (leaf_id == leaf).astype(grad.dtype) * member
@@ -359,8 +498,6 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             left_child = left_child.at[node].set(~leaf)
             right_child = right_child.at[node].set(~new_leaf)
 
-            out_l = st.best_left_out[leaf]
-            out_r = st.best_right_out[leaf]
             tree = tree._replace(
                 num_leaves=st.num_leaves + 1,
                 split_feature=tree.split_feature.at[node].set(f),
@@ -370,7 +507,7 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
                 cat_bitset=tree.cat_bitset.at[node].set(bitset),
                 left_child=left_child,
                 right_child=right_child,
-                split_gain=tree.split_gain.at[node].set(st.best_gain[leaf]),
+                split_gain=tree.split_gain.at[node].set(gain),
                 internal_value=tree.internal_value.at[node].set(
                     tree.leaf_value[leaf]),
                 internal_weight=tree.internal_weight.at[node].set(Hp),
@@ -441,6 +578,9 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             leaf_parent=jnp.full(L, -1, dtype=jnp.int32),
             leaf_depth=jnp.zeros(L, dtype=jnp.int32),
         )
+        used0 = (fmeta.cegb_used0 if (p.use_cegb_coupled
+                                      and fmeta.cegb_used0 is not None)
+                 else jnp.zeros(F, dtype=jnp.float32))
         st = _GrowState(
             leaf_id=jnp.zeros(n, dtype=jnp.int32),
             num_leaves=jnp.int32(1),
@@ -449,6 +589,11 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             leaf_g=zeros_l.at[0].set(G0),
             leaf_h=zeros_l.at[0].set(H0),
             leaf_c=zeros_l.at[0].set(C0),
+            leaf_mono_lo=jnp.full(L, -jnp.inf, dtype=jnp.float32),
+            leaf_mono_hi=jnp.full(L, jnp.inf, dtype=jnp.float32),
+            feat_used=used0,
+            seen=jnp.zeros((F, n) if p.use_cegb_lazy else (1, 1),
+                           dtype=jnp.int8),
             best_gain=neg,
             best_feature=jnp.full(L, -1, dtype=jnp.int32),
             best_threshold=jnp.zeros(L, dtype=jnp.int32),
@@ -462,7 +607,10 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
         fmask_root = _node_feature_mask(feature_mask, key, 2 * L, p)
         st = scan_leaf(st, 0, root_hist, G0, H0, C0, jnp.int32(0), fmeta,
                        fmask_root)
-        st = lax.fori_loop(0, L - 1, body, st)
+        # forced splits first (static plan, unrolled), then best-gain growth
+        for s, fp in enumerate(p.forced_plan[: L - 1]):
+            st = do_split(st, s, forced=fp)
+        st = lax.fori_loop(min(len(p.forced_plan), L - 1), L - 1, body, st)
         return st.tree, st.leaf_id
 
     if wrap is not None:

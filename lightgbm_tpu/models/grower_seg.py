@@ -41,7 +41,7 @@ from ..ops.pallas_histogram import (NUM_CHANNELS, histogram_segment,
                                     pack_channels, unpack_hist)
 from ..ops.split import NEG_INF, FeatureMeta, best_split
 from .grower import (CommHooks, GrowerParams, TreeArrays,
-                     _node_feature_mask, routed_left)
+                     _node_feature_mask, mono_handoff, routed_left)
 
 # compact when the tree reaches these leaf counts (log-spaced: each epoch
 # roughly quarters the confinement intervals, so total scan waste stays
@@ -69,6 +69,9 @@ class _SegState(NamedTuple):
     leaf_g: jax.Array
     leaf_h: jax.Array
     leaf_c: jax.Array
+    leaf_mono_lo: jax.Array    # [L] monotone output bounds
+    leaf_mono_hi: jax.Array
+    feat_used: jax.Array       # [F] CEGB coupled bookkeeping
     best_gain: jax.Array
     best_feature: jax.Array
     best_threshold: jax.Array
@@ -142,11 +145,19 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             h = comm.reduce_hist(h, None, None, None, None)
         return h
 
-    def _one_scan(hist, g, h, c, depth, fmeta, fmask, key, step):
+    def _one_scan(hist, g, h, c, depth, fmeta, fmask, key, step,
+                  lo, hi, feat_used):
         fmask_node = _node_feature_mask(fmask, key, step, p)
         if comm.shard_feature_mask is not None:
             fmask_node = comm.shard_feature_mask(fmask_node)
-        info = best_split(hist, g, h, c, fmeta, p.split, fmask_node)
+        adjust = None
+        if p.cegb_penalty_split > 0.0 or p.use_cegb_coupled:
+            from .grower import _cegb_split_coupled_adjust
+            adjust = _cegb_split_coupled_adjust(feat_used, c, fmeta, p)
+        info = best_split(hist, g, h, c, fmeta, p.split, fmask_node,
+                          mono_lo=lo if p.use_monotone else None,
+                          mono_hi=hi if p.use_monotone else None,
+                          gain_adjust=adjust)
         gain = info.gain
         if comm.merge_split is not None:
             info, gain = comm.merge_split(info, gain)
@@ -177,7 +188,8 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
     def scan_leaf(st: _SegState, leaf_idx, hist, g, h, c, depth, fmeta,
                   fmask, key, step):
         info, gain = _one_scan(hist, g, h, c, depth, fmeta, fmask, key,
-                               step)
+                               step, st.leaf_mono_lo[leaf_idx],
+                               st.leaf_mono_hi[leaf_idx], st.feat_used)
         leaves = jnp.asarray(leaf_idx, jnp.int32)[None]
         batched = jax.tree_util.tree_map(lambda x: x[None], info)
         return _write_scans(st, leaves, batched, gain[None])
@@ -187,9 +199,11 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         """Both children of a split evaluated in ONE vmapped scan — halves
         the per-split chain of small ops vs two sequential scans."""
         infos, gains = jax.vmap(
-            lambda hi, g, h, c, s: _one_scan(hi, g, h, c, depth, fmeta,
-                                             fmask, key, s)
-        )(hists2, g2, h2, c2, steps2)
+            lambda hh, g, h, c, s, blo, bhi: _one_scan(
+                hh, g, h, c, depth, fmeta, fmask, key, s, blo, bhi,
+                st.feat_used)
+        )(hists2, g2, h2, c2, steps2, st.leaf_mono_lo[leaves2],
+          st.leaf_mono_hi[leaves2])
         return _write_scans(st, leaves2, infos, gains)
 
     def compact(st: _SegState) -> _SegState:
@@ -265,6 +279,20 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                 leaf_lo=st.leaf_lo.at[new_leaf].set(lo),
                 leaf_hi=st.leaf_hi.at[new_leaf].set(hi),
             )
+            # monotone constraint handoff (serial_tree_learner.cpp:892-903)
+            if p.use_monotone:
+                lo_l, hi_l, lo_r, hi_r = mono_handoff(
+                    st.leaf_mono_lo[leaf], st.leaf_mono_hi[leaf],
+                    st.best_left_out[leaf], st.best_right_out[leaf],
+                    fmeta.monotone[f], cat)
+                st = st._replace(
+                    leaf_mono_lo=st.leaf_mono_lo
+                    .at[leaf].set(lo_l).at[new_leaf].set(lo_r),
+                    leaf_mono_hi=st.leaf_mono_hi
+                    .at[leaf].set(hi_l).at[new_leaf].set(hi_r),
+                )
+            if p.use_cegb_coupled:
+                st = st._replace(feat_used=st.feat_used.at[f].set(1.0))
 
             smaller_is_left = Cl <= Cr
             smaller = jnp.where(smaller_is_left, leaf, new_leaf)
@@ -390,6 +418,12 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             leaf_g=zeros_l.at[0].set(G0),
             leaf_h=zeros_l.at[0].set(H0),
             leaf_c=zeros_l.at[0].set(C0),
+            leaf_mono_lo=jnp.full(L, -jnp.inf, dtype=jnp.float32),
+            leaf_mono_hi=jnp.full(L, jnp.inf, dtype=jnp.float32),
+            feat_used=(fmeta.cegb_used0
+                       if (p.use_cegb_coupled
+                           and fmeta.cegb_used0 is not None)
+                       else jnp.zeros(F, dtype=jnp.float32)),
             best_gain=neg,
             best_feature=jnp.full(L, -1, dtype=jnp.int32),
             best_threshold=jnp.zeros(L, dtype=jnp.int32),

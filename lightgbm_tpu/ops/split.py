@@ -40,6 +40,13 @@ class FeatureMeta(NamedTuple):
     is_cat: jax.Array        # bool
     monotone: jax.Array      # i32 (-1/0/+1)
     penalty: jax.Array       # f32 (feature_contri)
+    # CEGB per-feature penalties (config cegb_penalty_feature_coupled /
+    # _lazy, serial_tree_learner.cpp:582-618); None when CEGB unused
+    cegb_coupled: jax.Array = None   # f32
+    cegb_lazy: jax.Array = None      # f32
+    # features already used by any split of the model so far (coupled
+    # penalty waived; is_feature_used_in_split_, serial_tree_learner.h:169)
+    cegb_used0: jax.Array = None     # f32 0/1
 
 
 class SplitParams(NamedTuple):
@@ -341,12 +348,15 @@ def per_feature_gains(hist: jax.Array, parent_g, parent_h, parent_c,
 
 def best_split(hist: jax.Array, parent_g, parent_h, parent_c,
                fmeta: FeatureMeta, params: SplitParams,
-               feature_mask: jax.Array, mono_lo=None, mono_hi=None) -> SplitInfo:
+               feature_mask: jax.Array, mono_lo=None, mono_hi=None,
+               gain_adjust=None) -> SplitInfo:
     """Find the best split of one leaf from its [F, B, 3] histogram.
 
     Mirrors SerialTreeLearner::FindBestSplitsFromHistograms
     (serial_tree_learner.cpp:549-640): per-feature best threshold, then the
     per-leaf argmax over features with feature-fraction masking and penalty.
+    ``gain_adjust`` is an optional [F] additive penalty subtracted from the
+    per-feature gains before the argmax (CEGB, :582-618).
     """
     p = params
     F, B, _ = hist.shape
@@ -359,6 +369,9 @@ def best_split(hist: jax.Array, parent_g, parent_h, parent_c,
     so_left, so_order = c["so_left"], c["so_order"]
     ni, oi, si, fam = c["ni"], c["oi"], c["si"], c["fam"]
     fgain_out = jnp.where(feature_mask > 0, c["fgain_out"], NEG_INF)
+    if gain_adjust is not None:
+        fgain_out = jnp.where(fgain_out > NEG_INF, fgain_out - gain_adjust,
+                              NEG_INF)
 
     best_f = jnp.argmax(fgain_out).astype(jnp.int32)
     best_gain = fgain_out[best_f]
