@@ -74,7 +74,12 @@ class Dataset:
                            if self.reference is not None else None))
             return self
         cfg = Config.from_params(self.params)
-        data = _as_2d_float(self.data)
+        # scipy sparse input never densifies (TpuDataset.from_scipy bins
+        # straight from the CSC slices; under EFB the bundled matrix is
+        # built directly)
+        is_sparse = (hasattr(self.data, "tocsr")
+                     and not hasattr(self.data, "values"))
+        data = self.data if is_sparse else _as_2d_float(self.data)
         feature_names = None
         if isinstance(self.feature_name, (list, tuple)):
             feature_names = list(self.feature_name)
@@ -98,7 +103,8 @@ class Dataset:
             ref_handle = self.reference.construct()._handle
         label = np.asarray(self.label, dtype=np.float64).ravel() \
             if self.label is not None else None
-        self._handle = TpuDataset.from_numpy(
+        make = TpuDataset.from_scipy if is_sparse else TpuDataset.from_numpy
+        self._handle = make(
             data, label=label, config=cfg,
             weights=(np.asarray(self.weight, dtype=np.float64).ravel()
                      if self.weight is not None else None),
@@ -120,6 +126,7 @@ class Dataset:
         sub.bin_mappers = h.bin_mappers
         sub.used_feature_indices = h.used_feature_indices
         sub.max_num_bin = h.max_num_bin
+        sub.bundle = h.bundle
         sub.feature_names = h.feature_names
         sub.monotone_constraints = h.monotone_constraints
         sub.feature_penalty = h.feature_penalty

@@ -1,0 +1,206 @@
+"""Exclusive Feature Bundling (EFB).
+
+Reference: Dataset::FindGroups + FastFeatureBundling
+(src/io/dataset.cpp:68-213) and FeatureGroup (include/LightGBM/
+feature_group.h:33): mutually-(almost-)exclusive sparse features are packed
+into one physical bin column, so a 10k-feature 99%-sparse matrix costs a
+handful of dense byte columns instead of 10k.
+
+TPU-first encoding (differs from the reference's per-group bin_offsets with
+most-frequent-bin elision, feature_group.h:46-70, but serves the same
+contract):
+
+  * every multi-feature bundle is ONE column of the dense bin matrix;
+  * column value 0 = "every member feature is at its default bin";
+  * member feature ``f`` with bin ``b != default_bin[f]`` stores
+    ``offset[f] + b`` (offsets accumulate ``1 + sum(num_bin)`` so ranges
+    never collide; the per-feature default slot is simply never written);
+  * conflicts (two members non-default on one row) keep the LAST member's
+    value — the same bounded-information-loss tradeoff the reference
+    accepts via ``max_conflict_rate`` (dataset.cpp:93-101);
+  * at scan time the per-feature histogram is gathered back out of the
+    group histogram and the default-bin slot is reconstructed as
+    ``leaf_total - sum(stored bins)`` — the reference's FixHistogram
+    (dataset.cpp:948-967) in vectorized form (ops/split.expand_group_hist).
+
+Single-feature groups store the plain bin with offset 0, so when no
+bundling happens the matrix is bit-identical to the unbundled layout.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+# 8-bit popcount table for packed conflict counting
+_POPCOUNT8 = np.array([bin(i).count("1") for i in range(256)],
+                      dtype=np.int32)
+
+# group bin budget: keeps every bundled column uint8 and inside the pallas
+# kernels' 256-bin ceiling (the reference GPU path uses the same cap,
+# dataset.cpp:152: max_bin per group forced <= 256 when GPU is enabled)
+MAX_BINS_PER_GROUP = 256
+
+
+class BundleSpec:
+    """Static description of the feature -> column packing."""
+
+    __slots__ = ("groups", "feat_group", "feat_offset", "group_num_bin")
+
+    def __init__(self, groups: List[List[int]], num_bins: np.ndarray):
+        self.groups = [list(g) for g in groups]
+        F = int(sum(len(g) for g in groups))
+        self.feat_group = np.zeros(F, dtype=np.int32)
+        self.feat_offset = np.zeros(F, dtype=np.int32)
+        self.group_num_bin = np.zeros(len(groups), dtype=np.int32)
+        for gi, g in enumerate(groups):
+            if len(g) == 1:
+                f = g[0]
+                self.feat_group[f] = gi
+                self.feat_offset[f] = 0
+                self.group_num_bin[gi] = int(num_bins[f])
+            else:
+                off = 1                       # slot 0 = all-default
+                for f in g:
+                    self.feat_group[f] = gi
+                    self.feat_offset[f] = off
+                    off += int(num_bins[f])
+                self.group_num_bin[gi] = off
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def is_trivial(self) -> bool:
+        """True when every group is a singleton in feature order (the
+        packed matrix would equal the plain one)."""
+        return (self.num_groups == len(self.feat_group)
+                and all(g == [i] for i, g in enumerate(self.groups)))
+
+    def to_dict(self) -> dict:
+        return {"groups": self.groups}
+
+    @classmethod
+    def from_dict(cls, d: dict, num_bins: np.ndarray) -> "BundleSpec":
+        return cls(d["groups"], num_bins)
+
+
+def find_groups(packed: np.ndarray, nnz: np.ndarray, num_bins: np.ndarray,
+                is_bundleable: np.ndarray, max_conflict_cnt: int,
+                max_bins_per_group: int = MAX_BINS_PER_GROUP
+                ) -> List[List[int]]:
+    """Greedy conflict-bounded grouping (Dataset::FindGroups,
+    src/io/dataset.cpp:68-138).
+
+    Args:
+      packed: [F, ceil(S/8)] uint8 — per-feature non-default bitmask on the
+        binning sample (np.packbits of the bool mask).
+      nnz: [F] int — non-default count per feature on the sample.
+      num_bins: [F] int — bins per feature.
+      is_bundleable: [F] bool — sparse enough to enter a bundle
+        (sparse_rate >= sparse_threshold); others become singletons.
+      max_conflict_cnt: total conflicting sample rows allowed per group
+        (int(max_conflict_rate * sample_cnt), dataset.cpp:157).
+
+    Returns groups as lists of feature indices, ordered so bundleable
+    multi-feature groups come first, then singletons in feature order.
+    """
+    F = packed.shape[0]
+    cand = [f for f in range(F) if is_bundleable[f]]
+    # by descending non-zero count (the second, usually-better order the
+    # reference tries, dataset.cpp:168-176)
+    cand.sort(key=lambda f: -int(nnz[f]))
+    group_feats: List[List[int]] = []
+    group_mask: List[np.ndarray] = []
+    group_bins: List[int] = []
+    group_conflicts: List[int] = []
+    for f in cand:
+        placed = False
+        fb = 1 + int(num_bins[f])      # +1: the shared all-default slot
+        for gi in range(len(group_feats)):
+            if group_bins[gi] + int(num_bins[f]) > max_bins_per_group:
+                continue
+            conflicts = int(
+                _POPCOUNT8[packed[f] & group_mask[gi]].sum())
+            if group_conflicts[gi] + conflicts > max_conflict_cnt:
+                continue
+            group_feats[gi].append(f)
+            group_mask[gi] |= packed[f]
+            group_bins[gi] += int(num_bins[f])
+            group_conflicts[gi] += conflicts
+            placed = True
+            break
+        if not placed:
+            group_feats.append([f])
+            group_mask.append(packed[f].copy())
+            group_bins.append(fb)
+            group_conflicts.append(0)
+
+    # bundles of one revert to plain singleton storage
+    groups = [g for g in group_feats if len(g) > 1]
+    single = sorted(f for g in group_feats if len(g) == 1 for f in g)
+    non_cand = [f for f in range(F) if not is_bundleable[f]]
+    groups.extend([f] for f in sorted(single + non_cand))
+    return groups
+
+
+def build_bundle(sample_nonzero_fn, num_features: int, sample_cnt: int,
+                 num_bins: np.ndarray, sparse_rates: np.ndarray,
+                 sparse_threshold: float, max_conflict_rate: float
+                 ) -> Optional[BundleSpec]:
+    """Decide the bundling for a dataset from its binning sample.
+
+    ``sample_nonzero_fn(f)`` returns the [S] bool non-default mask of used
+    feature ``f`` on the sample (a callable so sparse inputs materialize
+    one column at a time); masks are bit-packed immediately, so peak
+    memory is F * S/8 bytes.
+
+    Returns None when bundling would not change the layout (all
+    singletons) — the caller then keeps the plain per-feature matrix.
+    """
+    F, S = num_features, sample_cnt
+    if F <= 1 or S <= 0:
+        return None
+    is_bundleable = np.asarray(sparse_rates) >= sparse_threshold
+    if int(is_bundleable.sum()) <= 1:
+        return None
+    packed = np.zeros((F, (S + 7) // 8), dtype=np.uint8)
+    nnz = np.zeros(F, dtype=np.int64)
+    for f in range(F):
+        if not is_bundleable[f]:
+            continue
+        mask = np.asarray(sample_nonzero_fn(f), dtype=bool)
+        packed[f] = np.packbits(mask)
+        nnz[f] = int(mask.sum())
+    groups = find_groups(packed, nnz, num_bins, is_bundleable,
+                         int(max_conflict_rate * S))
+    spec = BundleSpec(groups, num_bins)
+    if spec.num_groups == F:
+        return None
+    return spec
+
+
+def quantize_bundled(per_feature_bin_cols, spec: BundleSpec,
+                     default_bins: np.ndarray, num_rows: int) -> np.ndarray:
+    """Pack per-feature bin columns into the bundled [N, G] uint8/16 matrix.
+
+    ``per_feature_bin_cols(f)`` returns the [N] integer bin column of used
+    feature ``f`` (a callable so sparse inputs can materialize one column
+    at a time; FeatureGroup::PushData, feature_group.h:131).
+    """
+    dtype = (np.uint8 if int(spec.group_num_bin.max(initial=1)) <= 256
+             else np.uint16)
+    out = np.zeros((num_rows, spec.num_groups), dtype=dtype)
+    for gi, g in enumerate(spec.groups):
+        if len(g) == 1:
+            out[:, gi] = per_feature_bin_cols(g[0]).astype(dtype)
+            continue
+        col = out[:, gi]
+        for f in g:
+            bins_f = per_feature_bin_cols(f)
+            nz = bins_f != default_bins[f]
+            col[nz] = (int(spec.feat_offset[f]) + bins_f[nz]).astype(dtype)
+        out[:, gi] = col
+    return out

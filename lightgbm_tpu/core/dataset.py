@@ -26,25 +26,34 @@ from ..config import Config
 from ..utils.log import check, log_fatal, log_info, log_warning
 from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
                       MISSING_NAN, MISSING_NONE, MISSING_ZERO)
+from .bundle import BundleSpec, build_bundle, quantize_bundled
 from .metadata import Metadata
 
 _BINARY_MAGIC = b"lightgbm_tpu.dataset.v1\x00"
 
 
 class FeatureInfo:
-    """Per-used-feature metadata consumed by the tree learner."""
+    """Per-used-feature metadata consumed by the tree learner.
+
+    ``group``/``offset`` locate the feature inside the physical bin matrix
+    (EFB bundling, core/bundle.py): column ``group`` holds this feature's
+    bins at ``offset + bin``.  Unbundled datasets have group == the
+    feature's own column and offset == 0.
+    """
 
     __slots__ = ("num_bin", "missing_type", "default_bin", "is_categorical",
-                 "monotone", "penalty")
+                 "monotone", "penalty", "group", "offset")
 
     def __init__(self, num_bin, missing_type, default_bin, is_categorical,
-                 monotone=0, penalty=1.0):
+                 monotone=0, penalty=1.0, group=0, offset=0):
         self.num_bin = num_bin
         self.missing_type = missing_type
         self.default_bin = default_bin
         self.is_categorical = is_categorical
         self.monotone = monotone
         self.penalty = penalty
+        self.group = group
+        self.offset = offset
 
 
 class TpuDataset:
@@ -61,6 +70,7 @@ class TpuDataset:
         self.max_num_bin: int = 0
         self.monotone_constraints: Optional[List[int]] = None
         self.feature_penalty: Optional[List[float]] = None
+        self.bundle: Optional[BundleSpec] = None   # EFB packing; None = plain
         self._device_binned = None
 
     # ------------------------------------------------------------ construction
@@ -100,8 +110,12 @@ class TpuDataset:
             ds.monotone_constraints = reference.monotone_constraints
             ds.feature_penalty = reference.feature_penalty
             ds.feature_names = list(reference.feature_names)
+            ds.bundle = reference.bundle
         else:
             ds._fit_bin_mappers(data, cfg, set(int(c) for c in categorical_features))
+            ds._build_bundle(cfg, lambda f, sample_idx=ds._sample_idx: (
+                np.asarray(data[sample_idx, ds.used_feature_indices[f]],
+                           dtype=np.float64)))
 
         ds._quantize(data)
         ds.metadata.init(n)
@@ -117,21 +131,30 @@ class TpuDataset:
 
     def _fit_bin_mappers(self, data: np.ndarray, cfg: Config,
                          categorical: set) -> None:
-        n = data.shape[0]
-        rng = np.random.RandomState(cfg.data_random_seed)
-        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
-        sample_idx = (np.arange(n) if sample_cnt >= n
-                      else rng.choice(n, sample_cnt, replace=False))
+        sample_idx = self._pick_sample(data.shape[0], cfg)
+        self._fit_bin_mappers_from_cols(
+            cfg, categorical, data.shape[1],
+            lambda f: np.asarray(data[sample_idx, f], dtype=np.float64),
+            len(sample_idx))
+
+    def _fit_bin_mappers_from_cols(self, cfg: Config, categorical: set,
+                                   num_features: int, col_vals_fn,
+                                   total_sample_cnt: int) -> None:
+        """Shared bin-fitting tail for the dense and sparse constructors.
+
+        ``col_vals_fn(f)`` returns feature f's sampled values; for sparse
+        input these are the NONZEROS only — ``total_sample_cnt -
+        len(values)`` values are implicitly zero (the reference's sparse
+        FindBin convention, bin.cpp:210)."""
         max_bin_by_feature = list(cfg.max_bin_by_feature or [])
         self.bin_mappers = []
-        for f in range(data.shape[1]):
-            col = np.asarray(data[sample_idx, f], dtype=np.float64)
+        for f in range(num_features):
             bt = BIN_TYPE_CATEGORICAL if f in categorical else BIN_TYPE_NUMERICAL
             mb = (max_bin_by_feature[f] if f < len(max_bin_by_feature)
                   else cfg.max_bin)
             m = BinMapper().find_bin(
-                col, total_sample_cnt=len(col), max_bin=mb,
-                min_data_in_bin=cfg.min_data_in_bin,
+                col_vals_fn(f), total_sample_cnt=total_sample_cnt,
+                max_bin=mb, min_data_in_bin=cfg.min_data_in_bin,
                 min_split_data=cfg.min_data_in_leaf,
                 bin_type=bt, use_missing=cfg.use_missing,
                 zero_as_missing=cfg.zero_as_missing)
@@ -154,8 +177,150 @@ class TpuDataset:
                   "feature_contri length must equal number of features")
             self.feature_penalty = [float(x) for x in fc]
 
+    @classmethod
+    def from_scipy(cls, data, label: Optional[np.ndarray] = None,
+                   config: Optional[Config] = None,
+                   weights: Optional[np.ndarray] = None,
+                   group: Optional[np.ndarray] = None,
+                   init_score: Optional[np.ndarray] = None,
+                   categorical_features: Sequence[int] = (),
+                   feature_names: Optional[List[str]] = None,
+                   reference: Optional["TpuDataset"] = None) -> "TpuDataset":
+        """Build a dataset from a scipy sparse matrix WITHOUT densifying
+        the raw values (LGBM_DatasetCreateFromCSR path, c_api.cpp:560).
+
+        Bins are found from per-column nonzeros (implicit zeros counted via
+        ``total_sample_cnt``, the reference's sparse FindBin convention,
+        bin.cpp:210), and the quantized matrix is written column-by-column
+        — peak extra memory is one dense column, and under EFB the result
+        is the bundled [N, num_groups] matrix directly.
+        """
+        cfg = config or Config()
+        csr = data.tocsr()
+        n, num_features = csr.shape
+        ds = cls()
+        ds.num_data = n
+        ds.num_total_features = num_features
+        ds.feature_names = (list(feature_names) if feature_names
+                            else [f"Column_{i}" for i in range(num_features)])
+        csc = csr.tocsc()
+
+        if reference is not None:
+            check(reference.num_total_features == num_features,
+                  "validation data has a different number of features")
+            ds.bin_mappers = reference.bin_mappers
+            ds.used_feature_indices = reference.used_feature_indices
+            ds.max_num_bin = reference.max_num_bin
+            ds.monotone_constraints = reference.monotone_constraints
+            ds.feature_penalty = reference.feature_penalty
+            ds.feature_names = list(reference.feature_names)
+            ds.bundle = reference.bundle
+        else:
+            sample_idx = ds._pick_sample(n, cfg)
+            sample_csc = (csc if len(sample_idx) >= n
+                          else csr[sample_idx].tocsc())
+            S = len(sample_idx)
+            ds._fit_bin_mappers_from_cols(
+                cfg, set(int(c) for c in categorical_features), num_features,
+                lambda f: np.asarray(
+                    sample_csc.data[sample_csc.indptr[f]:
+                                    sample_csc.indptr[f + 1]],
+                    dtype=np.float64),
+                S)
+
+            def sample_col(j):
+                f = int(ds.used_feature_indices[j])
+                out = np.zeros(S, dtype=np.float64)
+                sl = slice(sample_csc.indptr[f], sample_csc.indptr[f + 1])
+                out[sample_csc.indices[sl]] = sample_csc.data[sl]
+                return out
+
+            ds._build_bundle(cfg, sample_col)
+
+        used = ds.used_feature_indices
+        default_bins = np.asarray(
+            [ds.bin_mappers[f].default_bin for f in used], dtype=np.int64)
+
+        def col_bins(j):
+            """Full [N] bin column of used feature j from the CSC slices;
+            implicit zeros land on default_bin (== value_to_bin(0))."""
+            f = int(used[j])
+            m = ds.bin_mappers[f]
+            out = np.full(n, default_bins[j], dtype=np.int64)
+            sl = slice(csc.indptr[f], csc.indptr[f + 1])
+            out[csc.indices[sl]] = m.value_to_bin(
+                np.asarray(csc.data[sl], dtype=np.float64))
+            return out
+
+        if ds.bundle is not None:
+            ds.binned = quantize_bundled(col_bins, ds.bundle, default_bins, n)
+        else:
+            dtype = np.uint8 if ds.max_num_bin <= 256 else np.uint16
+            out = np.empty((n, len(used)), dtype=dtype)
+            for j in range(len(used)):
+                out[:, j] = col_bins(j).astype(dtype)
+            ds.binned = out
+        ds._device_binned = None
+        ds.metadata.init(n)
+        if label is not None:
+            ds.metadata.set_label(label)
+        if weights is not None:
+            ds.metadata.set_weights(weights)
+        if group is not None:
+            ds.metadata.set_query(group)
+        if init_score is not None:
+            ds.metadata.set_init_score(init_score)
+        return ds
+
+    def _pick_sample(self, n: int, cfg: Config) -> np.ndarray:
+        rng = np.random.RandomState(cfg.data_random_seed)
+        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+        self._sample_idx = (np.arange(n) if sample_cnt >= n
+                            else rng.choice(n, sample_cnt, replace=False))
+        return self._sample_idx
+
+    def _build_bundle(self, cfg: Config, sample_col_fn) -> None:
+        """EFB grouping from the binning sample (Dataset::Construct ->
+        FastFeatureBundling, src/io/dataset.cpp:235-241).
+        ``sample_col_fn(j)`` -> raw [S] float64 sample of used feature j."""
+        if not cfg.enable_bundle or len(self.used_feature_indices) <= 1:
+            return
+        used = self.used_feature_indices
+        num_bins = np.asarray([self.bin_mappers[f].num_bin for f in used],
+                              dtype=np.int64)
+        default_bins = np.asarray(
+            [self.bin_mappers[f].default_bin for f in used], dtype=np.int64)
+        sparse_rates = np.asarray(
+            [self.bin_mappers[f].sparse_rate for f in used])
+
+        def nonzero_fn(j):
+            m = self.bin_mappers[used[j]]
+            return m.value_to_bin(sample_col_fn(j)) != default_bins[j]
+
+        S = len(self._sample_idx)
+        self.bundle = build_bundle(nonzero_fn, len(used), S, num_bins,
+                                   sparse_rates, cfg.sparse_threshold,
+                                   cfg.max_conflict_rate)
+        if self.bundle is not None:
+            log_info(f"EFB bundled {len(used)} features into "
+                     f"{self.bundle.num_groups} groups")
+
     def _quantize(self, data: np.ndarray) -> None:
         used = self.used_feature_indices
+
+        if self.bundle is not None:
+            default_bins = np.asarray(
+                [self.bin_mappers[f].default_bin for f in used],
+                dtype=np.int64)
+
+            def col_fn(j):
+                return self.bin_mappers[used[j]].value_to_bin(
+                    np.asarray(data[:, used[j]], dtype=np.float64))
+
+            self.binned = quantize_bundled(col_fn, self.bundle, default_bins,
+                                           data.shape[0])
+            self._device_binned = None
+            return
         dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
         out = np.empty((data.shape[0], len(used)), dtype=dtype)
         for j, f in enumerate(used):
@@ -169,9 +334,21 @@ class TpuDataset:
     def num_used_features(self) -> int:
         return len(self.used_feature_indices)
 
+    @property
+    def num_columns(self) -> int:
+        """Physical bin-matrix columns (== groups under EFB)."""
+        return (self.bundle.num_groups if self.bundle is not None
+                else len(self.used_feature_indices))
+
+    @property
+    def max_column_bin(self) -> int:
+        """Max bins of any physical column (histogram bin-axis size)."""
+        return (int(self.bundle.group_num_bin.max(initial=1))
+                if self.bundle is not None else self.max_num_bin)
+
     def feature_infos(self) -> List[FeatureInfo]:
         infos = []
-        for f in self.used_feature_indices:
+        for j, f in enumerate(self.used_feature_indices):
             m = self.bin_mappers[f]
             mono = 0
             if self.monotone_constraints is not None:
@@ -179,8 +356,13 @@ class TpuDataset:
             pen = 1.0
             if self.feature_penalty is not None:
                 pen = self.feature_penalty[f]
+            if self.bundle is not None:
+                grp = int(self.bundle.feat_group[j])
+                off = int(self.bundle.feat_offset[j])
+            else:
+                grp, off = j, 0
             infos.append(FeatureInfo(m.num_bin, m.missing_type, m.default_bin,
-                                     m.is_categorical, mono, pen))
+                                     m.is_categorical, mono, pen, grp, off))
         return infos
 
     def real_threshold(self, used_feature: int, bin_threshold: int) -> float:
@@ -216,8 +398,11 @@ class TpuDataset:
             self._device_binned_T_key = row_multiple
         return self._device_binned_T
 
-    def create_valid(self, data: np.ndarray, label: Optional[np.ndarray] = None,
+    def create_valid(self, data, label: Optional[np.ndarray] = None,
                      **kwargs) -> "TpuDataset":
+        if hasattr(data, "tocsr"):            # scipy sparse
+            return TpuDataset.from_scipy(data, label=label, reference=self,
+                                         **kwargs)
         return TpuDataset.from_numpy(data, label=label, reference=self, **kwargs)
 
     def add_features_from(self, other: "TpuDataset") -> None:
@@ -229,6 +414,9 @@ class TpuDataset:
         check(self.num_data == other.num_data,
               "Cannot add features from other Dataset with a different "
               "number of rows")
+        check(self.bundle is None and other.bundle is None,
+              "add_features_from does not support EFB-bundled datasets; "
+              "construct with enable_bundle=false")
         offset = self.num_total_features
         self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
         self.used_feature_indices = np.concatenate([
@@ -274,6 +462,8 @@ class TpuDataset:
             "has_query": self.metadata.query_boundaries is not None,
             "has_init_score": self.metadata.init_score is not None,
             "binned_dtype": str(self.binned.dtype),
+            "bundle": (self.bundle.to_dict() if self.bundle is not None
+                       else None),
         }
         blob = json.dumps(meta).encode()
         with open(filename, "wb") as fh:
@@ -309,10 +499,16 @@ class TpuDataset:
                                                  dtype=np.int32)
             ds.max_num_bin = meta["max_num_bin"]
             ds.bin_mappers = [BinMapper.from_dict(d) for d in meta["bin_mappers"]]
+            if meta.get("bundle") is not None:
+                used_nb = np.asarray(
+                    [ds.bin_mappers[f].num_bin
+                     for f in ds.used_feature_indices], dtype=np.int64)
+                ds.bundle = BundleSpec.from_dict(meta["bundle"], used_nb)
             dtype = np.dtype(meta["binned_dtype"])
-            nbytes = ds.num_data * len(ds.used_feature_indices) * dtype.itemsize
+            ncols = ds.num_columns
+            nbytes = ds.num_data * ncols * dtype.itemsize
             ds.binned = np.frombuffer(fh.read(nbytes), dtype=dtype).reshape(
-                ds.num_data, len(ds.used_feature_indices)).copy()
+                ds.num_data, ncols).copy()
             ds.metadata.init(ds.num_data)
             ds.metadata.label = np.frombuffer(
                 fh.read(4 * ds.num_data), dtype=np.float32).copy()

@@ -89,6 +89,21 @@ def build_feature_meta(dataset: TpuDataset, config=None,
         cegb_lazy = per_feature(config.cegb_penalty_feature_lazy, 0.0)
         used0 = jnp.asarray(used_in_split if used_in_split is not None
                             else np.zeros(F), dtype=jnp.float32)
+    feat_group = feat_offset = gather_idx = None
+    if dataset.bundle is not None:
+        # static [F, Bf] gather map from the flattened [G * Bg] group
+        # histogram; Bg/Bf are the pow2-padded histogram axes the grower
+        # actually allocates (GBDT.reset_train_data uses the same rounding)
+        Bg = _round_up_pow2(max(dataset.max_column_bin, 2))
+        Bf = _round_up_pow2(max(dataset.max_num_bin, 2))
+        gi = np.full((F, Bf), -1, dtype=np.int32)
+        for j, info in enumerate(infos):
+            gi[j, : info.num_bin] = (info.group * Bg + info.offset
+                                     + np.arange(info.num_bin))
+        feat_group = jnp.asarray([i.group for i in infos], dtype=jnp.int32)
+        feat_offset = jnp.asarray([i.offset for i in infos],
+                                  dtype=jnp.int32)
+        gather_idx = jnp.asarray(gi)
     return FeatureMeta(
         num_bin=jnp.asarray([i.num_bin for i in infos], dtype=jnp.int32),
         missing_type=jnp.asarray([i.missing_type for i in infos],
@@ -101,6 +116,9 @@ def build_feature_meta(dataset: TpuDataset, config=None,
         cegb_coupled=cegb_coupled,
         cegb_lazy=cegb_lazy,
         cegb_used0=used0,
+        feat_group=feat_group,
+        feat_offset=feat_offset,
+        gather_idx=gather_idx,
     )
 
 
@@ -161,9 +179,9 @@ class GBDT:
             from ..ops.pallas_histogram import supported
             ok = (not parallel
                   and not cfg.gpu_use_dp and not cfg.tpu_double_precision
-                  and supported(self.train_set.num_used_features,
+                  and supported(self.train_set.num_columns,
                                 _round_up_pow2(
-                                    max(self.train_set.max_num_bin, 2)),
+                                    max(self.train_set.max_column_bin, 2)),
                                 self.train_set.binned.dtype))
             if choice == "pallas":
                 if not ok:
@@ -186,7 +204,9 @@ class GBDT:
         self.fmeta = build_feature_meta(train_set, self.config,
                                         self._cegb_used)
         self._row_pad = 0
-        self.num_bins = _round_up_pow2(max(train_set.max_num_bin, 2))
+        # histogram bin axis is over physical COLUMNS (EFB groups); the
+        # per-feature scan axis comes from fmeta.gather_idx when bundled
+        self.num_bins = _round_up_pow2(max(train_set.max_column_bin, 2))
         cfg = self.config
         # Resolve the parallel layout FIRST so the histogram backend is
         # chosen for the learner that actually runs: a parallel request on
@@ -220,7 +240,7 @@ class GBDT:
         if backend == "pallas":
             from ..ops.pallas_histogram import pick_block_rows
             rb = (cfg.tpu_row_chunk if cfg.tpu_row_chunk > 0 else
-                  pick_block_rows(train_set.num_used_features,
+                  pick_block_rows(train_set.num_columns,
                                   self.num_bins, -(-self.num_data // D)))
             # each shard's row count must be a whole number of blocks
             self.bins = train_set.device_binned_T(rb * D)
@@ -240,9 +260,18 @@ class GBDT:
             use_cegb_lazy = False
         forced_plan = ()
         if cfg.forcedsplits_filename:
-            forced_plan = _build_forced_plan(train_set,
-                                             cfg.forcedsplits_filename,
-                                             max(2, cfg.num_leaves))
+            if parallel and tl not in ("data", "data_parallel"):
+                # the forced path reads this shard's leaf histogram without
+                # a merge; feature/voting shards hold incomplete histograms
+                # (column stripes / elected subsets), so forced stats would
+                # diverge across devices.  Data-parallel psums full
+                # histograms and is safe.
+                log_warning("forcedsplits_filename is not supported by the "
+                            "feature/voting-parallel learners; ignoring it")
+            else:
+                forced_plan = _build_forced_plan(train_set,
+                                                 cfg.forcedsplits_filename,
+                                                 max(2, cfg.num_leaves))
         self.grower_params = GrowerParams(
             num_leaves=max(2, cfg.num_leaves),
             max_depth=cfg.max_depth,
@@ -279,9 +308,12 @@ class GBDT:
                             "histogram backend; using the fused grower")
         if parallel and self._use_segment:
             from ..parallel.learners import make_data_parallel_segment_grower
+            bundle = train_set.bundle
             self._grow_fn = make_data_parallel_segment_grower(
                 self.num_bins, self.grower_params, mesh, rb,
-                train_set.num_used_features)
+                train_set.num_columns,
+                feat_group=(bundle.feat_group if bundle is not None
+                            else None))
             self._mesh = mesh
         elif parallel:
             from ..parallel.learners import make_parallel_grower
@@ -291,9 +323,12 @@ class GBDT:
             if pad:
                 self.bins = jnp.pad(self.bins, ((0, pad), (0, 0)))
                 self._row_pad = pad
+            bundle = train_set.bundle
             self._grow_fn = make_parallel_grower(
                 self.num_bins, self.grower_params, mesh, tl,
-                top_k=cfg.top_k)
+                top_k=cfg.top_k, num_columns=train_set.num_columns,
+                feat_group=(bundle.feat_group if bundle is not None
+                            else None))
             self._mesh = mesh
         elif self._use_segment and impl in ("auto", "segment"):
             from .grower_seg import make_grow_tree_segment

@@ -35,7 +35,8 @@ from jax import lax
 from ..core.binning import MISSING_NAN, MISSING_ZERO
 from ..ops.histogram import histogram_chunked
 from ..ops.split import (NEG_INF, FeatureMeta, SplitParams, best_split,
-                         leaf_gain, leaf_output)
+                         expand_group_hist, leaf_gain, leaf_output,
+                         reconstruct_feature_column)
 
 
 class GrowerParams(NamedTuple):
@@ -294,12 +295,21 @@ class CommHooks(NamedTuple):
     set (voting-parallel: each call's vote elects a different feature
     subset, so parent and child histograms are masked inconsistently and
     their difference is meaningless).
+
+    ``column_block`` (feature-parallel) returns this shard's
+    ``(start_col, block_cols)`` so histogram CONSTRUCTION itself only
+    touches the shard's column stripe — the reference histograms only the
+    rank's own features (feature_parallel_tree_learner.cpp:36-75).  The
+    stripe result is scattered back into a zero [F, B, 3] tensor at its
+    offset; out-of-stripe features are masked by ``shard_feature_mask``.
+    ``block_cols`` must be static (the same on every shard).
     """
     reduce_hist: object = None
     reduce_stats: object = None
     merge_split: object = None
     shard_feature_mask: object = None
     no_subtract: bool = False
+    column_block: object = None
 
 
 def make_grow_tree(num_bins: int, params: GrowerParams,
@@ -320,13 +330,28 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
     sp = p.split
 
     def hist_of(bins, grad, hess, member, G, H, C, fmeta):
+        hist_bins = bins
+        start = None
+        if comm.column_block is not None:
+            # feature-parallel: construct only this shard's column stripe
+            start, ncols = comm.column_block(bins)
+            if p.feature_major:
+                hist_bins = lax.dynamic_slice_in_dim(bins, start, ncols,
+                                                     axis=0)
+            else:
+                hist_bins = lax.dynamic_slice_in_dim(bins, start, ncols,
+                                                     axis=1)
         if p.feature_major:
             from ..ops.pallas_histogram import leaf_histogram_pallas
-            out = leaf_histogram_pallas(bins, grad, hess, member, B,
+            out = leaf_histogram_pallas(hist_bins, grad, hess, member, B,
                                         p.row_chunk)
         else:
             w = jnp.stack([grad * member, hess * member, member])
-            out = histogram_chunked(bins, w, B, p.row_chunk)
+            out = histogram_chunked(hist_bins, w, B, p.row_chunk)
+        if start is not None:
+            ncols_total = bins.shape[0] if p.feature_major else bins.shape[1]
+            full = jnp.zeros((ncols_total,) + out.shape[1:], out.dtype)
+            out = lax.dynamic_update_slice_in_dim(full, out, start, axis=0)
         if comm.reduce_hist is not None:
             out = comm.reduce_hist(out, G, H, C, fmeta)
         return out
@@ -339,6 +364,9 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             hi = st.leaf_mono_hi[leaf_idx]
         adjust = _cegb_gain_adjust(st, leaf_idx, c, st.leaf_id == leaf_idx,
                                    fmeta, p)
+        # EFB: group-space histogram -> per-feature view (identity when
+        # the dataset is unbundled)
+        hist = expand_group_hist(hist, fmeta, g, h, c)
         info, gain = _leaf_scan(hist, g, h, c, depth, fmeta, fmask, p,
                                 lo=lo, hi=hi, gain_adjust=adjust)
         if comm.merge_split is not None:
@@ -359,10 +387,13 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
         )
 
     def grow(bins, grad, hess, member, fmeta: FeatureMeta, feature_mask, key):
+        # G = physical bin-matrix columns (EFB groups); F = logical
+        # features the scans see.  Equal when unbundled.
         if p.feature_major:
-            F, n = bins.shape
+            G_cols, n = bins.shape
         else:
-            n, F = bins.shape
+            n, G_cols = bins.shape
+        F = fmeta.num_bin.shape[0]
         if comm.shard_feature_mask is not None:
             feature_mask = comm.shard_feature_mask(feature_mask)
 
@@ -394,7 +425,9 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
                 dl = jnp.asarray(False)
                 cat = jnp.asarray(False)
                 bitset = jnp.zeros(8, dtype=jnp.uint32)
-                hist_row = st.leaf_hist[forced[0], forced[1]]
+                hist_row = expand_group_hist(
+                    st.leaf_hist[forced[0]], fmeta, st.leaf_g[leaf],
+                    st.leaf_h[leaf], st.leaf_c[leaf])[forced[1]]
                 cum = jnp.cumsum(hist_row, axis=0)
                 Gl, Hl, Cl = cum[forced[2], 0], cum[forced[2], 1], \
                     cum[forced[2], 2]
@@ -428,12 +461,14 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
                         - leaf_gain(Gp, Hp, sp.lambda_l1, sp.lambda_l2,
                                     sp.max_delta_step))
 
+            col = f if fmeta.feat_group is None else fmeta.feat_group[f]
             if p.feature_major:
                 # contiguous [1, N] stream — far cheaper than the strided
                 # row-major column gather
-                fcol = lax.dynamic_slice_in_dim(bins, f, 1, axis=0)[0, :]
+                fcol = lax.dynamic_slice_in_dim(bins, col, 1, axis=0)[0, :]
             else:
-                fcol = lax.dynamic_slice_in_dim(bins, f, 1, axis=1)[:, 0]
+                fcol = lax.dynamic_slice_in_dim(bins, col, 1, axis=1)[:, 0]
+            fcol = reconstruct_feature_column(fcol, f, fmeta)
             go_left = routed_left(fcol, t, dl, cat, bitset,
                                   fmeta.missing_type[f], fmeta.default_bin[f],
                                   fmeta.num_bin[f])
@@ -584,7 +619,7 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
         st = _GrowState(
             leaf_id=jnp.zeros(n, dtype=jnp.int32),
             num_leaves=jnp.int32(1),
-            leaf_hist=jnp.zeros((L, F, B, 3), dtype=jnp.float32)
+            leaf_hist=jnp.zeros((L,) + root_hist.shape, dtype=jnp.float32)
                          .at[0].set(root_hist),
             leaf_g=zeros_l.at[0].set(G0),
             leaf_h=zeros_l.at[0].set(H0),

@@ -39,7 +39,8 @@ from jax import lax
 
 from ..ops.pallas_histogram import (NUM_CHANNELS, histogram_segment,
                                     pack_channels, unpack_hist)
-from ..ops.split import NEG_INF, FeatureMeta, best_split
+from ..ops.split import (NEG_INF, FeatureMeta, best_split, expand_group_hist,
+                         reconstruct_feature_column)
 from .grower import (CommHooks, GrowerParams, TreeArrays,
                      _node_feature_mask, mono_handoff, routed_left)
 
@@ -135,12 +136,12 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
     B = num_bins
     rb = block_rows
 
-    def hist_leaf(st: _SegState, leaf, F):
+    def hist_leaf(st: _SegState, leaf, G_cols):
         lo = st.leaf_lo[leaf]
         n_blk = st.leaf_hi[leaf] - lo
         out = histogram_segment(st.binsT, st.w8, st.leaf_id, lo, n_blk,
                                 leaf, B, rb)
-        h = unpack_hist(out[:F])
+        h = unpack_hist(out[:G_cols])
         if comm.reduce_hist is not None:
             h = comm.reduce_hist(h, None, None, None, None)
         return h
@@ -154,6 +155,8 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         if p.cegb_penalty_split > 0.0 or p.use_cegb_coupled:
             from .grower import _cegb_split_coupled_adjust
             adjust = _cegb_split_coupled_adjust(feat_used, c, fmeta, p)
+        # EFB: group-space histogram -> per-feature view
+        hist = expand_group_hist(hist, fmeta, g, h, c)
         info = best_split(hist, g, h, c, fmeta, p.split, fmask_node,
                           mono_lo=lo if p.use_monotone else None,
                           mono_hi=hi if p.use_monotone else None,
@@ -231,11 +234,14 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
 
     def grow(binsT, grad, hess, member, fmeta: FeatureMeta, feature_mask,
              key):
-        F, n = binsT.shape
+        # G_cols = physical bin-matrix columns (EFB groups); F = logical
+        # features (fmeta/feature_mask space).  Equal when unbundled.
+        G_cols, n = binsT.shape
+        F = fmeta.num_bin.shape[0]
         assert n % rb == 0, (n, rb)
         max_blocks = n // rb
-        # pad feature rows to a multiple of 4 for the sort word packing
-        fpad = (-F) % 4
+        # pad column rows to a multiple of 4 for the sort word packing
+        fpad = (-G_cols) % 4
         if fpad:
             binsT = jnp.pad(binsT, ((0, fpad), (0, 0)))
 
@@ -260,7 +266,9 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             cat = st.best_is_cat[leaf]
             bitset = st.best_cat_bitset[leaf]
 
-            fcol = lax.dynamic_slice_in_dim(st.binsT, f, 1, axis=0)[0, :]
+            col = f if fmeta.feat_group is None else fmeta.feat_group[f]
+            fcol = lax.dynamic_slice_in_dim(st.binsT, col, 1, axis=0)[0, :]
+            fcol = reconstruct_feature_column(fcol, f, fmeta)
             go_left = routed_left(fcol, t, dl, cat, bitset,
                                   fmeta.missing_type[f],
                                   fmeta.default_bin[f], fmeta.num_bin[f])
@@ -296,7 +304,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
 
             smaller_is_left = Cl <= Cr
             smaller = jnp.where(smaller_is_left, leaf, new_leaf)
-            hist_small = hist_leaf(st, smaller, F)
+            hist_small = hist_leaf(st, smaller, G_cols)
             hist_parent = st.leaf_hist[leaf]
             hist_large = hist_parent - hist_small
             hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
@@ -414,7 +422,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             leaf_hi=jnp.zeros(L, dtype=jnp.int32)
                        .at[0].set(max_blocks),
             num_leaves=jnp.int32(1),
-            leaf_hist=jnp.zeros((L, F, B, 3), dtype=jnp.float32),
+            leaf_hist=jnp.zeros((L, G_cols, B, 3), dtype=jnp.float32),
             leaf_g=zeros_l.at[0].set(G0),
             leaf_h=zeros_l.at[0].set(H0),
             leaf_c=zeros_l.at[0].set(C0),
@@ -434,7 +442,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             best_left_out=zeros_l, best_right_out=zeros_l,
             tree=tree0,
         )
-        root_hist = hist_leaf(st, jnp.int32(0), F)
+        root_hist = hist_leaf(st, jnp.int32(0), G_cols)
         st = st._replace(leaf_hist=st.leaf_hist.at[0].set(root_hist))
         st = scan_leaf(st, 0, root_hist, G0, H0, C0, jnp.int32(0), fmeta,
                        feature_mask, key, 2 * L)

@@ -215,6 +215,10 @@ class Tree:
             return np.zeros(n, dtype=np.int32)
         nb = np.asarray([fi.num_bin for fi in feature_infos], dtype=np.int32)
         db = np.asarray([fi.default_bin for fi in feature_infos], dtype=np.int32)
+        # EFB (core/bundle.py): feature f lives in column grp[f] at
+        # offset off[f]; out-of-range column values mean "f at default"
+        grp = np.asarray([fi.group for fi in feature_infos], dtype=np.int32)
+        off = np.asarray([fi.offset for fi in feature_infos], dtype=np.int32)
         cur = np.zeros(n, dtype=np.int32)
         leaf = np.full(n, -1, dtype=np.int32)
         active = np.ones(n, dtype=bool)
@@ -223,7 +227,9 @@ class Tree:
                 break
             nodes = cur[active]
             f = self.split_feature_inner[nodes]
-            fv = binned[active, f].astype(np.int32)
+            gv = binned[active, grp[f]].astype(np.int32)
+            in_range = (gv >= off[f]) & (gv < off[f] + nb[f])
+            fv = np.where(in_range, gv - off[f], db[f])
             dt = self.decision_type[nodes]
             is_cat = (dt & K_CATEGORICAL_MASK) > 0
             mt = (dt.astype(np.int32) >> 2) & 3

@@ -47,6 +47,13 @@ class FeatureMeta(NamedTuple):
     # features already used by any split of the model so far (coupled
     # penalty waived; is_feature_used_in_split_, serial_tree_learner.h:169)
     cegb_used0: jax.Array = None     # f32 0/1
+    # EFB bundling (core/bundle.py): physical bin-matrix column and bin
+    # offset of each logical feature, plus the static [F, Bf] gather map
+    # from the flattened [G*Bg] group histogram.  All None when the dataset
+    # is unbundled (column == feature).
+    feat_group: jax.Array = None     # i32 [F]
+    feat_offset: jax.Array = None    # i32 [F]
+    gather_idx: jax.Array = None     # i32 [F, Bf]; -1 = empty slot
 
 
 class SplitParams(NamedTuple):
@@ -85,6 +92,44 @@ class SplitInfo(NamedTuple):
     right_c: jax.Array
     left_out: jax.Array
     right_out: jax.Array
+
+
+def expand_group_hist(hist, fmeta: FeatureMeta, parent_g, parent_h,
+                      parent_c):
+    """[G, Bg, 3] group histogram -> [F, Bf, 3] per-feature histogram.
+
+    Identity when the dataset is unbundled.  For bundled features the
+    stored slots are gathered out of the group column and the default-bin
+    slot — which bundling never stores (core/bundle.py) — is reconstructed
+    as ``leaf_total - sum(stored slots)``, the reference's
+    Dataset::FixHistogram (src/io/dataset.cpp:948-967).  For unbundled
+    features the same fix is a numerical no-op, so one uniform path
+    serves both.
+    """
+    if fmeta.gather_idx is None:
+        return hist
+    gi = fmeta.gather_idx                                     # [F, Bf]
+    flat = hist.reshape(-1, hist.shape[-1])                   # [G*Bg, 3]
+    fh = flat[jnp.clip(gi, 0)] * (gi >= 0)[..., None]         # [F, Bf, 3]
+    total = jnp.stack([parent_g, parent_h, parent_c]).astype(fh.dtype)
+    Bf = fh.shape[1]
+    db_onehot = (jnp.arange(Bf, dtype=jnp.int32)[None, :]
+                 == fmeta.default_bin[:, None])               # [F, Bf]
+    stored = jnp.sum(fh * (~db_onehot)[..., None], axis=1)    # [F, 3]
+    fix = total[None, :] - stored                             # [F, 3]
+    return jnp.where(db_onehot[..., None], fix[:, None, :], fh)
+
+
+def reconstruct_feature_column(gcol, f, fmeta: FeatureMeta):
+    """Per-row bin of logical feature ``f`` from its group's raw column
+    (inverse of core/bundle.quantize_bundled for one feature)."""
+    gcol = gcol.astype(jnp.int32)
+    if fmeta.feat_group is None:
+        return gcol
+    off = fmeta.feat_offset[f]
+    nb = fmeta.num_bin[f]
+    in_range = (gcol >= off) & (gcol < off + nb)
+    return jnp.where(in_range, gcol - off, fmeta.default_bin[f])
 
 
 def threshold_l1(s, l1):

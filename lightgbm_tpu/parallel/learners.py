@@ -40,7 +40,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.grower import CommHooks, GrowerParams, make_grow_tree
-from ..ops.split import NEG_INF, SplitInfo, SplitParams, per_feature_gains
+from ..ops.split import (NEG_INF, SplitInfo, SplitParams, expand_group_hist,
+                         per_feature_gains)
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -66,11 +67,14 @@ def _merge_split_by_gain(info: SplitInfo, gain, axis):
 
 
 def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
-                         mode: str, top_k: int = 20):
+                         mode: str, top_k: int = 20,
+                         num_columns: int = 0, feat_group=None):
     """shard_map-wrapped grower for mode in {'data', 'feature', 'voting'}.
 
     Argument order of the returned fn matches the serial grower:
     (bins, grad, hess, member, fmeta, feature_mask, key).
+    ``num_columns``/``feat_group`` locate features in the physical bin
+    matrix for the feature-parallel column stripes (EFB, core/bundle.py).
     """
     axis = mesh.axis_names[0]
     D = int(mesh.devices.size)
@@ -85,29 +89,53 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
                     repl)
         out_specs = (repl, P(axis))
     elif mode in ("feature", "feature_parallel"):
-        def shard_mask(fmask):
-            # features striped modulo D (the reference re-balances by #bins
-            # per tree, feature_parallel_tree_learner.cpp:36-47; a stripe is
-            # an even split when bins are uniform)
-            F = fmask.shape[0]
+        # every shard holds the FULL data but histograms and scans only a
+        # contiguous COLUMN stripe; the winning SplitInfo merges by
+        # max-gain and all shards split locally — the reference's
+        # feature-parallel contract (feature_parallel_tree_learner.cpp:
+        # 36-75, histograms only for the rank's own features).  The
+        # reference re-balances shards by #bins per tree (:36-47); an even
+        # column split is equivalent when bins are uniform.
+        G = num_columns
+        per = -(-G // D)
+
+        def my_start():
             me = lax.axis_index(axis)
-            stripe = (jnp.arange(F, dtype=jnp.int32) % D) == me
+            return jnp.minimum(me * per,
+                               jnp.maximum(G - per, 0)).astype(jnp.int32)
+
+        def column_block(bins):
+            return my_start(), per
+
+        def shard_mask(fmask):
+            start = my_start()
+            col = (jnp.asarray(np.asarray(feat_group), dtype=jnp.int32)
+                   if feat_group is not None
+                   else jnp.arange(fmask.shape[0], dtype=jnp.int32))
+            stripe = (col >= start) & (col < start + per)
             return fmask * stripe.astype(fmask.dtype)
 
-        # TODO(perf): histograms are still built for ALL features on every
-        # shard (only the scan is striped); sharding construction itself
-        # needs the grower to histogram a per-shard feature slice while
-        # routing on the full matrix — tracked for the distributed phase.
         comm = CommHooks(
             merge_split=lambda info, gain: _merge_split_by_gain(
                 info, gain, axis),
-            shard_feature_mask=shard_mask)
+            shard_feature_mask=shard_mask,
+            column_block=column_block)
         in_specs = (repl, repl, repl, repl, repl, repl, repl)
         out_specs = (repl, repl)
     elif mode in ("voting", "voting_parallel"):
         def reduce_voted(h, G, H, C, fmeta):
-            local_gains = per_feature_gains(h, G, H, C, fmeta, sp)   # [F]
-            F = h.shape[0]
+            # vote in FEATURE space on the expanded view (identity when
+            # unbundled), reduce in COLUMN space.  The vote must use LOCAL
+            # leaf totals — G/H/C are already psum'd global stats, and
+            # expanding the pre-reduce partial histogram with global totals
+            # would inflate the reconstructed default-bin slot by the other
+            # shards' mass.  Every row lands in exactly one bin of every
+            # column, so column 0's bin-sum IS the local (g, h, count).
+            loc = h[0].sum(axis=0)
+            hf = expand_group_hist(h, fmeta, loc[0], loc[1], loc[2])
+            local_gains = per_feature_gains(hf, loc[0], loc[1], loc[2],
+                                            fmeta, sp)               # [F]
+            F = local_gains.shape[0]
             k = min(top_k, F)
             gains_top, local_top = lax.top_k(local_gains, k)
             votes = jnp.zeros(F, dtype=jnp.int32).at[local_top].add(
@@ -115,8 +143,14 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
             votes = lax.psum(votes, axis)
             k2 = min(2 * top_k, F)
             _, elected = lax.top_k(votes, k2)
-            mask = jnp.zeros(F, dtype=h.dtype).at[elected].set(1.0)
-            # only elected features' histograms cross the wire; the rest are
+            fmask = jnp.zeros(F, dtype=h.dtype).at[elected].set(1.0)
+            if fmeta.feat_group is not None:
+                # a column crosses the wire if ANY member feature is elected
+                mask = jnp.zeros(h.shape[0], dtype=h.dtype) \
+                    .at[fmeta.feat_group].max(fmask)
+            else:
+                mask = fmask
+            # only elected columns' histograms cross the wire; the rest are
             # zeroed so their candidates mask out in the scan
             return lax.psum(h * mask[:, None, None], axis)
 
@@ -141,7 +175,7 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
 
 def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
                                       mesh: Mesh, block_rows: int,
-                                      num_features: int):
+                                      num_columns: int, feat_group=None):
     """Data-parallel learner with the segment grower's O(leaf) per-split
     cost AND the reference's §3.4 communication pattern
     (data_parallel_tree_learner.cpp:437-447):
@@ -162,24 +196,28 @@ def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
 
     axis = mesh.axis_names[0]
     D = int(mesh.devices.size)
-    F = num_features
-    Fpad = -(-F // D) * D
-    per = Fpad // D
+    G = num_columns
+    Gpad = -(-G // D) * D
+    per = Gpad // D
 
     def reduce_hist(h, *_):
-        # [F, B, 3] per-shard partials -> reduced stripe per shard, placed
-        # back at its offset (non-stripe rows zero; the scan masks them)
-        hp = jnp.pad(h, ((0, Fpad - F), (0, 0), (0, 0)))
+        # [G, B, 3] per-shard partials -> reduced COLUMN stripe per shard,
+        # placed back at its offset (non-stripe rows zero; the scan masks
+        # out their features)
+        hp = jnp.pad(h, ((0, Gpad - G), (0, 0), (0, 0)))
         mine = lax.psum_scatter(hp, axis, scatter_dimension=0, tiled=True)
         me = lax.axis_index(axis)
         out = jnp.zeros_like(hp)
         out = lax.dynamic_update_slice(out, mine, (me * per, 0, 0))
-        return out[:F]
+        return out[:G]
 
     def shard_mask(fmask):
+        # a shard scans the features whose COLUMN lies in its stripe
         me = lax.axis_index(axis)
-        idx = jnp.arange(F, dtype=jnp.int32)
-        stripe = (idx >= me * per) & (idx < (me + 1) * per)
+        col = (jnp.asarray(np.asarray(feat_group), dtype=jnp.int32)
+               if feat_group is not None
+               else jnp.arange(fmask.shape[0], dtype=jnp.int32))
+        stripe = (col >= me * per) & (col < (me + 1) * per)
         return fmask * stripe.astype(fmask.dtype)
 
     comm = CommHooks(

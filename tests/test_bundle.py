@@ -1,0 +1,186 @@
+"""EFB (Exclusive Feature Bundling) + sparse ingestion tests.
+
+Reference behavior: Dataset::FindGroups / FastFeatureBundling
+(src/io/dataset.cpp:68-213) bundle mutually-exclusive sparse features into
+shared bin columns; LGBM_DatasetCreateFromCSR (c_api.cpp:560) ingests
+sparse input without densifying.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.bundle import BundleSpec, build_bundle, find_groups
+from lightgbm_tpu.core.dataset import TpuDataset
+
+
+def log_loss(y, p):
+    p = np.clip(p, 1e-15, 1 - 1e-15)
+    return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+
+
+def make_sparse_binary(rng, n=4000, blocks=50, width=20):
+    """[n, blocks*width] matrix where each block of `width` features is
+    one-hot-ish (mutually exclusive within the block): ideal EFB input."""
+    F = blocks * width
+    X = np.zeros((n, F), dtype=np.float64)
+    picks = rng.randint(0, width, size=(n, blocks))
+    vals = rng.normal(loc=2.0, scale=1.0, size=(n, blocks))
+    for b in range(blocks):
+        X[np.arange(n), b * width + picks[:, b]] = vals[:, b]
+    # block sums are dense signals (each row has one nonzero per block),
+    # so the problem is learnable even though every feature is 95% sparse
+    logit = (X[:, :width].sum(axis=1) - X[:, width:2 * width].sum(axis=1)
+             + 0.5 * X[:, 2 * width:3 * width].sum(axis=1) - 1.0)
+    y = (logit + rng.normal(size=n) * 0.3 > 0).astype(np.float64)
+    return X, y
+
+
+# ------------------------------------------------------------- unit: groups
+def test_find_groups_exclusive_features_bundle():
+    # 4 perfectly exclusive features -> one group
+    masks = np.zeros((4, 100), dtype=bool)
+    for f in range(4):
+        masks[f, f * 25:(f + 1) * 25] = True
+    packed = np.packbits(masks, axis=1)
+    nnz = masks.sum(axis=1)
+    num_bins = np.full(4, 10)
+    groups = find_groups(packed, nnz, num_bins, np.ones(4, bool),
+                         max_conflict_cnt=0)
+    assert len(groups) == 1 and sorted(groups[0]) == [0, 1, 2, 3]
+
+
+def test_find_groups_conflicts_respected():
+    # features 0 and 1 overlap on 30 rows -> cannot share a group at
+    # conflict budget 0, can at budget 30
+    masks = np.zeros((2, 100), dtype=bool)
+    masks[0, :50] = True
+    masks[1, 20:70] = True
+    packed = np.packbits(masks, axis=1)
+    nnz = masks.sum(axis=1)
+    nb = np.full(2, 10)
+    g0 = find_groups(packed, nnz, nb, np.ones(2, bool), max_conflict_cnt=0)
+    assert len(g0) == 2
+    g1 = find_groups(packed, nnz, nb, np.ones(2, bool), max_conflict_cnt=30)
+    assert len(g1) == 1
+
+
+def test_find_groups_bin_budget():
+    # 3 exclusive features of 120 bins each: only two fit in a 256-bin
+    # group (1 + 120 + 120 = 241; adding the third exceeds the cap)
+    masks = np.zeros((3, 300), dtype=bool)
+    for f in range(3):
+        masks[f, f * 100:(f + 1) * 100] = True
+    packed = np.packbits(masks, axis=1)
+    groups = find_groups(packed, masks.sum(axis=1), np.full(3, 120),
+                         np.ones(3, bool), max_conflict_cnt=0)
+    sizes = sorted(len(g) for g in groups)
+    assert sizes == [1, 2]
+
+
+def test_bundle_spec_offsets_disjoint():
+    spec = BundleSpec([[0, 2], [1]], np.asarray([5, 7, 9]))
+    # group 0 holds features 0 and 2 with non-overlapping ranges after the
+    # shared all-default slot 0
+    assert spec.feat_group.tolist() == [0, 1, 0]
+    assert spec.feat_offset[0] == 1
+    assert spec.feat_offset[2] == 1 + 5
+    assert spec.group_num_bin[0] == 1 + 5 + 9
+    assert spec.group_num_bin[1] == 7
+
+
+# ------------------------------------------------------- dataset-level EFB
+def test_dataset_bundles_and_matches_dense(rng):
+    # the VERDICT acceptance shape: ~1000 features, 95% sparse
+    X, y = make_sparse_binary(rng)
+    F = X.shape[1]
+    assert F == 1000 and (X == 0).mean() > 0.94
+    cfg_on = Config(objective="binary", verbosity=-1)
+    cfg_off = Config(objective="binary", verbosity=-1, enable_bundle=False)
+    ds_on = TpuDataset.from_numpy(X, y, config=cfg_on)
+    ds_off = TpuDataset.from_numpy(X, y, config=cfg_off)
+
+    assert ds_on.bundle is not None
+    # 50 exclusive blocks of 4 -> far fewer columns than features
+    assert ds_on.num_columns < F // 2
+    assert ds_on.binned.shape == (X.shape[0], ds_on.num_columns)
+    assert ds_off.binned.shape[1] == len(ds_off.used_feature_indices)
+    assert ds_on.binned.dtype == np.uint8
+
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 15, "min_data_in_leaf": 5}
+    out = {}
+    for name, flag in (("on", True), ("off", False)):
+        p = dict(params, enable_bundle=flag)
+        d = lgb.Dataset(X, y, params=p)
+        bst = lgb.train(p, d, num_boost_round=30, verbose_eval=False)
+        out[name] = log_loss(y, bst.predict(X))
+    # exclusive blocks + conflict budget 0 => bundling is lossless; the
+    # bundled run must track the dense run, and both must beat the prior
+    # (p=0.509 -> logloss ~0.693)
+    assert abs(out["on"] - out["off"]) < 0.02
+    assert out["on"] < 0.55
+
+
+def test_bundled_valid_set_and_binary_cache(rng, tmp_path):
+    X, y = make_sparse_binary(rng, n=2000)
+    Xt, yt = make_sparse_binary(rng, n=500)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 15, "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, y, params=params)
+    vs = ds.create_valid(Xt, yt)
+    res = {}
+    bst = lgb.train(params, ds, num_boost_round=20, valid_sets=[vs],
+                    verbose_eval=False, evals_result=res)
+    assert ds._handle.bundle is not None
+    # valid set shares the exact bundling
+    assert vs._handle.bundle is ds._handle.bundle
+    assert vs._handle.binned.shape[1] == ds._handle.num_columns
+    ll = log_loss(yt, bst.predict(Xt))
+    # binned eval loses conflicting bundle members on UNSEEN rows (the
+    # reference's max_conflict_rate tradeoff, dataset.cpp:93-101) while raw
+    # predict sees true values — a ~0.1% metric gap is inherent to EFB
+    assert res["valid_0"]["binary_logloss"][-1] == pytest.approx(ll, rel=1e-2)
+
+    # binary cache round-trips the bundle
+    path = str(tmp_path / "bundled.bin")
+    ds._handle.save_binary(path)
+    back = TpuDataset.load_binary(path)
+    assert back.bundle is not None
+    assert back.num_columns == ds._handle.num_columns
+    np.testing.assert_array_equal(back.binned, ds._handle.binned)
+    np.testing.assert_array_equal(back.bundle.feat_offset,
+                                  ds._handle.bundle.feat_offset)
+
+
+# --------------------------------------------------------- sparse ingestion
+def test_from_scipy_matches_dense(rng):
+    sp = pytest.importorskip("scipy.sparse")
+    X, y = make_sparse_binary(rng, n=2000)
+    Xs = sp.csr_matrix(X)
+    cfg = Config(objective="binary", verbosity=-1)
+    ds_dense = TpuDataset.from_numpy(X, y, config=cfg)
+    ds_sparse = TpuDataset.from_scipy(Xs, y, config=cfg)
+    assert ds_sparse.bundle is not None
+    # same bin boundaries and same packed matrix as the dense path
+    for md, ms in zip(ds_dense.bin_mappers, ds_sparse.bin_mappers):
+        np.testing.assert_allclose(md.bin_upper_bound, ms.bin_upper_bound)
+    np.testing.assert_array_equal(ds_dense.binned, ds_sparse.binned)
+
+
+def test_python_api_accepts_scipy_without_densify(rng, monkeypatch):
+    sp = pytest.importorskip("scipy.sparse")
+    X, y = make_sparse_binary(rng, n=2000)
+    Xs = sp.csr_matrix(X)
+    # make densification fail loudly if anything calls it
+    monkeypatch.setattr(Xs, "toarray",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            MemoryError("densified sparse input")),
+                        raising=False)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 15, "min_data_in_leaf": 5}
+    ds = lgb.Dataset(Xs, y, params=params)
+    bst = lgb.train(params, ds, num_boost_round=20, verbose_eval=False)
+    # must beat the prior (~0.693) — 20 rounds over 1000 sparse features
+    assert log_loss(y, bst.predict(X)) < 0.6

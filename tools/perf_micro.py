@@ -1,0 +1,104 @@
+"""Microbench of the segment grower's N-scaled primitives at HIGGS size.
+
+Times (a) one histogram_segment kernel over a full-N interval, (b) one
+epoch-compaction sort, (c) one routing pass — the three per-row costs that
+dominate per_iter at 10.5M rows (tools/perf_probe.py showed the N-term is
+~97% of iteration time there).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+F = 28
+B = 64
+
+
+def timeit(fn, *args, reps=5):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(fn(*args))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from lightgbm_tpu.ops.pallas_histogram import (
+        histogram_segment, pack_channels, pick_block_rows)
+    from lightgbm_tpu.models.grower_seg import (_pack_bins_words,
+                                                _pack_w8_words)
+
+    rb = pick_block_rows(F, B, N)
+    npad = -(-N // rb) * rb
+    print(f"N={N} npad={npad} rb={rb} blocks={npad//rb} backend={jax.default_backend()}", flush=True)
+    rng = np.random.RandomState(0)
+    binsT = jnp.asarray(rng.randint(0, B, size=(F + (-F) % 4, npad),
+                                    dtype=np.int64).astype(np.uint8))
+    grad = jnp.asarray(rng.normal(size=npad).astype(np.float32))
+    hess = jnp.ones(npad, jnp.float32)
+    member = jnp.ones(npad, jnp.float32)
+    w8 = pack_channels(grad, hess, member)
+    leaf_id = jnp.zeros(npad, jnp.int32)
+
+    # (a) full-N segment histogram
+    f = jax.jit(lambda b, w, l: histogram_segment(
+        b, w, l, jnp.int32(0), jnp.int32(npad // rb), jnp.int32(0), B, rb))
+    t = timeit(f, binsT, w8, leaf_id)
+    print(f"hist_full_N: {t*1e3:.1f} ms  ({t/N*1e9:.2f} ns/row)", flush=True)
+
+    # (a2) quarter-interval histogram (typical epoch confinement)
+    f4 = jax.jit(lambda b, w, l: histogram_segment(
+        b, w, l, jnp.int32(0), jnp.int32(npad // rb // 4), jnp.int32(0), B,
+        rb))
+    t = timeit(f4, binsT, w8, leaf_id)
+    print(f"hist_quarter: {t*1e3:.1f} ms", flush=True)
+
+    # (b) compaction sort (same payload as grower_seg.compact)
+    def compact(lid, bT, w):
+        ops = ((lid,) + tuple(_pack_bins_words(bT))
+               + tuple(_pack_w8_words(w)) + (jnp.arange(npad, dtype=jnp.int32),))
+        return lax.sort(ops, num_keys=1, is_stable=True)[0]
+    cj = jax.jit(compact)
+    t = timeit(cj, leaf_id, binsT, w8, reps=3)
+    print(f"compact_sort: {t*1e3:.1f} ms", flush=True)
+
+    # (c) one routing pass (fcol slice + threshold + leaf_id where)
+    def route(bT, lid):
+        fcol = lax.dynamic_slice_in_dim(bT, 3, 1, axis=0)[0, :]
+        go_left = fcol.astype(jnp.int32) <= 31
+        in_leaf = lid == 0
+        return jnp.where(in_leaf & ~go_left, 7, lid)
+    rj = jax.jit(route)
+    t = timeit(rj, binsT, leaf_id)
+    print(f"route_pass: {t*1e3:.2f} ms  (x254/tree = {t*254*1e3:.0f} ms)",
+          flush=True)
+
+    # (d) per-split scan cost proxy: [F, B, 3] best-split pair
+    from lightgbm_tpu.ops.split import (FeatureMeta, SplitParams, best_split)
+    fmeta = FeatureMeta(
+        num_bin=jnp.full(F, B, jnp.int32),
+        missing_type=jnp.zeros(F, jnp.int32),
+        default_bin=jnp.zeros(F, jnp.int32),
+        is_cat=jnp.zeros(F, bool),
+        monotone=jnp.zeros(F, jnp.int32),
+        penalty=jnp.ones(F, jnp.float32))
+    hist = jnp.asarray(rng.normal(size=(F, B, 3)).astype(np.float32))
+    sp = SplitParams(has_cat=False)
+    sj = jax.jit(lambda h: best_split(h, 1.0, float(N), float(N), fmeta, sp,
+                                      jnp.ones(F, jnp.float32)))
+    t = timeit(sj, hist, reps=20)
+    print(f"scan_one: {t*1e3:.2f} ms  (x508/tree = {t*508*1e3:.0f} ms)",
+          flush=True)
+
+
+main()

@@ -1,0 +1,129 @@
+"""Device-time microbench with in-jit repetition: each primitive runs K
+times inside ONE jit with a data dependency, so (t(K) - t(1)) / (K - 1) is
+pure device compute, immune to dispatch/RPC overhead of the tunneled
+backend (tools/perf_micro.py measured dispatch, not compute)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+F = 28
+B = 64
+K = 9
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from lightgbm_tpu.ops.pallas_histogram import (
+        histogram_segment, pack_channels, pick_block_rows)
+    from lightgbm_tpu.models.grower_seg import (
+        _pack_bins_words, _pack_w8_words, _unpack_bins_words,
+        _unpack_w8_words)
+    from lightgbm_tpu.ops.split import FeatureMeta, SplitParams, best_split
+
+    rb = pick_block_rows(F, B, N)
+    npad = -(-N // rb) * rb
+    nblk = npad // rb
+    print(f"N={N} rb={rb} blocks={nblk} backend={jax.default_backend()}",
+          flush=True)
+    rng = np.random.RandomState(0)
+    F4 = F + (-F) % 4
+    binsT = jnp.asarray(rng.randint(0, B, size=(F4, npad),
+                                    dtype=np.int64).astype(np.uint8))
+    grad = jnp.asarray(rng.normal(size=npad).astype(np.float32))
+    w8 = pack_channels(grad, jnp.ones(npad, jnp.float32),
+                       jnp.ones(npad, jnp.float32))
+    leaf_id = jnp.asarray(rng.randint(0, 2, size=npad).astype(np.int32))
+
+    def timed(make_fn, label, scale=1.0):
+        f1 = jax.jit(make_fn(1))
+        fK = jax.jit(make_fn(K))
+        r = np.asarray(f1(binsT, w8, leaf_id)).sum()  # compile+run
+        r = np.asarray(fK(binsT, w8, leaf_id)).sum()
+        ts = []
+        for f in (f1, fK):
+            t0 = time.perf_counter()
+            np.asarray(f(binsT, w8, leaf_id)).sum()
+            ts.append(time.perf_counter() - t0)
+        per = (ts[1] - ts[0]) / (K - 1)
+        print(f"{label}: {per*1e3:.2f} ms/op  (t1={ts[0]*1e3:.1f} "
+              f"tK={ts[1]*1e3:.1f}) {scale_note(per, scale)}", flush=True)
+        return per
+
+    def scale_note(per, per_tree_calls):
+        return f"-> x{per_tree_calls:.0f}/tree = " \
+               f"{per * per_tree_calls * 1e3:.0f} ms"
+
+    # (a) full-N segment histogram, K reps with alternating target leaf
+    def mk_hist(reps):
+        def fn(bT, w, lid):
+            def body(i, acc):
+                h = histogram_segment(bT, w, lid, jnp.int32(0),
+                                      jnp.int32(nblk), i % 2, B, rb)
+                return acc + h
+            return lax.fori_loop(0, reps, body,
+                                 jnp.zeros((F4, B, 8), jnp.float32))
+        return fn
+    # sum of smaller-child intervals per tree ~ 10N with default compaction
+    timed(mk_hist, "hist_full_N", scale=10.0)
+
+    # (b) compaction sort
+    def mk_sort(reps):
+        def fn(bT, w, lid):
+            def body(i, lid_c):
+                ops = ((lid_c + i,) + tuple(_pack_bins_words(bT))
+                       + tuple(_pack_w8_words(w)))
+                out = lax.sort(ops, num_keys=1, is_stable=True)
+                return out[0]
+            return lax.fori_loop(0, reps, body, lid)
+        return fn
+    timed(mk_sort, "compact_sort", scale=4.0)
+
+    # (c) routing pass
+    def mk_route(reps):
+        def fn(bT, w, lid):
+            def body(i, lid_c):
+                fcol = lax.dynamic_slice_in_dim(bT, i % F, 1, axis=0)[0, :]
+                go_left = fcol.astype(jnp.int32) <= 31
+                in_leaf = lid_c == i % 7
+                return jnp.where(in_leaf & ~go_left, i % 7 + 1, lid_c)
+            return lax.fori_loop(0, reps, body, lid)
+        return fn
+    timed(mk_route, "route_pass", scale=254.0)
+
+    # (d) per-leaf best-split scan
+    fmeta = FeatureMeta(
+        num_bin=jnp.full(F, B, jnp.int32),
+        missing_type=jnp.zeros(F, jnp.int32),
+        default_bin=jnp.zeros(F, jnp.int32),
+        is_cat=jnp.zeros(F, bool),
+        monotone=jnp.zeros(F, jnp.int32),
+        penalty=jnp.ones(F, jnp.float32))
+    sp = SplitParams(has_cat=False)
+
+    def mk_scan(reps):
+        def fn(bT, w, lid):
+            h0 = histogram_segment(bT, w, lid, jnp.int32(0), jnp.int32(1),
+                                   jnp.int32(0), B, rb)
+            hist = jnp.stack([h0[..., 0] + h0[..., 1],
+                              h0[..., 2] + h0[..., 3],
+                              h0[..., 4]], axis=-1)[:F]
+
+            def body(i, acc):
+                info = best_split(hist + acc * 1e-9, 1.0, float(N),
+                                  float(N), fmeta, sp,
+                                  jnp.ones(F, jnp.float32))
+                return acc + info.gain
+            return lax.fori_loop(0, reps, body, jnp.float32(0.0))
+        return fn
+    timed(mk_scan, "scan_one", scale=508.0)
+
+
+main()

@@ -1,0 +1,53 @@
+"""Perf probe: per-iteration time vs (num_rows, num_leaves) on the live
+backend.  Confirms where segment-grower time goes: per-split overhead
+(scales with L) vs data work (scales with N).  Usage:
+
+    python tools/perf_probe.py "rows,leaves,warmup,measure" ...
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(n_rows: int, num_leaves: int, warmup: int, measure: int) -> None:
+    import jax
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.core.dataset import TpuDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objective import create_objective
+
+    rng = np.random.RandomState(42)
+    X = rng.normal(size=(n_rows, 28)).astype(np.float32)
+    logit = 2.0 * X[:, 0] + X[:, 1] - X[:, 2] * X[:, 3]
+    y = (logit + rng.normal(size=n_rows) * 0.5 > 0).astype(np.float64)
+    cfg = Config(objective="binary", num_leaves=num_leaves, max_bin=63,
+                 learning_rate=0.1, min_sum_hessian_in_leaf=100.0,
+                 verbosity=-1)
+    ds = TpuDataset.from_numpy(X, y, config=cfg)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    booster = GBDT(cfg, ds, obj)
+    t0 = time.time()
+    for _ in range(warmup):
+        booster.train_one_iter()
+    jax.block_until_ready(booster.train_score)
+    t_warm = time.time() - t0
+    t0 = time.time()
+    for _ in range(measure):
+        booster.train_one_iter()
+    jax.block_until_ready(booster.train_score)
+    per_iter = (time.time() - t0) / measure
+    print(f"PROBE rows={n_rows} leaves={num_leaves} impl="
+          f"{'segment' if booster._use_segment else 'fused'} "
+          f"warmup={t_warm:.1f}s per_iter={per_iter:.4f}s", flush=True)
+
+
+if __name__ == "__main__":
+    for spec in sys.argv[1:]:
+        r, l, w, m = (int(x) for x in spec.split(","))
+        run(r, l, w, m)

@@ -1,0 +1,108 @@
+"""Compare compaction strategies at HIGGS size: the current 13-operand
+lax.sort vs sort-(key,index)-then-gather-payload.  All outputs reduced to
+scalars before fetch (the tunnel makes large fetches look like seconds)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+K = 5
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from lightgbm_tpu.ops.pallas_histogram import pack_channels, \
+        pick_block_rows
+    from lightgbm_tpu.models.grower_seg import (_pack_bins_words,
+                                                _pack_w8_words)
+
+    rb = pick_block_rows(28, 64, N)
+    npad = -(-N // rb) * rb
+    print(f"N={N} npad={npad} backend={jax.default_backend()}", flush=True)
+    rng = np.random.RandomState(0)
+    binsT = jnp.asarray(rng.randint(0, 64, size=(32, npad),
+                                    dtype=np.int64).astype(np.uint8))
+    w8 = pack_channels(jnp.asarray(rng.normal(size=npad).astype(np.float32)),
+                       jnp.ones(npad, jnp.float32),
+                       jnp.ones(npad, jnp.float32))
+    lid0 = jnp.asarray(rng.randint(0, 256, size=npad).astype(np.int32))
+
+    def timed(make_fn, label):
+        f1 = jax.jit(make_fn(1))
+        fK = jax.jit(make_fn(K))
+        np.asarray(f1(binsT, w8, lid0)).sum()
+        np.asarray(fK(binsT, w8, lid0)).sum()
+        t0 = time.perf_counter(); np.asarray(f1(binsT, w8, lid0)).sum()
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); np.asarray(fK(binsT, w8, lid0)).sum()
+        tK = time.perf_counter() - t0
+        per = (tK - t1) / (K - 1)
+        print(f"{label}: {per*1e3:.1f} ms/op (t1={t1*1e3:.0f} "
+              f"tK={tK*1e3:.0f})", flush=True)
+
+    def reshuffle(lid, i):
+        # cheap pseudo-random re-keying so every chained sort does real work
+        return ((lid * 1103515245 + i * 12345) & 0xFF).astype(jnp.int32)
+
+    # current: sort keys + 13 payload operands
+    def mk_full(reps):
+        def fn(bT, w, lid):
+            def body(i, lid_c):
+                ops = ((reshuffle(lid_c, i),) + tuple(_pack_bins_words(bT))
+                       + tuple(_pack_w8_words(w))
+                       + (jnp.arange(npad, dtype=jnp.int32),))
+                return lax.sort(ops, num_keys=1, is_stable=True)[0]
+            return jnp.sum(lax.fori_loop(0, reps, body, lid))
+        return fn
+    timed(mk_full, "sort13")
+
+    # candidate: sort (key, index) then one gather per payload tensor
+    def mk_pair(reps):
+        def fn(bT, w, lid):
+            def body(i, lid_c):
+                keys = reshuffle(lid_c, i)
+                _, perm = lax.sort((keys, jnp.arange(npad, dtype=jnp.int32)),
+                                   num_keys=1, is_stable=True)
+                b2 = jnp.take(bT, perm, axis=1)
+                w2 = jnp.take(w, perm, axis=1)
+                return lid_c + b2[0].astype(jnp.int32) + \
+                    w2[4].astype(jnp.int32)
+            return jnp.sum(lax.fori_loop(0, reps, body, lid))
+        return fn
+    timed(mk_pair, "sort2+gather")
+
+    # sort cost alone (2 operands)
+    def mk_pair_only(reps):
+        def fn(bT, w, lid):
+            def body(i, lid_c):
+                keys = reshuffle(lid_c, i)
+                s, perm = lax.sort(
+                    (keys, jnp.arange(npad, dtype=jnp.int32)),
+                    num_keys=1, is_stable=True)
+                return lid_c + s + perm
+            return jnp.sum(lax.fori_loop(0, reps, body, lid))
+        return fn
+    timed(mk_pair_only, "sort2_only")
+
+    # gather cost alone
+    def mk_gather(reps):
+        def fn(bT, w, lid):
+            def body(i, acc):
+                perm = (jnp.arange(npad, dtype=jnp.int32) * 7 + i) % npad
+                b2 = jnp.take(bT, perm, axis=1)
+                w2 = jnp.take(w, perm, axis=1)
+                return acc + b2[0].astype(jnp.int32) + \
+                    w2[4].astype(jnp.int32)
+            return jnp.sum(lax.fori_loop(0, reps, body, lid))
+        return fn
+    timed(mk_gather, "gather_only")
+
+
+main()

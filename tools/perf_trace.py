@@ -1,0 +1,79 @@
+"""Capture a jax-profiler trace of segment-grower iterations and print a
+per-op device-time breakdown from the xplane protobuf.
+
+Usage: python tools/perf_trace.py [rows] [leaves]
+"""
+
+import glob
+import os
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+L = int(sys.argv[2]) if len(sys.argv) > 2 else 255
+TRACE_DIR = "/tmp/lgbtpu_trace"
+
+
+def capture():
+    import jax
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.core.dataset import TpuDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objective import create_objective
+
+    rng = np.random.RandomState(42)
+    X = rng.normal(size=(N, 28)).astype(np.float32)
+    y = (2 * X[:, 0] + X[:, 1] - X[:, 2] * X[:, 3]
+         + rng.normal(size=N) * 0.5 > 0).astype(np.float64)
+    cfg = Config(objective="binary", num_leaves=L, max_bin=63,
+                 learning_rate=0.1, min_sum_hessian_in_leaf=100.0,
+                 verbosity=-1)
+    ds = TpuDataset.from_numpy(X, y, config=cfg)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    booster = GBDT(cfg, ds, obj)
+    for _ in range(2):
+        booster.train_one_iter()
+    jax.block_until_ready(booster.train_score)
+    jax.profiler.start_trace(TRACE_DIR)
+    for _ in range(2):
+        booster.train_one_iter()
+    jax.block_until_ready(booster.train_score)
+    jax.profiler.stop_trace()
+
+
+def summarize():
+    from tensorboard_plugin_profile.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(TRACE_DIR, "**", "*.xplane.pb"),
+                      recursive=True)
+    assert paths, f"no xplane under {TRACE_DIR}"
+    path = max(paths, key=os.path.getmtime)
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as fh:
+        xs.ParseFromString(fh.read())
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+            continue
+        tot = defaultdict(float)
+        cnt = defaultdict(int)
+        for line in plane.lines:
+            for ev in line.events:
+                name = plane.event_metadata[ev.metadata_id].name
+                tot[name] += ev.duration_ps / 1e12
+                cnt[name] += 1
+        items = sorted(tot.items(), key=lambda kv: -kv[1])
+        total = sum(tot.values())
+        print(f"== plane {plane.name}: lines={len(plane.lines)} "
+              f"total={total:.3f}s (2 iters; includes overlap)")
+        for name, sec in items[:40]:
+            print(f"  {sec:8.3f}s x{cnt[name]:<7} {name[:110]}")
+
+
+if __name__ == "__main__":
+    capture()
+    summarize()
