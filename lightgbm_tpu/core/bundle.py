@@ -72,13 +72,6 @@ class BundleSpec:
     def num_groups(self) -> int:
         return len(self.groups)
 
-    @property
-    def is_trivial(self) -> bool:
-        """True when every group is a singleton in feature order (the
-        packed matrix would equal the plain one)."""
-        return (self.num_groups == len(self.feat_group)
-                and all(g == [i] for i, g in enumerate(self.groups)))
-
     def to_dict(self) -> dict:
         return {"groups": self.groups}
 
@@ -197,10 +190,9 @@ def quantize_bundled(per_feature_bin_cols, spec: BundleSpec,
         if len(g) == 1:
             out[:, gi] = per_feature_bin_cols(g[0]).astype(dtype)
             continue
-        col = out[:, gi]
+        col = out[:, gi]                  # a view; writes go through
         for f in g:
             bins_f = per_feature_bin_cols(f)
             nz = bins_f != default_bins[f]
             col[nz] = (int(spec.feat_offset[f]) + bins_f[nz]).astype(dtype)
-        out[:, gi] = col
     return out

@@ -145,20 +145,41 @@ class TpuDataset:
         ``col_vals_fn(f)`` returns feature f's sampled values; for sparse
         input these are the NONZEROS only — ``total_sample_cnt -
         len(values)`` values are implicitly zero (the reference's sparse
-        FindBin convention, bin.cpp:210)."""
+        FindBin convention, bin.cpp:210).
+
+        Multi-process runs shard this loop: each rank fits the BinMappers
+        of its modulo-strided feature subset from its local sample, then
+        the serialized mappers are allgathered and merged — the
+        reference's distributed bin finding
+        (dataset_loader.cpp:933-1034)."""
+        from ..parallel import network
+        world, rank = network.binning_world()
         max_bin_by_feature = list(cfg.max_bin_by_feature or [])
-        self.bin_mappers = []
-        for f in range(num_features):
-            bt = BIN_TYPE_CATEGORICAL if f in categorical else BIN_TYPE_NUMERICAL
+
+        def fit_one(f):
+            bt = (BIN_TYPE_CATEGORICAL if f in categorical
+                  else BIN_TYPE_NUMERICAL)
             mb = (max_bin_by_feature[f] if f < len(max_bin_by_feature)
                   else cfg.max_bin)
-            m = BinMapper().find_bin(
+            return BinMapper().find_bin(
                 col_vals_fn(f), total_sample_cnt=total_sample_cnt,
                 max_bin=mb, min_data_in_bin=cfg.min_data_in_bin,
                 min_split_data=cfg.min_data_in_leaf,
                 bin_type=bt, use_missing=cfg.use_missing,
                 zero_as_missing=cfg.zero_as_missing)
-            self.bin_mappers.append(m)
+
+        if world > 1:
+            local = {f: fit_one(f).to_dict()
+                     for f in range(rank, num_features, world)}
+            merged = {}
+            for part in network.allgather_obj(local):
+                merged.update(part)
+            check(len(merged) == num_features,
+                  "distributed bin finding did not cover every feature")
+            self.bin_mappers = [BinMapper.from_dict(merged[f])
+                                for f in range(num_features)]
+        else:
+            self.bin_mappers = [fit_one(f) for f in range(num_features)]
         used = [f for f, m in enumerate(self.bin_mappers) if not m.is_trivial]
         if not used:
             log_warning("There are no meaningful features, as all feature "

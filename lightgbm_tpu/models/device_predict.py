@@ -42,6 +42,12 @@ def stack_trees(trees: List, num_features: int = -1) -> TreeStack:
     become silent clipped gathers inside the jitted predict).
     """
     T = len(trees)
+    for i, t in enumerate(trees):
+        if not getattr(t, "bins_aligned", True):
+            raise ValueError(
+                f"tree {i} was loaded from a model file and its bin "
+                f"thresholds are not aligned with any dataset; remap "
+                f"before binned prediction")
     M = max(max(t.num_leaves - 1, 1) for t in trees)
     L = max(max(t.num_leaves, 1) for t in trees)
     sf = np.zeros((T, M), dtype=np.int32)
@@ -84,8 +90,15 @@ def stack_trees(trees: List, num_features: int = -1) -> TreeStack:
 
 def predict_binned_ensemble(stack: TreeStack, bins: jax.Array,
                             fmeta_num_bin: jax.Array,
-                            fmeta_default_bin: jax.Array) -> jax.Array:
-    """Sum of per-tree raw outputs for binned rows: [N] f32."""
+                            fmeta_default_bin: jax.Array,
+                            feat_group: jax.Array = None,
+                            feat_offset: jax.Array = None) -> jax.Array:
+    """Sum of per-tree raw outputs for binned rows: [N] f32.
+
+    For EFB-bundled datasets (core/bundle.py) pass ``feat_group`` /
+    ``feat_offset`` ([F] i32): feature f's bin lives in column
+    ``feat_group[f]`` at ``feat_offset[f] + bin``, with out-of-range column
+    values meaning "f at its default bin"."""
     n = bins.shape[0]
 
     def route_one_tree(carry, tree_idx):
@@ -102,9 +115,14 @@ def predict_binned_ensemble(stack: TreeStack, bins: jax.Array,
             internal = node >= 0
             safe = jnp.maximum(node, 0)
             f = sf[safe]
+            col = f if feat_group is None else feat_group[f]
             fv = jnp.take_along_axis(
-                bins, f[:, None].astype(jnp.int32), axis=1)[:, 0] \
+                bins, col[:, None].astype(jnp.int32), axis=1)[:, 0] \
                 .astype(jnp.int32)
+            if feat_group is not None:
+                off = feat_offset[f]
+                in_range = (fv >= off) & (fv < off + fmeta_num_bin[f])
+                fv = jnp.where(in_range, fv - off, fmeta_default_bin[f])
             d = dt[safe]
             is_cat = (d & 1) > 0
             mt = (d >> 2) & 3

@@ -174,7 +174,10 @@ def tree_from_block(block: str) -> Tree:
         t.internal_value = arr("internal_value", np.float64, n)
         t.internal_weight = arr("internal_weight", np.float64, n)
         t.internal_count = arr("internal_count", np.int64, n)
-        t.threshold_in_bin = t.threshold.astype(np.int32)  # approximate
+        # real thresholds only until a dataset remap (_remap_tree_to_bins);
+        # flag keeps binned prediction from routing on these placeholders
+        t.threshold_in_bin = np.zeros(n, dtype=np.int32)
+        t.bins_aligned = False
         if t.num_cat > 0:
             bounds = arr("cat_boundaries", np.int64, t.num_cat + 1)
             words = arr("cat_threshold", np.int64, 0).astype(np.uint32)
@@ -331,13 +334,23 @@ def load_trees_into(gbdt, init_booster, raw_data=None) -> None:
             jnp.asarray(deltas[k], dtype=jnp.float32))
     for it in range(src.iter_):
         for k in range(C):
-            gbdt.models.append(src.models[it * C + k])
+            tree = src.models[it * C + k]
+            # keep the stored copies bin-aligned with the live dataset so
+            # later binned passes (eval/rollback/DART) can route them
+            if not tree.bins_aligned and gbdt.train_set is not None:
+                tree = _remap_tree_to_bins(tree, gbdt.train_set)
+            gbdt.models.append(tree)
     gbdt.iter_ += src.iter_
     gbdt._boosted_from_average = True
 
 
 def _remap_tree_to_bins(tree: Tree, ds) -> Tree:
-    """Rewrite a tree's inner (bin-space) split data against dataset ``ds``."""
+    """Rewrite a tree's inner (bin-space) split data against dataset ``ds``:
+    numerical thresholds via BinMapper::ValueToBin of the stored real
+    threshold (exact — Tree thresholds ARE bin upper bounds,
+    Dataset::RealThreshold), categorical raw-value bitsets re-expressed
+    over ``ds``'s category bins when the model file lacks the inner-bitset
+    extension block (stock LightGBM files)."""
     import copy
     t = copy.copy(tree)
     n = tree.num_leaves - 1
@@ -345,15 +358,43 @@ def _remap_tree_to_bins(tree: Tree, ds) -> Tree:
         [ds.inner_feature_index(int(f)) for f in tree.split_feature],
         dtype=np.int32)
     thr = np.zeros(n, dtype=np.int32)
+    rebuild_inner = (tree.num_cat > 0
+                     and not getattr(tree, "cat_threshold_inner", None))
+    if rebuild_inner:
+        t.cat_boundaries_inner = list(tree.cat_boundaries)
+        t.cat_threshold_inner = [None] * tree.num_cat
     for i in range(n):
         f = int(tree.split_feature[i])
         if tree.decision_type[i] & 1:
-            thr[i] = tree.threshold_in_bin[i]
+            # categorical nodes keep the cat-table index (the loader stores
+            # it in threshold_in_bin for both our and stock model files)
+            cat_idx = int(tree.threshold_in_bin[i])
+            thr[i] = cat_idx
+            if rebuild_inner:
+                words = tree.cat_threshold[cat_idx]
+                mapper = ds.bin_mappers[f]
+                # sized by the MAPPER's bin count, not the raw bitset;
+                # categories the new dataset never saw have no bin and are
+                # skipped (value_to_bin's fallback would alias an
+                # unrelated bin)
+                inner = np.zeros(max(1, -(-mapper.num_bin // 32)),
+                                 dtype=np.uint32)
+                for c in range(len(words) * 32):
+                    if words[c // 32] >> (c % 32) & 1:
+                        b = mapper.categorical_2_bin.get(c)
+                        if b is not None:
+                            inner[b // 32] |= np.uint32(1 << (b % 32))
+                t.cat_threshold_inner[cat_idx] = inner
             continue
         mapper = ds.bin_mappers[f]
         thr[i] = int(mapper.value_to_bin(
             np.asarray([tree.threshold[i]]))[0])
+    if rebuild_inner:
+        t.cat_threshold_inner = [w if w is not None
+                                 else np.zeros(1, dtype=np.uint32)
+                                 for w in t.cat_threshold_inner]
     t.threshold_in_bin = thr
+    t.bins_aligned = True
     return t
 
 

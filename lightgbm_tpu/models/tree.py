@@ -75,6 +75,13 @@ class Tree:
         self.leaf_count = np.zeros(max(num_leaves, 1), dtype=np.int64)
         self.leaf_parent = np.full(max(num_leaves, 1), -1, dtype=np.int32)
         self.leaf_depth = np.zeros(max(num_leaves, 1), dtype=np.int32)
+        # whether threshold_in_bin / split_feature_inner / inner bitsets are
+        # valid against some live dataset's bins.  Trees parsed from a model
+        # file carry only real-valued thresholds until
+        # serialization._remap_tree_to_bins aligns them (bin.h ValueToBin of
+        # Tree threshold); using them binned before that would route rows
+        # through garbage bins.
+        self.bins_aligned = True
 
     # --------------------------------------------------------------- factory
     @classmethod
@@ -213,6 +220,12 @@ class Tree:
         n = binned.shape[0]
         if self.num_leaves <= 1:
             return np.zeros(n, dtype=np.int32)
+        if not self.bins_aligned:
+            from ..utils.log import LightGBMError
+            raise LightGBMError(
+                "tree loaded from a model file has un-aligned bin "
+                "thresholds; remap it against a dataset first "
+                "(serialization._remap_tree_to_bins)")
         nb = np.asarray([fi.num_bin for fi in feature_infos], dtype=np.int32)
         db = np.asarray([fi.default_bin for fi in feature_infos], dtype=np.int32)
         # EFB (core/bundle.py): feature f lives in column grp[f] at

@@ -85,6 +85,41 @@ def rank() -> int:
     return jax.process_index()
 
 
+def binning_world() -> tuple:
+    """(world, rank) for host-level distributed bin finding
+    (dataset_loader.cpp:933-1034).  Machine count here means PROCESSES
+    (hosts) — a single process driving 8 local devices gains nothing from
+    sharding host-side binning, so the mesh size is deliberately not used."""
+    if _injected is not None:
+        return _injected["num_machines"], _injected["rank"]
+    return jax.process_count(), jax.process_index()
+
+
+def allgather_obj(obj):
+    """Allgather a picklable object across binning ranks; returns the list
+    of every rank's object (self included), rank-ordered.
+
+    Uses the injected allgather when tests fake a multi-machine run
+    (init_with_functions), else jax.experimental.multihost_utils over DCN
+    for real multi-process meshes, else identity."""
+    import pickle
+    blob = pickle.dumps(obj)
+    if _injected is not None:
+        return [pickle.loads(b) for b in _injected["allgather"](blob)]
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+    arr = np.frombuffer(blob, np.uint8)
+    sizes = multihost_utils.process_allgather(
+        np.asarray([arr.size], np.int64))
+    maxn = int(np.max(sizes))
+    pad = np.zeros(maxn, np.uint8)
+    pad[: arr.size] = arr
+    gathered = multihost_utils.process_allgather(pad)
+    return [pickle.loads(gathered[i, : int(sizes[i])].tobytes())
+            for i in range(gathered.shape[0])]
+
+
 def dispose() -> None:
     global _mesh, _injected
     _mesh = None

@@ -1,0 +1,159 @@
+"""Model-file interop: golden reference-format fixture, bin re-alignment of
+loaded trees, and CLI<->Python parity (the reference's
+tests/python_package_test/test_consistency.py:103 pattern)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import Application
+
+
+# A stock LightGBM 2.2.4-format model (gbdt_model_text.cpp:250 key order;
+# no init_scores line — that is this package's extension).  Binary
+# objective, 3 features, 2 trees:
+#   tree 0: x0<=0.5 ? (x1<=-0.25 ? -0.4 : 0.55) : 0.3
+#   tree 1: x2<=1.25 ? -0.2 : 0.1
+GOLDEN_MODEL = """tree
+version=v2
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=2
+objective=binary sigmoid:1
+feature_names=f0 f1 f2
+feature_infos=[-5:5] [-5:5] [-5:5]
+tree_sizes=480 340
+
+Tree=0
+num_leaves=3
+num_cat=0
+split_feature=0 1
+split_gain=10 5
+threshold=0.5 -0.25
+decision_type=2 2
+left_child=1 -1
+right_child=-2 -3
+leaf_value=-0.4 0.3 0.55
+leaf_weight=100 120 80
+leaf_count=100 120 80
+internal_value=0 0.1
+internal_weight=300 180
+internal_count=300 180
+shrinkage=0.1
+
+Tree=1
+num_leaves=2
+num_cat=0
+split_feature=2
+split_gain=4
+threshold=1.25
+decision_type=2
+left_child=-1
+right_child=-2
+leaf_value=-0.2 0.1
+leaf_weight=150 150
+leaf_count=150 150
+internal_value=0
+internal_weight=300
+internal_count=300
+shrinkage=0.1
+
+end of trees
+
+feature importances:
+f0=1
+f1=1
+f2=1
+
+parameters:
+end of parameters
+"""
+
+
+def _golden_raw(X):
+    t0 = np.where(X[:, 0] <= 0.5,
+                  np.where(X[:, 1] <= -0.25, -0.4, 0.55), 0.3)
+    t1 = np.where(X[:, 2] <= 1.25, -0.2, 0.1)
+    return t0 + t1
+
+
+def test_golden_reference_model_predicts(tmp_path, rng):
+    path = tmp_path / "golden.txt"
+    path.write_text(GOLDEN_MODEL)
+    bst = lgb.Booster(model_file=str(path))
+    assert bst.num_trees() == 2
+    X = rng.normal(size=(500, 3)) * 2
+    raw = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(raw, _golden_raw(X), rtol=1e-12)
+    prob = bst.predict(X)
+    np.testing.assert_allclose(prob, 1.0 / (1.0 + np.exp(-_golden_raw(X))),
+                               rtol=1e-9)
+    # save -> reload reproduces the predictions exactly
+    out = tmp_path / "resaved.txt"
+    bst.save_model(str(out))
+    bst2 = lgb.Booster(model_file=str(out))
+    np.testing.assert_array_equal(bst2.predict(X, raw_score=True), raw)
+
+
+def test_loaded_tree_binned_routing_guarded(tmp_path, rng):
+    """A tree parsed from a model file must refuse BINNED routing until its
+    thresholds are re-mapped through a dataset's BinMappers
+    (serialization.py placeholder thresholds would route on garbage)."""
+    path = tmp_path / "golden.txt"
+    path.write_text(GOLDEN_MODEL)
+    bst = lgb.Booster(model_file=str(path))
+    tree = bst.gbdt.models[0]
+    assert not tree.bins_aligned
+    X = rng.normal(size=(100, 3))
+    ds = lgb.Dataset(X, (X[:, 0] > 0).astype(float)).construct()._handle
+    with pytest.raises(lgb.LightGBMError):
+        tree.predict_binned(ds.binned, ds.feature_infos())
+
+
+def test_continued_training_realigns_loaded_trees(tmp_path, rng):
+    X = rng.normal(size=(1500, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 7}
+    b1 = lgb.train(params, lgb.Dataset(X, y), num_boost_round=5)
+    mf = str(tmp_path / "m.txt")
+    b1.save_model(mf)
+    # continue WITHOUT raw data binding: trees must be re-mapped to bins
+    b2 = lgb.train(params, lgb.Dataset(X, y), num_boost_round=5,
+                   init_model=mf)
+    assert b2.num_trees() == 10
+    assert all(t.bins_aligned for t in b2.gbdt.models)
+    # the re-mapped thresholds route identically to the raw thresholds
+    ds = lgb.Dataset(X, y).construct()._handle
+    infos = ds.feature_infos()
+    for t in b2.gbdt.models[:5]:
+        np.testing.assert_allclose(
+            t.predict_binned(ds.binned, infos), t.predict_raw(X),
+            rtol=1e-12)
+
+
+def test_cli_python_parity(tmp_path, rng):
+    """CLI and Python API trained on the SAME file with the SAME params
+    must produce identical predictions (test_consistency.py:103)."""
+    n, f = 800, 5
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    train = str(tmp_path / "train.csv")
+    np.savetxt(train, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+
+    model_cli = str(tmp_path / "cli.txt")
+    Application([
+        "task=train", f"data={train}", "objective=binary", "num_trees=12",
+        "num_leaves=7", "min_data_in_leaf=5", f"output_model={model_cli}",
+        "verbosity=-1",
+    ]).run()
+
+    params = {"objective": "binary", "num_trees": 12, "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbose": -1}
+    bst_py = lgb.train(params, lgb.Dataset(train), num_boost_round=12)
+
+    Xr = np.loadtxt(train, delimiter=",")[:, 1:]
+    p_cli = lgb.Booster(model_file=model_cli).predict(Xr)
+    p_py = bst_py.predict(Xr)
+    np.testing.assert_allclose(p_cli, p_py, rtol=1e-9, atol=1e-12)
