@@ -175,21 +175,30 @@ def build_bundle(sample_nonzero_fn, num_features: int, sample_cnt: int,
     return spec
 
 
+def bundle_dtype(spec: BundleSpec):
+    return (np.uint8 if int(spec.group_num_bin.max(initial=1)) <= 256
+            else np.uint16)
+
+
 def quantize_bundled(per_feature_bin_cols, spec: BundleSpec,
-                     default_bins: np.ndarray, num_rows: int) -> np.ndarray:
+                     default_bins: np.ndarray, num_rows: int,
+                     out: Optional[np.ndarray] = None) -> np.ndarray:
     """Pack per-feature bin columns into the bundled [N, G] uint8/16 matrix.
 
-    ``per_feature_bin_cols(f)`` returns the [N] integer bin column of used
-    feature ``f`` (a callable so sparse inputs can materialize one column
-    at a time; FeatureGroup::PushData, feature_group.h:131).
+    ``per_feature_bin_cols(f)`` returns the [num_rows] integer bin column
+    of used feature ``f`` (a callable so sparse/chunked inputs materialize
+    one column at a time; FeatureGroup::PushData, feature_group.h:131).
+    ``out``, when given, is the destination slice (chunked loading writes
+    straight into a preallocated matrix).
     """
-    dtype = (np.uint8 if int(spec.group_num_bin.max(initial=1)) <= 256
-             else np.uint16)
-    out = np.zeros((num_rows, spec.num_groups), dtype=dtype)
+    dtype = bundle_dtype(spec)
+    if out is None:
+        out = np.zeros((num_rows, spec.num_groups), dtype=dtype)
     for gi, g in enumerate(spec.groups):
         if len(g) == 1:
             out[:, gi] = per_feature_bin_cols(g[0]).astype(dtype)
             continue
+        out[:, gi] = 0
         col = out[:, gi]                  # a view; writes go through
         for f in g:
             bins_f = per_feature_bin_cols(f)

@@ -101,6 +101,7 @@ class TpuDataset:
         ds.feature_names = (list(feature_names) if feature_names
                             else [f"Column_{i}" for i in range(num_features)])
 
+        from ..utils.phase import GLOBAL_TIMER
         if reference is not None:
             check(reference.num_total_features == num_features,
                   "validation data has a different number of features")
@@ -112,12 +113,15 @@ class TpuDataset:
             ds.feature_names = list(reference.feature_names)
             ds.bundle = reference.bundle
         else:
-            ds._fit_bin_mappers(data, cfg, set(int(c) for c in categorical_features))
-            ds._build_bundle(cfg, lambda f, sample_idx=ds._sample_idx: (
-                np.asarray(data[sample_idx, ds.used_feature_indices[f]],
-                           dtype=np.float64)))
+            with GLOBAL_TIMER.phase("bin_find"):
+                ds._fit_bin_mappers(data, cfg,
+                                    set(int(c) for c in categorical_features))
+                ds._build_bundle(cfg, lambda f, sample_idx=ds._sample_idx: (
+                    np.asarray(data[sample_idx, ds.used_feature_indices[f]],
+                               dtype=np.float64)))
 
-        ds._quantize(data)
+        with GLOBAL_TIMER.phase("bin_quantize"):
+            ds._quantize(data)
         ds.metadata.init(n)
         if label is not None:
             ds.metadata.set_label(label)
@@ -303,25 +307,41 @@ class TpuDataset:
     def _build_bundle(self, cfg: Config, sample_col_fn) -> None:
         """EFB grouping from the binning sample (Dataset::Construct ->
         FastFeatureBundling, src/io/dataset.cpp:235-241).
-        ``sample_col_fn(j)`` -> raw [S] float64 sample of used feature j."""
+        ``sample_col_fn(j)`` -> raw [S] float64 sample of used feature j.
+
+        Multi-process runs take rank 0's grouping for everyone: the
+        BundleSpec defines the physical column layout, and ranks deriving
+        it from their own local samples could disagree — then sharded
+        histograms would combine mismatched columns."""
         if not cfg.enable_bundle or len(self.used_feature_indices) <= 1:
             return
+        from ..parallel import network
+        world, rank = network.binning_world()
         used = self.used_feature_indices
         num_bins = np.asarray([self.bin_mappers[f].num_bin for f in used],
                               dtype=np.int64)
-        default_bins = np.asarray(
-            [self.bin_mappers[f].default_bin for f in used], dtype=np.int64)
-        sparse_rates = np.asarray(
-            [self.bin_mappers[f].sparse_rate for f in used])
+        spec = None
+        if rank == 0:
+            default_bins = np.asarray(
+                [self.bin_mappers[f].default_bin for f in used],
+                dtype=np.int64)
+            sparse_rates = np.asarray(
+                [self.bin_mappers[f].sparse_rate for f in used])
 
-        def nonzero_fn(j):
-            m = self.bin_mappers[used[j]]
-            return m.value_to_bin(sample_col_fn(j)) != default_bins[j]
+            def nonzero_fn(j):
+                m = self.bin_mappers[used[j]]
+                return m.value_to_bin(sample_col_fn(j)) != default_bins[j]
 
-        S = len(self._sample_idx)
-        self.bundle = build_bundle(nonzero_fn, len(used), S, num_bins,
-                                   sparse_rates, cfg.sparse_threshold,
-                                   cfg.max_conflict_rate)
+            S = len(self._sample_idx)
+            spec = build_bundle(nonzero_fn, len(used), S, num_bins,
+                                sparse_rates, cfg.sparse_threshold,
+                                cfg.max_conflict_rate)
+        if world > 1:
+            groups = network.allgather_obj(
+                spec.to_dict() if spec is not None else None)[0]
+            spec = (BundleSpec.from_dict(groups, num_bins)
+                    if groups is not None else None)
+        self.bundle = spec
         if self.bundle is not None:
             log_info(f"EFB bundled {len(used)} features into "
                      f"{self.bundle.num_groups} groups")

@@ -37,13 +37,16 @@ def _detect_format(sample_lines: List[str]) -> str:
     return "csv"  # single-column fallback
 
 
+_NA_TOKENS = ("", "na", "nan", "NaN", "NULL", "N/A", "NA", "null")
+
+
 def _parse_dense(lines: List[str], sep: str) -> np.ndarray:
     rows = []
     for line in lines:
         line = line.strip()
         if not line:
             continue
-        rows.append([float(tok) if tok not in ("", "na", "nan", "NaN", "NULL")
+        rows.append([float(tok) if tok not in _NA_TOKENS
                      else np.nan for tok in line.split(sep)])
     width = max(len(r) for r in rows)
     out = np.full((len(rows), width), np.nan)
@@ -79,6 +82,51 @@ def _parse_libsvm(lines: List[str]) -> np.ndarray:
     return out
 
 
+_CHUNK_ROWS = 200_000
+
+
+def _read_head(filename: str, max_bytes: int = 1 << 16) -> List[str]:
+    """First lines of the file for format/width detection — the whole file
+    is never read into Python strings (dataset_loader.cpp:741's streaming
+    stance; the old readlines() path held ~2GB of str objects at 10M
+    rows)."""
+    with open(filename) as fh:
+        head = fh.read(max_bytes)
+        truncated = len(head) == max_bytes and fh.read(1)
+    lines = head.splitlines()
+    # only a buffer-boundary cut makes the tail line incomplete; a short
+    # file's last line is complete even without a trailing newline
+    if truncated and len(lines) > 1 and not head.endswith("\n"):
+        lines = lines[:-1]
+    return lines
+
+
+def _iter_dense_chunks(filename: str, sep: str, skip_rows: int,
+                       chunk_rows: int = _CHUNK_ROWS):
+    """Yield [chunk, ncol] float64 arrays from a CSV/TSV file via pandas'
+    C tokenizer (the numpy-tokenized chunked reader; peak memory is one
+    chunk)."""
+    import pandas as pd
+    reader = pd.read_csv(filename, sep=sep, header=None,
+                         skiprows=skip_rows, chunksize=chunk_rows,
+                         na_values=list(_NA_TOKENS), dtype=np.float64,
+                         keep_default_na=True)
+    for chunk in reader:
+        yield chunk.to_numpy(dtype=np.float64)
+
+
+def _read_dense_matrix(filename: str, sep: str, skip_rows: int) -> np.ndarray:
+    """Whole-file dense parse, chunked C tokenizer with a pure-Python
+    fallback for ragged/odd files."""
+    try:
+        chunks = list(_iter_dense_chunks(filename, sep, skip_rows))
+        return (np.vstack(chunks) if len(chunks) > 1 else chunks[0])
+    except Exception:
+        with open(filename) as fh:
+            lines = fh.readlines()[skip_rows:]
+        return _parse_dense(lines, sep)
+
+
 def _column_index(spec: str, header_names: Optional[List[str]]) -> int:
     """Resolve 'name:<col>' / numeric column spec (config.h label_column)."""
     if spec.startswith("name:"):
@@ -99,27 +147,26 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
     if filename.endswith(".bin") or _is_binary(filename):
         return TpuDataset.load_binary(filename)
 
-    with open(filename) as fh:
-        lines = fh.readlines()
+    import time
+    t0 = time.perf_counter()
+    head = _read_head(filename)
     header_names: Optional[List[str]] = None
-    if config.header and lines:
-        first = lines[0].strip()
+    skip_rows = 0
+    if config.header and head:
+        first = head[0].strip()
         sep = "\t" if "\t" in first else ","
         header_names = first.split(sep)
-        lines = lines[1:]
+        head = head[1:]
+        skip_rows = 1
 
-    fmt = _detect_format(lines[:32])
+    fmt = _detect_format(head[:32])
     log_info(f"Loading {filename} as {fmt}")
-    if fmt == "libsvm":
-        mat = _parse_libsvm(lines)
-        label_col = 0
-    else:
-        sep = "\t" if fmt == "tsv" else ","
-        mat = _parse_dense(lines, sep)
-        label_col = (_column_index(config.label_column, header_names)
-                     if config.label_column else 0)
-
-    ncol = mat.shape[1]
+    sep = "\t" if fmt == "tsv" else ","
+    ncol = (_parse_libsvm(head[:32]).shape[1] if fmt == "libsvm"
+            else len(head[0].strip().split(sep)))
+    label_col = (0 if fmt == "libsvm"
+                 else (_column_index(config.label_column, header_names)
+                       if config.label_column else 0))
     weight_col = (_column_index(config.weight_column, header_names)
                   if config.weight_column else -1)
     group_col = (_column_index(config.group_column, header_names)
@@ -130,35 +177,60 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
             tok = tok.strip()
             if tok:
                 ignore_cols.add(_column_index(tok, header_names))
-
-    label = mat[:, label_col]
-    weights = mat[:, weight_col] if weight_col >= 0 else None
-    qids = mat[:, group_col] if group_col >= 0 else None
     drop = {label_col} | ignore_cols
     if weight_col >= 0:
         drop.add(weight_col)
     if group_col >= 0:
         drop.add(group_col)
-    feat_cols = [c for c in range(ncol) if c not in drop]
-    X = mat[:, feat_cols]
-    feat_names = ([header_names[c] for c in feat_cols] if header_names
-                  else None)
+    def resolve_cols(width):
+        """Final feature columns / names / categorical indices for the
+        ACTUAL parsed width (ragged/libsvm files can exceed the head)."""
+        cols = [c for c in range(width) if c not in drop]
+        names = ([header_names[c] for c in cols]
+                 if header_names and width <= len(header_names) else None)
+        cats: List[int] = []
+        if config.categorical_feature:
+            for tok in str(config.categorical_feature).split(","):
+                tok = tok.strip()
+                if not tok:
+                    continue
+                orig = _column_index(tok, header_names)
+                # map original column index to feature index after drops
+                if orig in cols:
+                    cats.append(cols.index(orig))
+        return cols, names, cats
 
-    cat_idx: List[int] = []
-    if config.categorical_feature:
-        for tok in str(config.categorical_feature).split(","):
-            tok = tok.strip()
-            if not tok:
-                continue
-            orig = _column_index(tok, header_names)
-            # map original column index to feature index after drops
-            if orig in feat_cols:
-                cat_idx.append(feat_cols.index(orig))
+    feat_cols, feat_names, cat_idx = resolve_cols(ncol)
 
-    ds = TpuDataset.from_numpy(
-        X, label=label, config=config, weights=weights,
-        categorical_features=cat_idx, feature_names=feat_names,
-        reference=reference)
+    if fmt != "libsvm" and config.two_round:
+        ds = _load_two_round(filename, sep, skip_rows, config, label_col,
+                             weight_col, group_col, feat_cols, feat_names,
+                             cat_idx, reference, t0)
+        qids = ds._qids_tmp
+        del ds._qids_tmp
+    else:
+        if fmt == "libsvm":
+            with open(filename) as fh:
+                lines = fh.readlines()[skip_rows:]
+            mat = _parse_libsvm(lines)
+        else:
+            mat = _read_dense_matrix(filename, sep, skip_rows)
+        if mat.shape[1] != ncol:
+            # the head under-estimated the width (libsvm tail features or
+            # a ragged dense file through the fallback parser)
+            feat_cols, feat_names, cat_idx = resolve_cols(mat.shape[1])
+        t_read = time.perf_counter() - t0
+        label = mat[:, label_col]
+        weights = mat[:, weight_col] if weight_col >= 0 else None
+        qids = mat[:, group_col] if group_col >= 0 else None
+        X = mat[:, feat_cols]
+        t0b = time.perf_counter()
+        ds = TpuDataset.from_numpy(
+            X, label=label, config=config, weights=weights,
+            categorical_features=cat_idx, feature_names=feat_names,
+            reference=reference)
+        log_info(f"load: read={t_read:.2f}s "
+                 f"bin={time.perf_counter() - t0b:.2f}s")
     if qids is not None:
         ds.metadata.set_query_from_ids(qids)
     # group file sidecar: <data>.query (dataset_loader.cpp query file load)
@@ -167,11 +239,128 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
         groups = np.loadtxt(qfile, dtype=np.int64, ndmin=1)
         ds.metadata.set_query(groups)
     wfile = filename + ".weight"
-    if weights is None and os.path.exists(wfile):
+    if ds.metadata.weights is None and os.path.exists(wfile):
         ds.metadata.set_weights(np.loadtxt(wfile, ndmin=1))
     ifile = filename + ".init"
     if os.path.exists(ifile):
         ds.metadata.set_init_score(np.loadtxt(ifile, ndmin=1).ravel())
+    return ds
+
+
+def _load_two_round(filename: str, sep: str, skip_rows: int, config: Config,
+                    label_col: int, weight_col: int, group_col: int,
+                    feat_cols: List[int], feat_names, cat_idx, reference,
+                    t0: float):
+    """Two-pass low-memory loading (two_round config;
+    dataset_loader.cpp:741-840 SampleTextDataFromFile + two-round
+    ExtractFeatures): pass 1 streams chunks keeping only a uniform
+    reservoir sample for bin finding plus the label/weight/query columns;
+    pass 2 streams again and quantizes straight into the preallocated bin
+    matrix.  Peak memory = binned matrix + one raw chunk + the sample."""
+    import time
+
+    from .bundle import bundle_dtype, quantize_bundled
+    from .dataset import TpuDataset
+
+    rng = np.random.RandomState(config.data_random_seed)
+    S_target = int(config.bin_construct_sample_cnt)
+    sample_rows: List[np.ndarray] = []
+    sample_full: Optional[np.ndarray] = None
+    labels, weights, qids = [], [], []
+    n_seen = 0
+    for chunk in _iter_dense_chunks(filename, sep, skip_rows):
+        k = chunk.shape[0]
+        labels.append(np.ascontiguousarray(chunk[:, label_col]))
+        if weight_col >= 0:
+            weights.append(np.ascontiguousarray(chunk[:, weight_col]))
+        if group_col >= 0:
+            qids.append(np.ascontiguousarray(chunk[:, group_col]))
+        if reference is None:
+            feats = chunk[:, feat_cols]
+            take_head = max(0, min(S_target - n_seen, k))
+            if take_head:
+                sample_rows.append(feats[:take_head].copy())
+            if take_head < k:
+                if sample_full is None:
+                    sample_full = np.vstack(sample_rows)
+                    sample_rows = []
+                # vectorized reservoir: global row i replaces a random
+                # slot with probability S/(i+1)
+                gi = n_seen + np.arange(take_head, k)
+                slots = (rng.random_sample(len(gi))
+                         * (gi + 1)).astype(np.int64)
+                hit = slots < S_target
+                for r, s in zip(np.nonzero(hit)[0], slots[hit]):
+                    sample_full[s] = feats[take_head + r]
+        n_seen += k
+    if reference is None and sample_full is None:
+        sample_full = (np.vstack(sample_rows) if sample_rows
+                       else np.zeros((0, len(feat_cols))))
+    t_pass1 = time.perf_counter() - t0
+
+    N = n_seen
+    ds = TpuDataset()
+    ds.num_data = N
+    ds.num_total_features = len(feat_cols)
+    ds.feature_names = (list(feat_names) if feat_names
+                        else [f"Column_{i}" for i in range(len(feat_cols))])
+    if reference is not None:
+        check(reference.num_total_features == len(feat_cols),
+              "validation data has a different number of features")
+        ds.bin_mappers = reference.bin_mappers
+        ds.used_feature_indices = reference.used_feature_indices
+        ds.max_num_bin = reference.max_num_bin
+        ds.monotone_constraints = reference.monotone_constraints
+        ds.feature_penalty = reference.feature_penalty
+        ds.feature_names = list(reference.feature_names)
+        ds.bundle = reference.bundle
+    else:
+        S = sample_full.shape[0]
+        ds._sample_idx = np.arange(S)
+        ds._fit_bin_mappers_from_cols(
+            config, set(int(c) for c in cat_idx), len(feat_cols),
+            lambda f: np.asarray(sample_full[:, f], dtype=np.float64), S)
+        ds._build_bundle(config, lambda j: np.asarray(
+            sample_full[:, ds.used_feature_indices[j]], dtype=np.float64))
+    t_bin = time.perf_counter() - t0 - t_pass1
+
+    used = ds.used_feature_indices
+    default_bins = np.asarray([ds.bin_mappers[f].default_bin for f in used],
+                              dtype=np.int64)
+    if ds.bundle is not None:
+        dtype = bundle_dtype(ds.bundle)
+    else:
+        dtype = np.uint8 if ds.max_num_bin <= 256 else np.uint16
+    out = np.zeros((N, ds.num_columns), dtype=dtype)
+    off = 0
+    for chunk in _iter_dense_chunks(filename, sep, skip_rows):
+        feats = chunk[:, feat_cols]
+        k = feats.shape[0]
+
+        def col_bins(j, feats=feats):
+            f = int(used[j])
+            return ds.bin_mappers[f].value_to_bin(
+                np.asarray(feats[:, f], dtype=np.float64))
+
+        if ds.bundle is not None:
+            quantize_bundled(col_bins, ds.bundle, default_bins, k,
+                             out=out[off:off + k])
+        else:
+            for j in range(len(used)):
+                out[off:off + k, j] = col_bins(j).astype(dtype)
+        off += k
+    ds.binned = out
+    ds._device_binned = None
+    t_pass2 = time.perf_counter() - t0 - t_pass1 - t_bin
+    log_info(f"two-round load: sample_pass={t_pass1:.2f}s bin={t_bin:.2f}s "
+             f"quantize_pass={t_pass2:.2f}s rows={N}")
+
+    ds.metadata.init(N)
+    ds.metadata.set_label(np.concatenate(labels) if labels
+                          else np.zeros(0))
+    if weights:
+        ds.metadata.set_weights(np.concatenate(weights))
+    ds._qids_tmp = np.concatenate(qids) if qids else None
     return ds
 
 

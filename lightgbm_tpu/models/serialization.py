@@ -361,7 +361,6 @@ def _remap_tree_to_bins(tree: Tree, ds) -> Tree:
     rebuild_inner = (tree.num_cat > 0
                      and not getattr(tree, "cat_threshold_inner", None))
     if rebuild_inner:
-        t.cat_boundaries_inner = list(tree.cat_boundaries)
         t.cat_threshold_inner = [None] * tree.num_cat
     for i in range(n):
         f = int(tree.split_feature[i])
@@ -393,6 +392,13 @@ def _remap_tree_to_bins(tree: Tree, ds) -> Tree:
         t.cat_threshold_inner = [w if w is not None
                                  else np.zeros(1, dtype=np.uint32)
                                  for w in t.cat_threshold_inner]
+        # boundaries must describe the REBUILT word arrays (sized by the
+        # mapper's bins), not the raw-category ones — a save/reload slices
+        # the flattened inner words by these offsets
+        bounds = [0]
+        for w in t.cat_threshold_inner:
+            bounds.append(bounds[-1] + len(w))
+        t.cat_boundaries_inner = bounds
     t.threshold_in_bin = thr
     t.bins_aligned = True
     return t

@@ -66,6 +66,29 @@ def _merge_split_by_gain(info: SplitInfo, gain, axis):
     return merged, gains[winner]
 
 
+def _log_collective_estimate(mode: str, D: int, num_columns: int,
+                             num_bins: int, num_leaves: int,
+                             top_k: int = 0):
+    """Static wire-byte estimate from mesh math (SURVEY §5: the TPU
+    equivalent of the fork's Linkers byte counters, linkers.h:114-117).
+    Ring allreduce moves ~2x the payload, reduce-scatter ~1x; the
+    SplitInfo merge is ~14 scalars all_gathered per leaf scan."""
+    from ..utils.log import log_info
+    hist_bytes = num_columns * num_bins * 3 * 4
+    per_split = {
+        "data": 2 * hist_bytes,            # psum (allreduce) of full hist
+        "data_segment": hist_bytes,        # psum_scatter (reduce-scatter)
+        "voting": 2 * hist_bytes * min(1.0, 2 * top_k / max(num_columns, 1))
+        + num_columns * 4,                 # elected slices + vote psum
+        "feature": 0,                      # scan-only; no hist crosses
+    }.get(mode, 0)
+    split_info = 14 * 4 * D * 2            # all_gather of 2 SplitInfos
+    total = (num_leaves - 1) * (per_split + split_info)
+    log_info(f"collective estimate [{mode}, D={D}]: "
+             f"{per_split + split_info} B/split, "
+             f"{total / 1e6:.1f} MB/tree on the wire")
+
+
 def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
                          mode: str, top_k: int = 20,
                          num_columns: int = 0, feat_group=None):
@@ -170,6 +193,9 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
     def wrap(grow):
         return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
 
+    _log_collective_estimate(
+        mode.split("_")[0], D, num_columns or 0, num_bins,
+        params.num_leaves, top_k)
     return make_grow_tree(num_bins, params, comm=comm, wrap=wrap)
 
 
@@ -233,5 +259,7 @@ def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
     def wrap(grow):
         return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
 
+    _log_collective_estimate("data_segment", D, G, num_bins,
+                             params.num_leaves)
     return make_grow_tree_segment(num_bins, params, block_rows, comm=comm,
                                   wrap=wrap)

@@ -89,9 +89,20 @@ def binning_world() -> tuple:
     """(world, rank) for host-level distributed bin finding
     (dataset_loader.cpp:933-1034).  Machine count here means PROCESSES
     (hosts) — a single process driving 8 local devices gains nothing from
-    sharding host-side binning, so the mesh size is deliberately not used."""
+    sharding host-side binning, so the mesh size is deliberately not used.
+
+    jax.process_count() would INITIALIZE the backend; dataset loading is
+    pure host work and must not block on a device runtime (a down TPU
+    tunnel turns backend init into a retry loop), so multi-process is only
+    consulted when jax.distributed was explicitly initialized."""
     if _injected is not None:
         return _injected["num_machines"], _injected["rank"]
+    try:
+        from jax._src import distributed
+        if distributed.global_state.client is None:
+            return 1, 0
+    except Exception:
+        return 1, 0
     return jax.process_count(), jax.process_index()
 
 

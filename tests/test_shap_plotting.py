@@ -1,0 +1,75 @@
+"""SHAP (predict_contrib) and plotting tests — reference coverage:
+test_engine.py predict_contrib assertions + test_plotting.py."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture
+def binary_booster(rng):
+    X = rng.normal(size=(1200, 6))
+    y = (X[:, 0] * 2 + X[:, 1] - X[:, 2] > 0).astype(np.float64)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 15}
+    res = {}
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train(params, ds, num_boost_round=10,
+                    valid_sets=[ds.create_valid(X, y)], verbose_eval=False,
+                    evals_result=res)
+    return bst, X, y, res
+
+
+def test_contrib_local_accuracy(binary_booster):
+    """TreeSHAP local accuracy: contributions (+ bias) sum to the raw
+    score for every row (Tree::PredictContrib contract)."""
+    bst, X, y, _ = binary_booster
+    contrib = bst.predict(X[:50], pred_contrib=True)
+    assert contrib.shape == (50, X.shape[1] + 1)
+    raw = bst.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6,
+                               atol=1e-6)
+    # the dominant feature must carry the largest mean |contribution|
+    mean_abs = np.abs(contrib[:, :-1]).mean(axis=0)
+    assert int(np.argmax(mean_abs)) == 0
+
+
+def test_contrib_multiclass_shape(rng):
+    X = rng.normal(size=(900, 5))
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    params = {"objective": "multiclass", "num_class": 3, "verbose": -1,
+              "num_leaves": 7}
+    bst = lgb.train(params, lgb.Dataset(X, y.astype(float)),
+                    num_boost_round=5)
+    contrib = bst.predict(X[:20], pred_contrib=True)
+    assert contrib.shape == (20, 3 * (X.shape[1] + 1))
+    raw = bst.predict(X[:20], raw_score=True)
+    sums = contrib.reshape(20, 3, X.shape[1] + 1).sum(axis=2)
+    np.testing.assert_allclose(sums, raw, rtol=1e-6, atol=1e-6)
+
+
+def test_plot_importance_and_metric(binary_booster):
+    mpl = pytest.importorskip("matplotlib")
+    mpl.use("Agg")
+    bst, X, y, res = binary_booster
+    ax = lgb.plot_importance(bst)
+    labels = [t.get_text() for t in ax.get_yticklabels()]
+    assert any("Column_0" in l for l in labels)
+    ax2 = lgb.plot_metric(res, metric="binary_logloss")
+    assert ax2.get_lines()
+    import matplotlib.pyplot as plt
+    plt.close("all")
+
+
+def test_plot_tree_runs(binary_booster):
+    import shutil
+    if not shutil.which("dot"):
+        pytest.skip("graphviz `dot` binary not installed")
+    mpl = pytest.importorskip("matplotlib")
+    mpl.use("Agg")
+    bst, _, _, _ = binary_booster
+    ax = lgb.plot_tree(bst, tree_index=0)
+    assert ax is not None
+    import matplotlib.pyplot as plt
+    plt.close("all")
