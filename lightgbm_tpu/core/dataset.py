@@ -423,20 +423,25 @@ class TpuDataset:
             self._device_binned = jnp.asarray(self.binned)
         return self._device_binned
 
-    def device_binned_T(self, row_multiple: int = 1):
+    def device_binned_T(self, row_multiple: int = 1, packed4: bool = False):
         """Feature-major [F, Npad] bin matrix, rows padded to a multiple of
         ``row_multiple`` (pad rows are bin 0; training must give them zero
         weight).  This is the training layout: each feature is a contiguous
-        lane stream for the histogram kernels."""
+        lane stream for the histogram kernels.  ``packed4`` packs two
+        <=16-bin columns per byte (Dense4bitsBin equivalent,
+        dense_nbits_bin.hpp:42): [ceil(F/2), Npad] on device."""
         import jax.numpy as jnp
         key = getattr(self, "_device_binned_T_key", None)
-        if key != row_multiple:
+        if key != (row_multiple, packed4):
             npad = (-self.num_data) % row_multiple
             t = np.ascontiguousarray(self.binned.T)
             if npad:
                 t = np.pad(t, ((0, 0), (0, npad)))
+            if packed4:
+                from ..ops.pallas_histogram import pack_bins_4bit
+                t = pack_bins_4bit(t)
             self._device_binned_T = jnp.asarray(t)
-            self._device_binned_T_key = row_multiple
+            self._device_binned_T_key = (row_multiple, packed4)
         return self._device_binned_T
 
     def create_valid(self, data, label: Optional[np.ndarray] = None,

@@ -205,7 +205,7 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
     if fmt != "libsvm" and config.two_round:
         ds = _load_two_round(filename, sep, skip_rows, config, label_col,
                              weight_col, group_col, feat_cols, feat_names,
-                             cat_idx, reference, t0)
+                             cat_idx, reference, t0, ncol, resolve_cols)
         qids = ds._qids_tmp
         del ds._qids_tmp
     else:
@@ -250,7 +250,7 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
 def _load_two_round(filename: str, sep: str, skip_rows: int, config: Config,
                     label_col: int, weight_col: int, group_col: int,
                     feat_cols: List[int], feat_names, cat_idx, reference,
-                    t0: float):
+                    t0: float, ncol: int = -1, resolve_cols=None):
     """Two-pass low-memory loading (two_round config;
     dataset_loader.cpp:741-840 SampleTextDataFromFile + two-round
     ExtractFeatures): pass 1 streams chunks keeping only a uniform
@@ -270,6 +270,11 @@ def _load_two_round(filename: str, sep: str, skip_rows: int, config: Config,
     n_seen = 0
     for chunk in _iter_dense_chunks(filename, sep, skip_rows):
         k = chunk.shape[0]
+        if n_seen == 0 and resolve_cols is not None \
+                and chunk.shape[1] != ncol:
+            # the head buffer truncated a very wide first row; re-resolve
+            # the column roles from the true parsed width
+            feat_cols, feat_names, cat_idx = resolve_cols(chunk.shape[1])
         labels.append(np.ascontiguousarray(chunk[:, label_col]))
         if weight_col >= 0:
             weights.append(np.ascontiguousarray(chunk[:, weight_col]))

@@ -233,31 +233,10 @@ class GBDT:
         # row-major sharded layout is incompatible with the feature-major
         # pallas bins
         impl = str(cfg.tpu_tree_impl).strip().lower()
-        data_mode = tl in ("data", "data_parallel") and impl != "fused"
-        D = int(mesh.devices.size) if parallel else 1
-        backend = self._resolve_hist_backend(parallel and not data_mode)
-        rb = 0
-        if backend == "pallas":
-            from ..ops.pallas_histogram import pick_block_rows
-            rb = (cfg.tpu_row_chunk if cfg.tpu_row_chunk > 0 else
-                  pick_block_rows(train_set.num_columns,
-                                  self.num_bins, -(-self.num_data // D)))
-            # each shard's row count must be a whole number of blocks
-            self.bins = train_set.device_binned_T(rb * D)
-            self._row_pad = int(self.bins.shape[1]) - self.num_data
-        else:
-            self.bins = train_set.device_binned()
-        # rb threads through as the single block size for BOTH the bin
-        # matrix padding and every kernel launch (grower + segment grower);
-        # re-picking it at a kernel call site could desync from the padding
-        infos = train_set.feature_infos()
-        use_monotone = any(i.monotone != 0 for i in infos)
-        use_cegb_coupled = bool(cfg.cegb_penalty_feature_coupled)
-        use_cegb_lazy = bool(cfg.cegb_penalty_feature_lazy)
-        if use_cegb_lazy and parallel:
-            log_warning("cegb_penalty_feature_lazy is not supported by the "
-                        "distributed learners; ignoring it")
-            use_cegb_lazy = False
+        # forced splits are a fused-grower feature: resolve them BEFORE the
+        # layout choice, because a forced data-parallel run must fall back
+        # to the fused grower's ROW-major sharded layout (a feature-major
+        # pallas matrix sharded on axis 0 would split features, not rows)
         forced_plan = ()
         if cfg.forcedsplits_filename:
             if parallel and tl not in ("data", "data_parallel"):
@@ -272,12 +251,46 @@ class GBDT:
                 forced_plan = _build_forced_plan(train_set,
                                                  cfg.forcedsplits_filename,
                                                  max(2, cfg.num_leaves))
+        data_mode = (tl in ("data", "data_parallel") and impl != "fused"
+                     and not forced_plan)
+        D = int(mesh.devices.size) if parallel else 1
+        backend = self._resolve_hist_backend(parallel and not data_mode)
+        rb = 0
+        self._packed4 = False
+        if backend == "pallas":
+            from ..ops.pallas_histogram import pick_block_rows
+            rb = (cfg.tpu_row_chunk if cfg.tpu_row_chunk > 0 else
+                  pick_block_rows(train_set.num_columns,
+                                  self.num_bins, -(-self.num_data // D)))
+            # each shard's row count must be a whole number of blocks
+            # 4-bit packing (Dense4bitsBin equivalent) for <=16-bin
+            # datasets: two columns per byte halves the bin-stream DMA
+            # and the compaction sort payload
+            self._packed4 = self.num_bins <= 16
+            self.bins = train_set.device_binned_T(rb * D,
+                                                  packed4=self._packed4)
+            self._row_pad = int(self.bins.shape[1]) - self.num_data
+        else:
+            self.bins = train_set.device_binned()
+        # rb threads through as the single block size for BOTH the bin
+        # matrix padding and every kernel launch (grower + segment grower);
+        # re-picking it at a kernel call site could desync from the padding
+        infos = train_set.feature_infos()
+        use_monotone = any(i.monotone != 0 for i in infos)
+        use_cegb_coupled = bool(cfg.cegb_penalty_feature_coupled)
+        use_cegb_lazy = bool(cfg.cegb_penalty_feature_lazy)
+        if use_cegb_lazy and parallel:
+            log_warning("cegb_penalty_feature_lazy is not supported by the "
+                        "distributed learners; ignoring it")
+            use_cegb_lazy = False
         self.grower_params = GrowerParams(
             num_leaves=max(2, cfg.num_leaves),
             max_depth=cfg.max_depth,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
             row_chunk=rb,
             hist_backend=backend,
+            packed4=self._packed4,
+            num_columns=train_set.num_columns,
             use_monotone=use_monotone,
             cegb_tradeoff=float(cfg.cegb_tradeoff),
             cegb_penalty_split=float(cfg.cegb_penalty_split),

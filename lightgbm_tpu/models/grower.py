@@ -49,6 +49,13 @@ class GrowerParams(NamedTuple):
     # "pallas": feature-major [F, Npad] bins, TPU pallas kernel
     # (ops/pallas_histogram.py)
     hist_backend: str = "onehot"
+    # pallas-only: bins packed two <=16-bin columns per byte
+    # (ops/pallas_histogram.pack_bins_4bit; reference Dense4bitsBin,
+    # dense_nbits_bin.hpp:42) — halves bin-stream DMA and sort payload
+    packed4: bool = False
+    # logical bin-matrix columns (EFB groups); 0 = same as the physical
+    # row count of the bins array (needed when packed4 obscures it)
+    num_columns: int = 0
     # static: any feature carries a monotone constraint — enables per-leaf
     # [min, max] output-bound propagation (LeafSplits::SetValueConstraint,
     # src/treelearner/leaf_splits.hpp:50-53 + the mid-split handoff in
@@ -344,7 +351,9 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
         if p.feature_major:
             from ..ops.pallas_histogram import leaf_histogram_pallas
             out = leaf_histogram_pallas(hist_bins, grad, hess, member, B,
-                                        p.row_chunk)
+                                        p.row_chunk, packed4=p.packed4)
+            if p.num_columns:
+                out = out[: p.num_columns]
         else:
             w = jnp.stack([grad * member, hess * member, member])
             out = histogram_chunked(hist_bins, w, B, p.row_chunk)
@@ -465,7 +474,14 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             if p.feature_major:
                 # contiguous [1, N] stream — far cheaper than the strided
                 # row-major column gather
-                fcol = lax.dynamic_slice_in_dim(bins, col, 1, axis=0)[0, :]
+                if p.packed4:
+                    byte = lax.dynamic_slice_in_dim(bins, col // 2, 1,
+                                                    axis=0)[0, :]
+                    byte = byte.astype(jnp.int32)
+                    fcol = jnp.where(col % 2 == 1, byte >> 4, byte & 15)
+                else:
+                    fcol = lax.dynamic_slice_in_dim(bins, col, 1,
+                                                    axis=0)[0, :]
             else:
                 fcol = lax.dynamic_slice_in_dim(bins, col, 1, axis=1)[:, 0]
             fcol = reconstruct_feature_column(fcol, f, fmeta)

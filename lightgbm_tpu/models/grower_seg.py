@@ -140,7 +140,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         lo = st.leaf_lo[leaf]
         n_blk = st.leaf_hi[leaf] - lo
         out = histogram_segment(st.binsT, st.w8, st.leaf_id, lo, n_blk,
-                                leaf, B, rb)
+                                leaf, B, rb, packed4=p.packed4)
         h = unpack_hist(out[:G_cols])
         if comm.reduce_hist is not None:
             h = comm.reduce_hist(h, None, None, None, None)
@@ -234,14 +234,16 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
 
     def grow(binsT, grad, hess, member, fmeta: FeatureMeta, feature_mask,
              key):
-        # G_cols = physical bin-matrix columns (EFB groups); F = logical
-        # features (fmeta/feature_mask space).  Equal when unbundled.
-        G_cols, n = binsT.shape
+        # G_cols = logical bin-matrix columns (EFB groups); F = logical
+        # features (fmeta/feature_mask space); binsT rows are PHYSICAL
+        # (half of G_cols under 4-bit packing).
+        n_phys, n = binsT.shape
+        G_cols = p.num_columns or (2 * n_phys if p.packed4 else n_phys)
         F = fmeta.num_bin.shape[0]
         assert n % rb == 0, (n, rb)
         max_blocks = n // rb
-        # pad column rows to a multiple of 4 for the sort word packing
-        fpad = (-G_cols) % 4
+        # pad physical rows to a multiple of 4 for the sort word packing
+        fpad = (-n_phys) % 4
         if fpad:
             binsT = jnp.pad(binsT, ((0, fpad), (0, 0)))
 
@@ -267,7 +269,14 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             bitset = st.best_cat_bitset[leaf]
 
             col = f if fmeta.feat_group is None else fmeta.feat_group[f]
-            fcol = lax.dynamic_slice_in_dim(st.binsT, col, 1, axis=0)[0, :]
+            if p.packed4:
+                byte = lax.dynamic_slice_in_dim(st.binsT, col // 2, 1,
+                                                axis=0)[0, :].astype(
+                                                    jnp.int32)
+                fcol = jnp.where(col % 2 == 1, byte >> 4, byte & 15)
+            else:
+                fcol = lax.dynamic_slice_in_dim(st.binsT, col, 1,
+                                                axis=0)[0, :]
             fcol = reconstruct_feature_column(fcol, f, fmeta)
             go_left = routed_left(fcol, t, dl, cat, bitset,
                                   fmeta.missing_type[f],

@@ -137,7 +137,7 @@ def unpack_hist(out: jax.Array) -> jax.Array:
     return jnp.stack([g, h, c], axis=-1)
 
 
-def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins):
+def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
     """Shared inner body: one [F, rb] bin block into the [F*B, 8]
     accumulator, one combined-one-hot matmul per (chunk, fblock).
 
@@ -145,21 +145,33 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins):
     Chunks are walked with an in-kernel ``fori_loop`` so the Mosaic program
     size is independent of the row-block size (a fully unrolled 64-chunk
     body made kernel compilation a large share of the jit time).
+
+    ``packed4``: the bin block holds TWO <=16-bin features per byte
+    (feature 2i in the low nibble of row i, 2i+1 in the high) — the TPU
+    equivalent of the reference's Dense4bitsBin (dense_nbits_bin.hpp:42):
+    half the HBM bin-stream DMA for narrow-bin datasets; unpacking is two
+    VPU ops per block.
     """
-    F, rb = binsT_ref.shape
+    Fp, rb = binsT_ref.shape
+    F = Fp * 2 if packed4 else Fp
     B = num_bins
-    fblk = _fblk(B)
+    fblk = max(1, _fblk(B) // (2 if packed4 else 1))
     chunk = _pick_chunk(rb)
 
     def one_chunk(c, carry):
         wc = wfn(c, chunk)                                  # [8, chunk]
-        for f0 in range(0, F, fblk):
-            nf = min(fblk, F - f0)
-            b = binsT_ref[f0:f0 + nf, pl.ds(c * chunk, chunk)].astype(
+        for p0 in range(0, Fp, fblk):
+            np_ = min(fblk, Fp - p0)
+            b = binsT_ref[p0:p0 + np_, pl.ds(c * chunk, chunk)].astype(
                 jnp.int32)
+            if packed4:
+                b = jnp.stack([b & 15, b >> 4], axis=1).reshape(
+                    2 * np_, chunk)
+            nf = b.shape[0]
             iota = lax.broadcasted_iota(jnp.int32, (nf, B, chunk), 1)
             onehot = (b[:, None, :] == iota).astype(
                 jnp.bfloat16).reshape(nf * B, chunk)
+            f0 = (2 * p0 if packed4 else p0)
             acc_ref[f0 * B:(f0 + nf) * B] += lax.dot_general(
                 onehot, wc, dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -168,7 +180,7 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins):
     lax.fori_loop(0, rb // chunk, one_chunk, 0)
 
 
-def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref):
+def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref, *, num_bins, packed4):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -178,15 +190,15 @@ def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref):
     def wfn(c, chunk):
         return w_ref[:, pl.ds(c * chunk, chunk)]
 
-    _accumulate_block(binsT_ref, wfn, acc_ref,
-                      acc_ref.shape[0] // binsT_ref.shape[0])
+    _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
         out_ref[:] = acc_ref[:]
 
 
-def _kernel_segment(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref):
+def _kernel_segment(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref, *,
+                    num_bins, packed4):
     # sref: prefetched [3] i32 = (start_block, n_blocks, target_leaf)
     i = pl.program_id(0)
 
@@ -201,8 +213,7 @@ def _kernel_segment(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref):
             lc = lid_ref[:, pl.ds(c * chunk, chunk)]
             return wc * (lc == sref[2]).astype(jnp.bfloat16)
 
-        _accumulate_block(binsT_ref, wfn, acc_ref,
-                          acc_ref.shape[0] // binsT_ref.shape[0])
+        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -214,37 +225,42 @@ def _interpret_default() -> bool:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_bins", "block_rows", "interpret"))
+                   static_argnames=("num_bins", "block_rows", "interpret",
+                                    "packed4"))
 def histogram_all(binsT: jax.Array, w8: jax.Array, num_bins: int,
                   block_rows: int = 0,
-                  interpret: bool | None = None) -> jax.Array:
+                  interpret: bool | None = None,
+                  packed4: bool = False) -> jax.Array:
     """Full-data histogram: [F, Npad] bins x [8, Npad] channels -> [F, B, 8].
 
     Npad must be a multiple of ``block_rows``; pad rows must carry zero
-    weight channels (the bin values there may be anything).
+    weight channels (the bin values there may be anything).  With
+    ``packed4`` the bins hold two <=16-bin features per byte and F here
+    means PHYSICAL rows; the output has 2F logical features.
     """
     F, n = binsT.shape
+    F_log = 2 * F if packed4 else F
     if block_rows <= 0:
-        block_rows = pick_block_rows(F, num_bins)
+        block_rows = pick_block_rows(F_log, num_bins)
     if interpret is None:
         interpret = _interpret_default()
     assert n % block_rows == 0, (n, block_rows)
     out = pl.pallas_call(
-        _kernel_all,
-        out_shape=jax.ShapeDtypeStruct((F * num_bins, NUM_CHANNELS),
+        functools.partial(_kernel_all, num_bins=num_bins, packed4=packed4),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, NUM_CHANNELS),
                                        jnp.float32),
         grid=(n // block_rows,),
         in_specs=[
             pl.BlockSpec((F, block_rows), lambda i: (0, i)),
             pl.BlockSpec((NUM_CHANNELS, block_rows), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((F * num_bins, NUM_CHANNELS),
+        out_specs=pl.BlockSpec((F_log * num_bins, NUM_CHANNELS),
                                lambda i: (0, 0)),
-        scratch_shapes=[pltpu.VMEM((F * num_bins, NUM_CHANNELS),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, NUM_CHANNELS),
                                    jnp.float32)],
         interpret=interpret,
     )(binsT, w8)
-    return out.reshape(F, num_bins, NUM_CHANNELS)
+    return out.reshape(F_log, num_bins, NUM_CHANNELS)
 
 
 def _segment_buckets(max_blocks: int) -> list:
@@ -268,15 +284,17 @@ def _segment_buckets(max_blocks: int) -> list:
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "block_rows", "grid_blocks",
-                                    "interpret"))
+                                    "interpret", "packed4"))
 def _histogram_segment_fixed(binsT: jax.Array, w8: jax.Array,
                              leaf_id: jax.Array, start_block: jax.Array,
                              n_blocks: jax.Array, target_leaf: jax.Array,
                              num_bins: int, block_rows: int,
                              grid_blocks: int,
-                             interpret: bool | None = None) -> jax.Array:
+                             interpret: bool | None = None,
+                             packed4: bool = False) -> jax.Array:
     """One static-grid variant; grid_blocks must be >= n_blocks."""
     F, n = binsT.shape
+    F_log = 2 * F if packed4 else F
     if interpret is None:
         interpret = _interpret_default()
     max_blocks = n // block_rows
@@ -296,44 +314,48 @@ def _histogram_segment_fixed(binsT: jax.Array, w8: jax.Array,
             pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
             pl.BlockSpec((1, block_rows), im_data),
         ],
-        out_specs=pl.BlockSpec((F * num_bins, NUM_CHANNELS),
+        out_specs=pl.BlockSpec((F_log * num_bins, NUM_CHANNELS),
                                lambda i, s: (0, 0)),
-        scratch_shapes=[pltpu.VMEM((F * num_bins, NUM_CHANNELS),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, NUM_CHANNELS),
                                    jnp.float32)],
     )
     out = pl.pallas_call(
-        _kernel_segment,
-        out_shape=jax.ShapeDtypeStruct((F * num_bins, NUM_CHANNELS),
+        functools.partial(_kernel_segment, num_bins=num_bins,
+                          packed4=packed4),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, NUM_CHANNELS),
                                        jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
     )(scalars, binsT, w8, leaf_id.reshape(1, -1))
-    return out.reshape(F, num_bins, NUM_CHANNELS)
+    return out.reshape(F_log, num_bins, NUM_CHANNELS)
 
 
 def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
                       start_block: jax.Array, n_blocks: jax.Array,
                       target_leaf: jax.Array, num_bins: int,
                       block_rows: int = 0,
-                      interpret: bool | None = None) -> jax.Array:
+                      interpret: bool | None = None,
+                      packed4: bool = False) -> jax.Array:
     """Histogram of one leaf, scanning only its confinement blocks.
 
     ``leaf_id`` is [Npad] i32 row->leaf; rows outside the leaf (or padding,
     which must carry zero weights) contribute nothing.  DMA, compute AND
     grid length are proportional to ``n_blocks``, not N: the call
     dispatches to the smallest static-grid variant covering the interval
-    (``_segment_buckets``).  Returns [F, B, 8].
+    (``_segment_buckets``).  Returns [F, B, 8] (logical features when
+    ``packed4``).
     """
     F, n = binsT.shape
     if block_rows <= 0:
-        block_rows = pick_block_rows(F, num_bins)
+        block_rows = pick_block_rows(2 * F if packed4 else F, num_bins)
     assert n % block_rows == 0, (n, block_rows)
     max_blocks = n // block_rows
     buckets = _segment_buckets(max_blocks)
     if len(buckets) == 1:
         return _histogram_segment_fixed(binsT, w8, leaf_id, start_block,
                                         n_blocks, target_leaf, num_bins,
-                                        block_rows, buckets[0], interpret)
+                                        block_rows, buckets[0], interpret,
+                                        packed4)
     n_blocks = jnp.asarray(n_blocks, jnp.int32)
     # smallest bucket >= n_blocks
     idx = jnp.sum(jnp.asarray(buckets, jnp.int32)[None, :]
@@ -341,7 +363,8 @@ def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
         jnp.sum(jnp.asarray(buckets, jnp.int32) < n_blocks)
     branches = [
         (lambda gb: lambda b, w, l, s0, nb, tl: _histogram_segment_fixed(
-            b, w, l, s0, nb, tl, num_bins, block_rows, gb, interpret))(gb)
+            b, w, l, s0, nb, tl, num_bins, block_rows, gb, interpret,
+            packed4))(gb)
         for gb in buckets
     ]
     return jax.lax.switch(idx, branches, binsT, w8, leaf_id, start_block,
@@ -350,8 +373,23 @@ def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
 
 def leaf_histogram_pallas(binsT: jax.Array, grad: jax.Array,
                           hess: jax.Array, member: jax.Array,
-                          num_bins: int, block_rows: int = 0) -> jax.Array:
+                          num_bins: int, block_rows: int = 0,
+                          packed4: bool = False) -> jax.Array:
     """Drop-in [F, B, 3] leaf histogram matching ops.histogram semantics,
     computed with the full-data pallas kernel."""
     w8 = pack_channels(grad, hess, member)
-    return unpack_hist(histogram_all(binsT, w8, num_bins, block_rows))
+    return unpack_hist(histogram_all(binsT, w8, num_bins, block_rows,
+                                     packed4=packed4))
+
+
+def pack_bins_4bit(binsT):
+    """[F, N] u8 (bins <= 15) -> [ceil(F/2), N] u8 with feature 2i in the
+    low nibble and 2i+1 in the high (Dense4bitsBin::Push layout idea,
+    dense_nbits_bin.hpp:96, re-cut for the feature-major TPU stream)."""
+    import numpy as np
+    binsT = np.asarray(binsT)
+    F = binsT.shape[0]
+    if F % 2:
+        binsT = np.concatenate(
+            [binsT, np.zeros((1, binsT.shape[1]), binsT.dtype)])
+    return (binsT[0::2] | (binsT[1::2] << 4)).astype(np.uint8)

@@ -99,9 +99,14 @@ def binning_world() -> tuple:
         return _injected["num_machines"], _injected["rank"]
     try:
         from jax._src import distributed
-        if distributed.global_state.client is None:
-            return 1, 0
-    except Exception:
+        client = distributed.global_state.client
+    except (ImportError, AttributeError):
+        # private-API drift: be LOUD, because silently reporting world=1
+        # on a real multi-process run would desynchronize bin mappers
+        log_warning("could not inspect jax.distributed state; assuming a "
+                    "single-process run for bin finding")
+        return 1, 0
+    if client is None:
         return 1, 0
     return jax.process_count(), jax.process_index()
 

@@ -79,6 +79,22 @@ def test_segment_parity_binary_compaction(rng):
     _assert_tree_parity(fused, seg, X)
 
 
+def test_segment_parity_packed4(rng):
+    """max_bin=15 activates the 4-bit packed layout (Dense4bitsBin
+    equivalent): two columns per byte, in-kernel nibble unpack.  The
+    grown trees must match the unpacked fused grower."""
+    n = 3000
+    X = rng.normal(size=(n, 7))
+    y = (X[:, 0] + 0.6 * X[:, 1] - 0.2 * X[:, 2] ** 2
+         + 0.1 * rng.normal(size=n) > 0).astype(np.float64)
+    fused, seg = _train_pair(X, y, rng, n_iters=3, objective="binary",
+                             num_leaves=31, max_bin=15, min_data_in_leaf=5)
+    assert seg.grower_params.packed4, "packed4 layout was not selected"
+    # physical bin rows = ceil(columns / 2)
+    assert seg.bins.shape[0] == -(-seg.train_set.num_columns // 2)
+    _assert_tree_parity(fused, seg, X)
+
+
 def test_segment_parity_missing_nan(rng):
     n = 2000
     X = rng.normal(size=(n, 5))

@@ -56,6 +56,25 @@ def test_histogram_all_matches_reference(rng, n, f, b):
     assert np.abs(got - exp).max() < max(1e-6, scale * 3e-4)
 
 
+def test_histogram_all_packed4_matches_unpacked(rng):
+    from lightgbm_tpu.ops.pallas_histogram import pack_bins_4bit
+    n, f, b, rb = 1024, 6, 16, 256
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    m = np.ones(n, np.float32)
+    w8 = pack_channels(jnp.asarray(g), jnp.asarray(h), jnp.asarray(m))
+    plain = unpack_hist(histogram_all(jnp.asarray(bins.T.copy()), w8, b,
+                                      block_rows=rb, interpret=True))
+    packedT = pack_bins_4bit(bins.T)
+    assert packedT.shape == (f // 2, n)
+    packed = unpack_hist(histogram_all(jnp.asarray(packedT), w8, b,
+                                       block_rows=rb, interpret=True,
+                                       packed4=True))
+    np.testing.assert_allclose(np.asarray(packed)[:f], np.asarray(plain),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_histogram_segment_restricts_to_leaf(rng):
     n, f, b, rb = 1024, 4, 16, 256
     bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
