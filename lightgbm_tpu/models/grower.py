@@ -475,10 +475,8 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
                 # contiguous [1, N] stream — far cheaper than the strided
                 # row-major column gather
                 if p.packed4:
-                    byte = lax.dynamic_slice_in_dim(bins, col // 2, 1,
-                                                    axis=0)[0, :]
-                    byte = byte.astype(jnp.int32)
-                    fcol = jnp.where(col % 2 == 1, byte >> 4, byte & 15)
+                    from ..ops.pallas_histogram import slice_packed_column
+                    fcol = slice_packed_column(bins, col)
                 else:
                     fcol = lax.dynamic_slice_in_dim(bins, col, 1,
                                                     axis=0)[0, :]

@@ -44,18 +44,17 @@ from ..ops.split import (NEG_INF, FeatureMeta, best_split, expand_group_hist,
 from .grower import (CommHooks, GrowerParams, TreeArrays,
                      _node_feature_mask, mono_handoff, routed_left)
 
-# compact when the tree reaches these leaf counts (log-spaced: each epoch
-# roughly quarters the confinement intervals, so total scan waste stays
-# within ~2-3x of the ideal sum-of-leaf-sizes).  Overridable for perf
-# experiments via LIGHTGBM_TPU_COMPACT_AT="4,16,64".
+# Adaptive compaction: re-sort whenever the histogram kernels have scanned
+# more than COMPACT_WASTE x N rows of confinement intervals since the last
+# compaction.  Fixed leaf-count milestones (round 2) let waste balloon on
+# skewed trees — best-first growth keeps splitting inside one big segment,
+# so "compact at 4/16/64/256 leaves" could scan 30-40 N-equivalents per
+# tree; the amortized rule bounds scan waste at ~(1 + COMPACT_WASTE/2) x
+# ideal while the number of sorts stays <= total_scanned / (COMPACT_WASTE
+# x N).  Overridable via LIGHTGBM_TPU_COMPACT_WASTE (in N multiples).
 import os as _os
 
-_compact_env = _os.environ.get("LIGHTGBM_TPU_COMPACT_AT")
-if _compact_env is not None:
-    COMPACT_AT_LEAVES = tuple(
-        int(x) for x in _compact_env.split(",") if x.strip())
-else:
-    COMPACT_AT_LEAVES = (4, 16, 64, 256)
+COMPACT_WASTE = float(_os.environ.get("LIGHTGBM_TPU_COMPACT_WASTE", "2.0"))
 
 
 class _SegState(NamedTuple):
@@ -65,6 +64,11 @@ class _SegState(NamedTuple):
     leaf_id: jax.Array         # [Npad] i32 (permuted space)
     leaf_lo: jax.Array         # [L] i32 confinement start block
     leaf_hi: jax.Array         # [L] i32 confinement end block (exclusive)
+    # blocks scanned by histogram kernels since the last compaction /
+    # in total (adaptive-compaction accounting + perf introspection)
+    scanned_since: jax.Array   # i32 scalar
+    scanned_total: jax.Array   # i32 scalar
+    num_sorts: jax.Array       # i32 scalar
     num_leaves: jax.Array
     leaf_hist: jax.Array       # [L, F, B, 3]
     leaf_g: jax.Array
@@ -137,6 +141,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
     rb = block_rows
 
     def hist_leaf(st: _SegState, leaf, G_cols):
+        """Returns (hist [G,B,3], blocks scanned)."""
         lo = st.leaf_lo[leaf]
         n_blk = st.leaf_hi[leaf] - lo
         out = histogram_segment(st.binsT, st.w8, st.leaf_id, lo, n_blk,
@@ -144,7 +149,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         h = unpack_hist(out[:G_cols])
         if comm.reduce_hist is not None:
             h = comm.reduce_hist(h, None, None, None, None)
-        return h
+        return h, n_blk
 
     def _one_scan(hist, g, h, c, depth, fmeta, fmask, key, step,
                   lo, hi, feat_used):
@@ -230,7 +235,9 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         leaf_lo = jnp.where(ends > starts, starts // rb, 0)
         leaf_hi = jnp.where(ends > starts, -(-ends // rb), 0)
         return st._replace(binsT=binsT, w8=w8, order=order, leaf_id=lid,
-                           leaf_lo=leaf_lo, leaf_hi=leaf_hi)
+                           leaf_lo=leaf_lo, leaf_hi=leaf_hi,
+                           scanned_since=jnp.int32(0),
+                           num_sorts=st.num_sorts + 1)
 
     def grow(binsT, grad, hess, member, fmeta: FeatureMeta, feature_mask,
              key):
@@ -270,10 +277,8 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
 
             col = f if fmeta.feat_group is None else fmeta.feat_group[f]
             if p.packed4:
-                byte = lax.dynamic_slice_in_dim(st.binsT, col // 2, 1,
-                                                axis=0)[0, :].astype(
-                                                    jnp.int32)
-                fcol = jnp.where(col % 2 == 1, byte >> 4, byte & 15)
+                from ..ops.pallas_histogram import slice_packed_column
+                fcol = slice_packed_column(st.binsT, col)
             else:
                 fcol = lax.dynamic_slice_in_dim(st.binsT, col, 1,
                                                 axis=0)[0, :]
@@ -313,7 +318,9 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
 
             smaller_is_left = Cl <= Cr
             smaller = jnp.where(smaller_is_left, leaf, new_leaf)
-            hist_small = hist_leaf(st, smaller, G_cols)
+            hist_small, blk = hist_leaf(st, smaller, G_cols)
+            st = st._replace(scanned_since=st.scanned_since + blk,
+                             scanned_total=st.scanned_total + blk)
             hist_parent = st.leaf_hist[leaf]
             hist_large = hist_parent - hist_small
             hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
@@ -381,24 +388,18 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                 jnp.stack([2 * step, 2 * step + 1]))
             return st
 
-        # compaction milestones: the leaf count after step s is s+2 while
-        # growth continues, so "compact at c leaves" = end of step c-2.
-        # Traced as a cond inside ONE fori_loop body: the body and the
-        # compaction each compile once, vs once per milestone segment with
-        # unrolled loops (round 2's layout compiled ~5 copies; cutting the
-        # program size is most of the jit-time win).
-        milestone_steps = [c - 2 for c in COMPACT_AT_LEAVES
-                           if 2 <= c <= L - 1]
+        # adaptive compaction (module docstring): amortize the sort against
+        # the histogram DMA it saves.  Traced as a cond inside ONE
+        # fori_loop body so the body and the compaction each compile once.
+        limit_blocks = min(max(1, int(COMPACT_WASTE * max_blocks)),
+                           2**31 - 1)   # compared against an i32 counter
 
         def body(step, st: _SegState):
             can_split = jnp.max(st.best_gain) > 0.0
             st = lax.cond(can_split, lambda s: do_split(s, step),
                           lambda s: s, st)
-            if milestone_steps:
-                is_m = jnp.zeros((), bool)
-                for m in milestone_steps:
-                    is_m |= step == m
-                st = lax.cond(is_m, compact, lambda s: s, st)
+            st = lax.cond(st.scanned_since >= limit_blocks,
+                          compact, lambda s: s, st)
             return st
 
         neg = jnp.full(L, NEG_INF, dtype=jnp.float32)
@@ -430,6 +431,9 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                        .at[0].set(0),
             leaf_hi=jnp.zeros(L, dtype=jnp.int32)
                        .at[0].set(max_blocks),
+            scanned_since=jnp.int32(0),
+            scanned_total=jnp.int32(0),
+            num_sorts=jnp.int32(0),
             num_leaves=jnp.int32(1),
             leaf_hist=jnp.zeros((L, G_cols, B, 3), dtype=jnp.float32),
             leaf_g=zeros_l.at[0].set(G0),
@@ -451,11 +455,18 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             best_left_out=zeros_l, best_right_out=zeros_l,
             tree=tree0,
         )
-        root_hist = hist_leaf(st, jnp.int32(0), G_cols)
-        st = st._replace(leaf_hist=st.leaf_hist.at[0].set(root_hist))
+        root_hist, root_blk = hist_leaf(st, jnp.int32(0), G_cols)
+        st = st._replace(leaf_hist=st.leaf_hist.at[0].set(root_hist),
+                         scanned_since=root_blk, scanned_total=root_blk)
         st = scan_leaf(st, 0, root_hist, G0, H0, C0, jnp.int32(0), fmeta,
                        feature_mask, key, 2 * L)
         st = lax.fori_loop(0, L - 1, body, st)
+        if _os.environ.get("LIGHTGBM_TPU_SEG_STATS"):
+            jax.debug.print(
+                "seg stats: scanned {s} blocks ({x:.1f} N-equivalents), "
+                "{c} compactions",
+                s=st.scanned_total,
+                x=st.scanned_total / max_blocks, c=st.num_sorts)
         # leaf ids back in original row order
         leaf_id_orig = jnp.zeros(n, jnp.int32).at[st.order].set(st.leaf_id)
         return st.tree, leaf_id_orig

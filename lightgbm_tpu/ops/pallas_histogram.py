@@ -393,3 +393,12 @@ def pack_bins_4bit(binsT):
         binsT = np.concatenate(
             [binsT, np.zeros((1, binsT.shape[1]), binsT.dtype)])
     return (binsT[0::2] | (binsT[1::2] << 4)).astype(np.uint8)
+
+
+def slice_packed_column(binsT, col):
+    """One logical column [N] i32 out of a 4-bit packed feature-major
+    matrix (inverse of pack_bins_4bit for a single, possibly traced,
+    column index) — the single place that knows the nibble convention."""
+    byte = lax.dynamic_slice_in_dim(binsT, col // 2, 1,
+                                    axis=0)[0, :].astype(jnp.int32)
+    return jnp.where(col % 2 == 1, byte >> 4, byte & 15)

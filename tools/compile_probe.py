@@ -55,12 +55,12 @@ def main():
 
     if "seg_nocompact" in variants:
         import lightgbm_tpu.models.grower_seg as gs
-        saved = gs.COMPACT_AT_LEAVES
-        gs.COMPACT_AT_LEAVES = ()
+        saved = gs.COMPACT_WASTE
+        gs.COMPACT_WASTE = 1e9       # threshold never reached
         grow = gs.make_grow_tree_segment(B, params, RB)
         stage_time("segment grower (no compaction)", lambda: grow.lower(
             binsT, g, g, member, fmeta, fmask, key))
-        gs.COMPACT_AT_LEAVES = saved
+        gs.COMPACT_WASTE = saved
 
     if "fused" in variants:
         from lightgbm_tpu.models.grower import make_grow_tree
