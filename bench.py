@@ -35,7 +35,11 @@ TOTAL_ITERS_REF = 500
 BASELINE_500_ITERS_S_10M5 = 238.505  # reference CPU, 10.5M rows
 
 # (platform, rows, warmup, measured iters, subprocess timeout seconds)
+# primary tier = the REAL HIGGS row count (binned 10.5M x 28 is ~300MB,
+# HBM-trivial; benching 1M flattered vs_baseline by hiding the N-scaled
+# terms) with 1M as the TPU fallback tier for backend hiccups
 TIERS = [
+    ("tpu", 10_500_000, 2, 4, 2700),
     ("tpu", 1_000_000, 3, 12, 1800),
     ("cpu", 100_000, 1, 3, 1200),
     ("cpu", 10_000, 1, 2, 900),
@@ -127,10 +131,15 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
     backend = jax.default_backend()
     impl = ("segment" if getattr(booster, "_use_segment", False)
             else booster.grower_params.hist_backend)
+    # honest full-run accounting (round-2 verdict): a real 500-iter run
+    # pays binning + setup + compile once on top of the steady state
+    total_real = (t_bin + t_setup + t_warm
+                  + per_iter * (TOTAL_ITERS_REF - warmup))
     sys.stderr.write(
         f"bench phases [{backend}/{impl}, {n_rows} rows]: gen={t_gen:.1f}s "
         f"bin={t_bin:.1f}s setup={t_setup:.1f}s "
-        f"warmup({warmup})={t_warm:.1f}s per_iter={per_iter:.4f}s\n")
+        f"warmup({warmup})={t_warm:.1f}s per_iter={per_iter:.4f}s "
+        f"full_500_iter_incl_overheads={total_real:.1f}s\n")
     sys.stderr.write("bench " + GLOBAL_TIMER.summary() + "\n")
     print(RESULT_TAG + json.dumps(
         {"per_iter": per_iter, "rows": n_rows, "backend": backend,

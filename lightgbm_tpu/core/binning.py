@@ -46,8 +46,25 @@ def _double_equal_ordered(a: float, b: float) -> bool:
 def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
                     max_bin: int, total_cnt: int,
                     min_data_in_bin: int) -> List[float]:
-    """Greedy equal-frequency-ish bin upper bounds (bin.cpp:74-150)."""
+    """Greedy equal-frequency-ish bin upper bounds (bin.cpp:74-150).
+
+    Dispatches to the native implementation (src/native/fastbin.cpp —
+    this Python body is its spec and fallback); the interpreter loop over
+    ~200k distinct sample values per feature dominated single-core
+    dataset construction."""
     check(max_bin > 0, "max_bin must be positive")
+    from .native import greedy_find_bin_native
+    native = greedy_find_bin_native(distinct_values, counts, max_bin,
+                                    total_cnt, min_data_in_bin)
+    if native is not None:
+        return native
+    return _greedy_find_bin_py(distinct_values, counts, max_bin, total_cnt,
+                               min_data_in_bin)
+
+
+def _greedy_find_bin_py(distinct_values: np.ndarray, counts: np.ndarray,
+                        max_bin: int, total_cnt: int,
+                        min_data_in_bin: int) -> List[float]:
     num_distinct = len(distinct_values)
     bounds: List[float] = []
     if num_distinct <= max_bin:
@@ -139,33 +156,40 @@ def find_bin_with_zero_as_one_bin(distinct_values: np.ndarray, counts: np.ndarra
 
 
 def _distinct_with_zero(values_sorted: np.ndarray, zero_cnt: int):
-    """Distinct values/counts from a sorted sample, zero block spliced in at its
-    ordered position (bin.cpp:236-270).  Adjacent float-equal values merge,
-    keeping the larger value."""
-    distinct: List[float] = []
-    counts: List[int] = []
+    """Distinct values/counts from a sorted sample, zero block spliced in at
+    its ordered position (bin.cpp:236-270).  Adjacent float-equal values
+    merge, keeping the larger value.
+
+    Vectorized as run-length grouping: a new group starts wherever the
+    next value exceeds nextafter(previous) — the same chained adjacent
+    comparison the scalar loop made (the former Python loop cost ~0.7s
+    per feature at the 200k-row binning sample)."""
     n = len(values_sorted)
-    if n == 0 or (values_sorted[0] > 0.0 and zero_cnt > 0):
-        distinct.append(0.0)
-        counts.append(zero_cnt)
-    if n > 0:
-        distinct.append(float(values_sorted[0]))
-        counts.append(1)
-    for i in range(1, n):
-        prev, cur = float(values_sorted[i - 1]), float(values_sorted[i])
-        if not _double_equal_ordered(prev, cur):
-            if prev < 0.0 and cur > 0.0:
-                distinct.append(0.0)
-                counts.append(zero_cnt)
-            distinct.append(cur)
-            counts.append(1)
-        else:
-            distinct[-1] = cur  # keep the larger of float-equal values
-            counts[-1] += 1
-    if n > 0 and values_sorted[n - 1] < 0.0 and zero_cnt > 0:
-        distinct.append(0.0)
-        counts.append(zero_cnt)
-    return np.asarray(distinct, dtype=np.float64), np.asarray(counts, dtype=np.int64)
+    if n == 0:
+        return (np.asarray([0.0]), np.asarray([zero_cnt], dtype=np.int64))
+    v = np.asarray(values_sorted, dtype=np.float64)
+    boundary = v[1:] > np.nextafter(v[:-1], np.inf)
+    idx = np.flatnonzero(boundary) + 1
+    starts = np.concatenate([[0], idx]).astype(np.int64)
+    ends = np.concatenate([idx, [n]]).astype(np.int64)
+    dvals = v[ends - 1]                 # keep the larger of float-equals
+    dcnts = ends - starts
+    firsts = v[starts]
+    if v[0] > 0.0 and zero_cnt > 0:
+        dvals = np.concatenate([[0.0], dvals])
+        dcnts = np.concatenate([[zero_cnt], dcnts])
+    elif v[n - 1] < 0.0 and zero_cnt > 0:
+        dvals = np.concatenate([dvals, [0.0]])
+        dcnts = np.concatenate([dcnts, [zero_cnt]])
+    else:
+        # the scalar loop splices a zero block (even with count 0) at the
+        # unique negative->positive group boundary
+        pos = np.flatnonzero((dvals[:-1] < 0.0) & (firsts[1:] > 0.0))
+        if len(pos):
+            p = int(pos[0]) + 1
+            dvals = np.insert(dvals, p, 0.0)
+            dcnts = np.insert(dcnts, p, zero_cnt)
+    return dvals, dcnts.astype(np.int64)
 
 
 def _need_filter(cnt_in_bin: Sequence[int], total_cnt: int, filter_cnt: int,

@@ -38,7 +38,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.pallas_histogram import (NUM_CHANNELS, histogram_segment,
-                                    pack_channels, unpack_hist)
+                                    pack_channels, slice_packed_column,
+                                    unpack_hist)
 from ..ops.split import (NEG_INF, FeatureMeta, best_split, expand_group_hist,
                          reconstruct_feature_column)
 from .grower import (CommHooks, GrowerParams, TreeArrays,
@@ -277,7 +278,6 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
 
             col = f if fmeta.feat_group is None else fmeta.feat_group[f]
             if p.packed4:
-                from ..ops.pallas_histogram import slice_packed_column
                 fcol = slice_packed_column(st.binsT, col)
             else:
                 fcol = lax.dynamic_slice_in_dim(st.binsT, col, 1,

@@ -1,0 +1,28 @@
+/* SWIG interface for lib_lightgbm_tpu — the reference ships
+ * swig/lightgbmlib.i wrapping its c_api.h for JNI/mmlspark; this wraps
+ * the lightgbm_tpu C API (include/lightgbm_tpu/c_api.h) the same way:
+ * pointer/array helpers plus the raw LGBM_* entry points.  Target any
+ * SWIG language (-java for the mmlspark-style consumer, -python for the
+ * in-repo smoke test, tests/test_swig.py). */
+%module lightgbmlib
+%{
+#include "lightgbm_tpu/c_api.h"
+%}
+
+%include "stdint.i"
+%include "cpointer.i"
+%include "carrays.i"
+
+%pointer_functions(int, intp)
+%pointer_functions(long, longp)
+%pointer_functions(double, doublep)
+%pointer_functions(float, floatp)
+%pointer_functions(int64_t, int64_tp)
+%pointer_functions(void*, voidpp)
+
+%array_functions(double, doubleArray)
+%array_functions(float, floatArray)
+%array_functions(int, intArray)
+%array_functions(int64_t, int64Array)
+
+%include "lightgbm_tpu/c_api.h"
