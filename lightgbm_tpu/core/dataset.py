@@ -444,6 +444,39 @@ class TpuDataset:
             self._device_binned_T_key = (row_multiple, packed4)
         return self._device_binned_T
 
+    def check_align(self, other: "TpuDataset") -> None:
+        """Fatal unless ``other``'s bins align with this dataset's
+        (Dataset::CheckAlign / BinMapper::CheckAlign, dataset.h:301,
+        bin.h:86): binned routing on mismatched mappers is silently
+        wrong, so the mismatch must be an error."""
+        msg = ("Cannot use this dataset: its bin mappers differ from the "
+               "training data's (construct it with the training set as "
+               "reference)")
+        if other.bin_mappers is self.bin_mappers:
+            pass
+        elif other.num_total_features != self.num_total_features:
+            log_fatal(msg)
+        else:
+            for ma, mb in zip(self.bin_mappers, other.bin_mappers):
+                if (ma.num_bin != mb.num_bin
+                        or ma.bin_type != mb.bin_type
+                        or ma.missing_type != mb.missing_type
+                        # equal_nan: MISSING_NAN mappers end with a NaN bound
+                        or not np.array_equal(ma.bin_upper_bound,
+                                              mb.bin_upper_bound,
+                                              equal_nan=True)
+                        # categorical routing lives in the category->bin
+                        # map, not the (unused) numerical bounds
+                        or ma.bin_2_categorical != mb.bin_2_categorical):
+                    log_fatal(msg)
+        sb, ob = self.bundle, other.bundle
+        if (sb is None) != (ob is None) or (
+                sb is not None and ob is not sb
+                and (not np.array_equal(sb.feat_group, ob.feat_group)
+                     or not np.array_equal(sb.feat_offset, ob.feat_offset))):
+            log_fatal("Cannot use this dataset: its EFB column layout "
+                      "differs from the training data's")
+
     def create_valid(self, data, label: Optional[np.ndarray] = None,
                      **kwargs) -> "TpuDataset":
         if hasattr(data, "tocsr"):            # scipy sparse

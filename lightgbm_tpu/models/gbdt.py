@@ -195,6 +195,11 @@ class GBDT:
         return "onehot"
 
     def reset_train_data(self, train_set: TpuDataset) -> None:
+        if self.train_set is not None and self.train_set is not train_set:
+            # the reference CheckAligns on training-data reset too
+            # (gbdt.cpp:827); existing trees' bin-space thresholds would
+            # silently mis-route on differently-binned data
+            self.train_set.check_align(train_set)
         self.train_set = train_set
         self.num_data = train_set.num_data
         self.feature_names = list(train_set.feature_names)
@@ -366,6 +371,8 @@ class GBDT:
         self._obj_arrs = None
 
     def add_valid_data(self, name: str, valid_set: TpuDataset) -> None:
+        if self.train_set is not None:
+            self.train_set.check_align(valid_set)
         C = self.num_tree_per_iteration
         score = np.zeros((C, valid_set.num_data), dtype=np.float64)
         if valid_set.metadata.init_score is not None:

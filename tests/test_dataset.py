@@ -74,3 +74,25 @@ def test_binary_roundtrip(tmp_path, rng):
     assert ds2.max_num_bin == ds.max_num_bin
     assert [m.num_bin for m in ds2.bin_mappers] == \
            [m.num_bin for m in ds.bin_mappers]
+
+
+def test_check_align_rejects_foreign_valid(rng):
+    """Dataset::CheckAlign (dataset.h:301): a valid set built WITHOUT the
+    training reference must be rejected, not silently mis-routed."""
+    import pytest
+    from lightgbm_tpu.utils.log import LightGBMError
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objective import create_objective
+    from lightgbm_tpu.config import Config
+    X = rng.normal(size=(500, 5))
+    y = (X[:, 0] > 0).astype(np.float64)
+    cfg = Config(objective="binary", verbosity=-1)
+    train = TpuDataset.from_numpy(X, y, config=cfg)
+    obj = create_objective(cfg)
+    obj.init(train.metadata, train.num_data)
+    bst = GBDT(cfg, train, obj)
+    ok = train.create_valid(X[:100], y[:100])
+    bst.add_valid_data("ok", ok)            # aligned: accepted
+    foreign = TpuDataset.from_numpy(X[:100] * 1.7, y[:100], config=cfg)
+    with pytest.raises(LightGBMError):
+        bst.add_valid_data("bad", foreign)

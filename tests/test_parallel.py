@@ -124,3 +124,17 @@ def test_data_parallel_segment_binary_uneven(rng):
     p = bst.predict(X)
     ll = -np.mean(yb * np.log(p + 1e-9) + (1 - yb) * np.log(1 - p + 1e-9))
     assert ll < 0.6   # better than chance on a learnable target
+
+
+def test_data_parallel_segment_packed4(rng):
+    """Sharded segment grower with the 4-bit packed layout (max_bin<=15
+    activates packing; rows shard, packed columns replicate per shard)."""
+    X, y = make_data(rng, n=2600, f=7)
+    serial = _train(X, y, "serial", tpu_histogram_backend="pallas",
+                    tpu_tree_impl="segment", tpu_row_chunk=128, max_bin=15)
+    assert serial.gbdt.grower_params.packed4
+    data = _train(X, y, "data", tpu_histogram_backend="pallas",
+                  tpu_tree_impl="segment", tpu_row_chunk=128, max_bin=15)
+    assert data.gbdt._use_segment and data.gbdt.grower_params.packed4
+    np.testing.assert_allclose(serial.predict(X), data.predict(X),
+                               rtol=1e-3, atol=1e-4)
