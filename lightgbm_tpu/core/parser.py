@@ -85,14 +85,20 @@ def _parse_libsvm(lines: List[str]) -> np.ndarray:
 _CHUNK_ROWS = 200_000
 
 
-def _read_head(filename: str, max_bytes: int = 1 << 16) -> List[str]:
+def _read_head(filename: str, max_bytes: int = 1 << 16,
+               want_lines: int = 34) -> List[str]:
     """First lines of the file for format/width detection — the whole file
     is never read into Python strings (dataset_loader.cpp:741's streaming
     stance; the old readlines() path held ~2GB of str objects at 10M
-    rows)."""
+    rows).  The buffer grows until it holds ``want_lines`` complete lines
+    (very wide rows — thousands of features — exceed a fixed buffer)."""
     with open(filename) as fh:
         head = fh.read(max_bytes)
-        truncated = len(head) == max_bytes and fh.read(1)
+        truncated = len(head) == max_bytes
+        while truncated and head.count("\n") < want_lines:
+            more = fh.read(max_bytes)
+            head += more
+            truncated = len(more) == max_bytes
     lines = head.splitlines()
     # only a buffer-boundary cut makes the tail line incomplete; a short
     # file's last line is complete even without a trailing newline
@@ -388,20 +394,23 @@ def parse_file_to_matrix(filename: str, has_header: bool,
     the model's feature count are treated as label-free; LibSVM always
     carries a leading label.
     """
-    with open(filename) as fh:
-        lines = fh.readlines()
+    head = _read_head(filename)
     header_names = None
-    if has_header and lines:
-        sep = "\t" if "\t" in lines[0] else ","
-        header_names = lines[0].strip().split(sep)
-        lines = lines[1:]
-    fmt = _detect_format(lines[:32])
+    skip_rows = 0
+    if has_header and head:
+        sep = "\t" if "\t" in head[0] else ","
+        header_names = head[0].strip().split(sep)
+        head = head[1:]
+        skip_rows = 1
+    fmt = _detect_format(head[:32])
     if fmt == "libsvm":
+        with open(filename) as fh:
+            lines = fh.readlines()[skip_rows:]
         mat = _parse_libsvm(lines)
         label_col = 0
     else:
         sep = "\t" if fmt == "tsv" else ","
-        mat = _parse_dense(lines, sep)
+        mat = _read_dense_matrix(filename, sep, skip_rows)
         if mat.shape[1] == num_features:   # no label column present
             return mat, None
         label_col = (_column_index(label_column, header_names)

@@ -11,14 +11,16 @@ cut reduce volume, voting_parallel_tree_learner.cpp:170-380).
 Here each strategy is a set of collective hooks injected into the SAME fused
 grower and executed under ``shard_map`` over a 1-D ``machines`` mesh axis:
 
-  * data-parallel:    rows sharded; per-histogram ``lax.psum`` over ICI (the
-    runtime lowers the replicated-output psum to reduce-scatter +
-    all-gather, i.e. the reference's ReduceScatter-then-scan pattern but
-    compiler-scheduled); root stats psum.
-  * feature-parallel: data replicated; each shard strips the tree-level
-    feature mask to its modulo stripe, scans only those features, and the
-    per-leaf SplitInfos merge via all_gather + argmax on gain (the packed-
-    SplitInfo max-gain allreduce).
+  * data-parallel:    rows sharded; every leaf histogram ``psum_scatter``s
+    so each shard owns one contiguous COLUMN stripe (the reference's
+    ReduceScatter-then-scan §3.4 pattern), each shard scans only its
+    stripe, and the winning SplitInfo merges by max-gain all_gather.
+    Forced-split runs fall back to a full-histogram ``lax.psum`` (the
+    forced path reads the local leaf histogram without a merge).
+  * feature-parallel: data replicated; each shard histograms AND scans
+    only its contiguous column stripe, and the per-leaf SplitInfos merge
+    via all_gather + argmax on gain (the packed-SplitInfo max-gain
+    allreduce).
   * voting-parallel:  rows sharded; each shard votes its local top-k
     features by local best gain, votes are psum'd, and only the 2*top_k
     globally-elected features' histograms are reduced.
@@ -66,6 +68,17 @@ def _merge_split_by_gain(info: SplitInfo, gain, axis):
     return merged, gains[winner]
 
 
+def _stripe_feature_mask(fmask, axis, start, per, feat_group):
+    """Mask features whose physical COLUMN lies in [start, start+per) —
+    the one place that maps a shard's column stripe back to feature space
+    (identity column map when the dataset is unbundled)."""
+    col = (jnp.asarray(np.asarray(feat_group), dtype=jnp.int32)
+           if feat_group is not None
+           else jnp.arange(fmask.shape[0], dtype=jnp.int32))
+    stripe = (col >= start) & (col < start + per)
+    return fmask * stripe.astype(fmask.dtype)
+
+
 def _log_collective_estimate(mode: str, D: int, num_columns: int,
                              num_bins: int, num_leaves: int,
                              top_k: int = 0):
@@ -76,7 +89,8 @@ def _log_collective_estimate(mode: str, D: int, num_columns: int,
     from ..utils.log import log_info
     hist_bytes = num_columns * num_bins * 3 * 4
     per_split = {
-        "data": 2 * hist_bytes,            # psum (allreduce) of full hist
+        "data": hist_bytes,                # psum_scatter (reduce-scatter)
+        "data_allreduce": 2 * hist_bytes,  # full-hist psum fallback
         "data_segment": hist_bytes,        # psum_scatter (reduce-scatter)
         "voting": 2 * hist_bytes * min(1.0, 2 * top_k / max(num_columns, 1))
         + num_columns * 4,                 # elected slices + vote psum
@@ -105,9 +119,42 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
     repl = P()
 
     if mode in ("data", "data_parallel"):
-        comm = CommHooks(
-            reduce_hist=lambda h, G, H, C, f: lax.psum(h, axis),
-            reduce_stats=lambda x: lax.psum(x, axis))
+        # forced splits read the local leaf histogram without a merge, so
+        # they need the full-histogram psum variant, not stripe ownership
+        if num_columns > 0 and not params.forced_plan:
+            # the reference's §3.4 pattern (data_parallel_tree_learner.cpp:
+            # 437-447): reduce-scatter so each shard owns one contiguous
+            # column stripe, scan only the stripe, merge the winning
+            # SplitInfo by max gain — half the wire bytes of an allreduce
+            # and no redundant scan work
+            G = num_columns
+            Gpad = -(-G // D) * D
+            per = Gpad // D
+
+            def reduce_hist(h, *_):
+                hp = jnp.pad(h, ((0, Gpad - G), (0, 0), (0, 0)))
+                mine = lax.psum_scatter(hp, axis, scatter_dimension=0,
+                                        tiled=True)
+                me = lax.axis_index(axis)
+                out = jnp.zeros_like(hp)
+                out = lax.dynamic_update_slice(out, mine, (me * per, 0, 0))
+                return out[:G]
+
+            def shard_mask(fmask):
+                return _stripe_feature_mask(
+                    fmask, axis, lax.axis_index(axis) * per, per,
+                    feat_group)
+
+            comm = CommHooks(
+                reduce_hist=reduce_hist,
+                reduce_stats=lambda x: lax.psum(x, axis),
+                merge_split=lambda info, gain: _merge_split_by_gain(
+                    info, gain, axis),
+                shard_feature_mask=shard_mask)
+        else:
+            comm = CommHooks(
+                reduce_hist=lambda h, G, H, C, f: lax.psum(h, axis),
+                reduce_stats=lambda x: lax.psum(x, axis))
         in_specs = (P(axis, None), P(axis), P(axis), P(axis), repl, repl,
                     repl)
         out_specs = (repl, P(axis))
@@ -131,12 +178,8 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
             return my_start(), per
 
         def shard_mask(fmask):
-            start = my_start()
-            col = (jnp.asarray(np.asarray(feat_group), dtype=jnp.int32)
-                   if feat_group is not None
-                   else jnp.arange(fmask.shape[0], dtype=jnp.int32))
-            stripe = (col >= start) & (col < start + per)
-            return fmask * stripe.astype(fmask.dtype)
+            return _stripe_feature_mask(fmask, axis, my_start(), per,
+                                        feat_group)
 
         comm = CommHooks(
             merge_split=lambda info, gain: _merge_split_by_gain(
@@ -193,9 +236,11 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
     def wrap(grow):
         return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
 
-    _log_collective_estimate(
-        mode.split("_")[0], D, num_columns or 0, num_bins,
-        params.num_leaves, top_k)
+    est_mode = mode.split("_")[0]
+    if est_mode == "data" and (num_columns <= 0 or params.forced_plan):
+        est_mode = "data_allreduce"        # the full-hist psum fallback
+    _log_collective_estimate(est_mode, D, num_columns or 0, num_bins,
+                             params.num_leaves, top_k)
     return make_grow_tree(num_bins, params, comm=comm, wrap=wrap)
 
 
@@ -239,12 +284,9 @@ def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
 
     def shard_mask(fmask):
         # a shard scans the features whose COLUMN lies in its stripe
-        me = lax.axis_index(axis)
-        col = (jnp.asarray(np.asarray(feat_group), dtype=jnp.int32)
-               if feat_group is not None
-               else jnp.arange(fmask.shape[0], dtype=jnp.int32))
-        stripe = (col >= me * per) & (col < (me + 1) * per)
-        return fmask * stripe.astype(fmask.dtype)
+        return _stripe_feature_mask(fmask, axis,
+                                    lax.axis_index(axis) * per, per,
+                                    feat_group)
 
     comm = CommHooks(
         reduce_hist=reduce_hist,
