@@ -79,16 +79,17 @@ def probe_tpu(attempts: int = 2) -> bool:
 def run_tier_child(platform: str, n_rows: int, warmup: int,
                    measure: int) -> None:
     """Executed inside the tier subprocess; prints a tagged JSON result."""
+    import jax
     if platform == "cpu":
-        import jax
         jax.config.update("jax_platforms", "cpu")
+    from lightgbm_tpu.utils import enable_jax_compilation_cache
+    enable_jax_compilation_cache(os.path.dirname(os.path.abspath(__file__)))
 
     import numpy as np
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.core.dataset import TpuDataset
     from lightgbm_tpu.models.gbdt import GBDT
     from lightgbm_tpu.objective import create_objective
-    import jax
 
     rng = np.random.RandomState(42)
     t0 = time.time()

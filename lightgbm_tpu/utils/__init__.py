@@ -28,3 +28,22 @@ def cpu_subprocess_env(n_virtual_devices: int = 0) -> dict:
                  f"{n_virtual_devices}").strip()
     env["XLA_FLAGS"] = flags
     return env
+
+
+def enable_jax_compilation_cache(repo_root: str | None = None) -> None:
+    """Persistent executable cache: the ~3min remote TPU compile amortizes
+    across bench/probe runs instead of recurring (the driver's bench and
+    the perf tools share one cache under <repo>/.jax_cache)."""
+    import os
+
+    import jax
+    if repo_root is None:
+        # utils/ -> lightgbm_tpu/ -> repo root
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(repo_root, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:  # noqa: BLE001 — the cache is an optimization only
+        pass

@@ -19,6 +19,8 @@ K = 9
 
 def main():
     import jax
+    from lightgbm_tpu.utils import enable_jax_compilation_cache
+    enable_jax_compilation_cache()
     import jax.numpy as jnp
     from jax import lax
     from lightgbm_tpu.ops.pallas_histogram import (

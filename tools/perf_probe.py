@@ -16,6 +16,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def run(n_rows: int, num_leaves: int, warmup: int, measure: int) -> None:
     import jax
+    from lightgbm_tpu.utils import enable_jax_compilation_cache
+    enable_jax_compilation_cache()
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.core.dataset import TpuDataset
     from lightgbm_tpu.models.gbdt import GBDT

@@ -16,6 +16,8 @@ K = 5
 
 def main():
     import jax
+    from lightgbm_tpu.utils import enable_jax_compilation_cache
+    enable_jax_compilation_cache()
     import jax.numpy as jnp
     from jax import lax
     from lightgbm_tpu.ops.pallas_histogram import pack_channels, \
