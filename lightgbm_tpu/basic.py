@@ -299,9 +299,19 @@ class Booster:
     # ------------------------------------------------------------ training
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting iteration; returns True when no further splits are
-        possible (LGBM_BoosterUpdateOneIter, c_api.cpp:1143)."""
-        if train_set is not None:
-            raise LightGBMError("reset training data not yet supported")
+        possible (LGBM_BoosterUpdateOneIter, c_api.cpp:1143).  A new
+        ``train_set`` swaps the training data first
+        (LGBM_BoosterResetTrainingData; bins must align)."""
+        if train_set is not None and train_set is not self.train_set:
+            train_set.construct()
+            # alignment is checked inside reset_train_data; the objective
+            # and metrics re-bind only after it succeeds (atomic swap)
+            self.gbdt.reset_train_data(train_set._handle)
+            if self.objective is not None:
+                h = train_set._handle
+                self.objective.init(h.metadata, h.num_data)
+            self.train_set = train_set
+            self._setup_metrics()
         if fobj is not None:
             score = self.gbdt.train_score
             grad, hess = fobj(np.asarray(score).ravel(), self.train_set)

@@ -420,9 +420,11 @@ def booster_reset_training_data(hid: int, train_id: int) -> None:
     bst = _get(hid)
     train = _resolve_dataset(train_id)
     train.construct()
+    # alignment is checked inside reset_train_data; bind the objective only
+    # after it succeeds so a rejected swap leaves the booster untouched
+    bst.gbdt.reset_train_data(train._handle)
     if bst.objective is not None:
         bst.objective.init(train._handle.metadata, train._handle.num_data)
-    bst.gbdt.reset_train_data(train._handle)
     bst.train_set = train
 
 

@@ -360,11 +360,26 @@ class GBDT:
             init = np.asarray(train_set.metadata.init_score, dtype=np.float32)
             self.train_score = jnp.asarray(
                 init.reshape(C, self.num_data))
+        if self.iter_ > 0:
+            # mid-boosting swap (GBDT::ResetTrainingData): the score buffer
+            # must equal the existing model's raw prediction on the NEW
+            # rows, or the next iteration boosts against a zero model
+            infos = train_set.feature_infos()
+            score = np.zeros((C, self.num_data), dtype=np.float64)
+            for it in range(self.iter_):
+                for k in range(C):
+                    score[k] += self.models[it * C + k].predict_binned(
+                        train_set.binned, infos)
+            for k in range(C):
+                score[k] += self.init_scores[k]
+            self.train_score = jnp.asarray(score, dtype=jnp.float32)
         self._bag_rng = np.random.RandomState(cfg.bagging_seed)
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._key = jax.random.PRNGKey(cfg.seed)
         self.bag_weight = jnp.ones(self.num_data, dtype=jnp.float32)
-        self._boosted_from_average = False
+        # init scores are already folded into a replayed buffer; re-running
+        # boost-from-average would shift every valid score a second time
+        self._boosted_from_average = self.iter_ > 0
         self._full_fmask = jnp.ones(train_set.num_used_features,
                                     dtype=jnp.float32)
         self._fused_fns = None

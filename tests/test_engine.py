@@ -291,3 +291,32 @@ def test_predict_leaf_index(rng):
     assert leaves.shape == (500, 5)
     assert leaves.max() < 7
     assert leaves.min() >= 0
+
+
+def test_update_with_new_train_set(rng):
+    """Booster.update(train_set=...) swaps training data mid-boosting
+    (LGBM_BoosterResetTrainingData; aligned bins required)."""
+    X, y = make_binary(rng)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15}
+    ds = lgb.Dataset(X, y, params=params)
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(3):
+        bst.update()
+    # aligned swap: same bins via reference
+    ds2 = lgb.Dataset(X[:1200], y[:1200], reference=ds, params=params)
+    bst.update(train_set=ds2)
+    assert bst.gbdt.num_data == 1200
+    # the swapped score buffer must equal the model's raw prediction on
+    # the new rows (GBDT::ResetTrainingData replays existing trees)
+    np.testing.assert_allclose(
+        np.asarray(bst.gbdt.train_score)[0],
+        bst.predict(X[:1200], raw_score=True), rtol=1e-4, atol=1e-5)
+    pred = bst.predict(X)
+    assert np.mean((pred > 0.5) == y) > 0.85
+    # misaligned swap is rejected ATOMICALLY: booster still trains after
+    bad = lgb.Dataset(X * 1.7, y, params=params)
+    with pytest.raises(lgb.LightGBMError):
+        bst.update(train_set=bad)
+    assert bst.gbdt.num_data == 1200
+    bst.update()
+    assert bst.num_trees() == 5
