@@ -426,6 +426,8 @@ def booster_reset_training_data(hid: int, train_id: int) -> None:
     if bst.objective is not None:
         bst.objective.init(train._handle.metadata, train._handle.num_data)
     bst.train_set = train
+    # metrics must re-bind to the new labels/num_data
+    bst._setup_metrics()
 
 
 def booster_reset_parameter(hid: int, parameters: str) -> None:

@@ -200,6 +200,10 @@ class GBDT:
             # (gbdt.cpp:827); existing trees' bin-space thresholds would
             # silently mis-route on differently-binned data
             self.train_set.check_align(train_set)
+            # settle async-pipeline trees against the OLD score buffers
+            # before they are replaced (the flush may rollback a stopped
+            # iteration, which must not touch the new buffers)
+            self._flush_pending()
         self.train_set = train_set
         self.num_data = train_set.num_data
         self.feature_names = list(train_set.feature_names)
@@ -364,19 +368,14 @@ class GBDT:
             # mid-boosting swap (GBDT::ResetTrainingData): the score buffer
             # must equal the existing model's raw prediction on the NEW
             # rows, or the next iteration boosts against a zero model
-            infos = train_set.feature_infos()
-            score = np.zeros((C, self.num_data), dtype=np.float64)
-            for it in range(self.iter_):
-                for k in range(C):
-                    score[k] += self.models[it * C + k].predict_binned(
-                        train_set.binned, infos)
-            for k in range(C):
-                score[k] += self.init_scores[k]
-            self.train_score = jnp.asarray(score, dtype=jnp.float32)
+            self.train_score = jnp.asarray(
+                self._replay_model_scores(train_set), dtype=jnp.float32)
         self._bag_rng = np.random.RandomState(cfg.bagging_seed)
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._key = jax.random.PRNGKey(cfg.seed)
         self.bag_weight = jnp.ones(self.num_data, dtype=jnp.float32)
+        # a stopped model may find splits again on fresh data
+        self._stop_flag = False
         # init scores are already folded into a replayed buffer; re-running
         # boost-from-average would shift every valid score a second time
         self._boosted_from_average = self.iter_ > 0
@@ -385,22 +384,39 @@ class GBDT:
         self._fused_fns = None
         self._obj_arrs = None
 
+    def _replay_model_scores(self, dataset: TpuDataset) -> np.ndarray:
+        """[C, N] f64 raw scores of the current model on ``dataset``: the
+        dataset's per-row init scores (else zeros), every existing tree
+        replayed over its binned rows, plus the scalar boost-from-average
+        inits (gbdt.cpp AddValidDataset / ResetTrainingData).  Trees loaded
+        from a model file are bin-remapped first."""
+        C = self.num_tree_per_iteration
+        models = self.models                 # flushes the async pipeline
+        n_iter = self.iter_
+        score = np.zeros((C, dataset.num_data), dtype=np.float64)
+        if dataset.metadata.init_score is not None:
+            score = np.asarray(dataset.metadata.init_score,
+                               dtype=np.float64).reshape(
+                                   C, dataset.num_data).copy()
+        infos = dataset.feature_infos()
+        for it in range(n_iter):
+            for k in range(C):
+                tree = models[it * C + k]
+                if not tree.bins_aligned:
+                    from .serialization import _remap_tree_to_bins
+                    tree = _remap_tree_to_bins(tree, dataset)
+                    models[it * C + k] = tree
+                score[k] += tree.predict_binned(dataset.binned, infos)
+        for k in range(C):
+            score[k] += self.init_scores[k]
+        return score
+
     def add_valid_data(self, name: str, valid_set: TpuDataset) -> None:
         if self.train_set is not None:
             self.train_set.check_align(valid_set)
-        C = self.num_tree_per_iteration
-        score = np.zeros((C, valid_set.num_data), dtype=np.float64)
-        if valid_set.metadata.init_score is not None:
-            score = np.asarray(valid_set.metadata.init_score,
-                               dtype=np.float64).reshape(C, valid_set.num_data)
-        # replay existing trees (continued training, gbdt.cpp AddValidDataset)
-        infos = self.train_set.feature_infos() if self.train_set else None
-        for it in range(self.iter_):
-            for k in range(C):
-                tree = self.models[it * C + k]
-                score[k] += tree.predict_binned(valid_set.binned, infos)
-        for k in range(C):
-            score[k] += self.init_scores[k]
+        # replay existing trees (continued training, gbdt.cpp
+        # AddValidDataset)
+        score = self._replay_model_scores(valid_set)
         self.valid_sets.append((name, valid_set))
         self.valid_scores.append(score)
 

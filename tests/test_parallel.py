@@ -138,3 +138,23 @@ def test_data_parallel_segment_packed4(rng):
     assert data.gbdt._use_segment and data.gbdt.grower_params.packed4
     np.testing.assert_allclose(serial.predict(X), data.predict(X),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_voting_parallel_with_bundling(rng):
+    """Voting election over an EFB-bundled dataset: votes are cast in
+    feature space on locally-expanded histograms, reduced in column
+    space (learners.reduce_voted)."""
+    n, width, blocks = 2400, 10, 6
+    X = np.zeros((n, width * blocks))
+    picks = rng.randint(0, width, size=(n, blocks))
+    for b in range(blocks):
+        X[np.arange(n), b * width + picks[:, b]] = rng.normal(2, 1, n)
+    yb = (X[:, :width].sum(1) - X[:, width:2 * width].sum(1) > 0).astype(float)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+              "tree_learner": "voting", "min_data_in_leaf": 5, "top_k": 8}
+    ds = lgb.Dataset(X, yb, params=params)
+    bst = lgb.train(params, ds, num_boost_round=15, verbose_eval=False)
+    assert ds._handle.bundle is not None
+    p = bst.predict(X)
+    ll = -np.mean(yb * np.log(p + 1e-9) + (1 - yb) * np.log(1 - p + 1e-9))
+    assert ll < 0.55
