@@ -359,17 +359,20 @@ class GBDT:
         else:
             self._grow_fn = make_grow_tree(self.num_bins, self.grower_params)
         C = self.num_tree_per_iteration
-        self.train_score = jnp.zeros((C, self.num_data), dtype=jnp.float32)
-        if train_set.metadata.init_score is not None:
-            init = np.asarray(train_set.metadata.init_score, dtype=np.float32)
-            self.train_score = jnp.asarray(
-                init.reshape(C, self.num_data))
         if self.iter_ > 0:
             # mid-boosting swap (GBDT::ResetTrainingData): the score buffer
             # must equal the existing model's raw prediction on the NEW
-            # rows, or the next iteration boosts against a zero model
+            # rows (per-row init scores folded in by the replay), or the
+            # next iteration boosts against a zero model
             self.train_score = jnp.asarray(
                 self._replay_model_scores(train_set), dtype=jnp.float32)
+        elif train_set.metadata.init_score is not None:
+            init = np.asarray(train_set.metadata.init_score, dtype=np.float32)
+            self.train_score = jnp.asarray(
+                init.reshape(C, self.num_data))
+        else:
+            self.train_score = jnp.zeros((C, self.num_data),
+                                         dtype=jnp.float32)
         self._bag_rng = np.random.RandomState(cfg.bagging_seed)
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._key = jax.random.PRNGKey(cfg.seed)
@@ -405,7 +408,12 @@ class GBDT:
                 if not tree.bins_aligned:
                     from .serialization import _remap_tree_to_bins
                     tree = _remap_tree_to_bins(tree, dataset)
-                    models[it * C + k] = tree
+                    # cache the remap ONLY against the training set (whose
+                    # alignment is enforced); persisting a remap against an
+                    # arbitrary valid set would silently re-route later
+                    # binned passes through that set's bins
+                    if dataset is self.train_set:
+                        models[it * C + k] = tree
                 score[k] += tree.predict_binned(dataset.binned, infos)
         for k in range(C):
             score[k] += self.init_scores[k]

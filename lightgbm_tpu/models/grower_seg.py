@@ -78,17 +78,14 @@ class _SegState(NamedTuple):
     leaf_mono_lo: jax.Array    # [L] monotone output bounds
     leaf_mono_hi: jax.Array
     feat_used: jax.Array       # [F] CEGB coupled bookkeeping
-    best_gain: jax.Array
-    best_feature: jax.Array
-    best_threshold: jax.Array
-    best_default_left: jax.Array
-    best_is_cat: jax.Array
+    # best-split cache, PACKED so every scan writes 3 rows instead of 11
+    # scalar scatters (each in-loop dynamic-update-slice costs fixed
+    # overhead on TPU): f32 [L, 6] = (gain, left_g, left_h, left_c,
+    # left_out, right_out); i32 [L, 4] = (feature, threshold,
+    # default_left, is_cat); bitset [L, 8] u32
+    best_f32: jax.Array
+    best_i32: jax.Array
     best_cat_bitset: jax.Array
-    best_left_g: jax.Array
-    best_left_h: jax.Array
-    best_left_c: jax.Array
-    best_left_out: jax.Array
-    best_right_out: jax.Array
     tree: TreeArrays
 
 
@@ -175,23 +172,18 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         return info, gain
 
     def _write_scans(st: _SegState, leaf_idx, infos, gains):
-        """leaf_idx/gains [k], infos batched SplitInfo; one scatter each."""
+        """leaf_idx/gains [k], infos batched SplitInfo; 3 packed scatters."""
+        f32 = jnp.stack([gains, infos.left_g, infos.left_h, infos.left_c,
+                         infos.left_out, infos.right_out],
+                        axis=-1).astype(jnp.float32)
+        i32 = jnp.stack([infos.feature, infos.threshold,
+                         infos.default_left.astype(jnp.int32),
+                         infos.is_cat.astype(jnp.int32)], axis=-1)
         return st._replace(
-            best_gain=st.best_gain.at[leaf_idx].set(gains),
-            best_feature=st.best_feature.at[leaf_idx].set(infos.feature),
-            best_threshold=st.best_threshold.at[leaf_idx].set(
-                infos.threshold),
-            best_default_left=st.best_default_left.at[leaf_idx].set(
-                infos.default_left),
-            best_is_cat=st.best_is_cat.at[leaf_idx].set(infos.is_cat),
+            best_f32=st.best_f32.at[leaf_idx].set(f32),
+            best_i32=st.best_i32.at[leaf_idx].set(i32),
             best_cat_bitset=st.best_cat_bitset.at[leaf_idx].set(
                 infos.cat_bitset),
-            best_left_g=st.best_left_g.at[leaf_idx].set(infos.left_g),
-            best_left_h=st.best_left_h.at[leaf_idx].set(infos.left_h),
-            best_left_c=st.best_left_c.at[leaf_idx].set(infos.left_c),
-            best_left_out=st.best_left_out.at[leaf_idx].set(infos.left_out),
-            best_right_out=st.best_right_out.at[leaf_idx].set(
-                infos.right_out),
         )
 
     def scan_leaf(st: _SegState, leaf_idx, hist, g, h, c, depth, fmeta,
@@ -266,14 +258,16 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                           comm.reduce_stats(C0))
 
         def do_split(st: _SegState, step):
-            leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+            leaf = jnp.argmax(st.best_f32[:, 0]).astype(jnp.int32)
             new_leaf = st.num_leaves
             node = st.num_leaves - 1
 
-            f = st.best_feature[leaf]
-            t = st.best_threshold[leaf]
-            dl = st.best_default_left[leaf]
-            cat = st.best_is_cat[leaf]
+            bi = st.best_i32[leaf]
+            bf = st.best_f32[leaf]
+            f = bi[0]
+            t = bi[1]
+            dl = bi[2].astype(bool)
+            cat = bi[3].astype(bool)
             bitset = st.best_cat_bitset[leaf]
 
             col = f if fmeta.feat_group is None else fmeta.feat_group[f]
@@ -289,8 +283,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             in_leaf = st.leaf_id == leaf
             leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, st.leaf_id)
 
-            Gl, Hl, Cl = (st.best_left_g[leaf], st.best_left_h[leaf],
-                          st.best_left_c[leaf])
+            Gl, Hl, Cl = bf[1], bf[2], bf[3]
             Gp, Hp, Cp = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
             Gr, Hr, Cr = Gp - Gl, Hp - Hl, Cp - Cl
 
@@ -305,7 +298,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             if p.use_monotone:
                 lo_l, hi_l, lo_r, hi_r = mono_handoff(
                     st.leaf_mono_lo[leaf], st.leaf_mono_hi[leaf],
-                    st.best_left_out[leaf], st.best_right_out[leaf],
+                    bf[4], bf[5],
                     fmeta.monotone[f], cat)
                 st = st._replace(
                     leaf_mono_lo=st.leaf_mono_lo
@@ -344,8 +337,8 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             left_child = left_child.at[node].set(~leaf)
             right_child = right_child.at[node].set(~new_leaf)
 
-            out_l = st.best_left_out[leaf]
-            out_r = st.best_right_out[leaf]
+            out_l = bf[4]
+            out_r = bf[5]
             tree = tree._replace(
                 num_leaves=st.num_leaves + 1,
                 split_feature=tree.split_feature.at[node].set(f),
@@ -355,7 +348,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                 cat_bitset=tree.cat_bitset.at[node].set(bitset),
                 left_child=left_child,
                 right_child=right_child,
-                split_gain=tree.split_gain.at[node].set(st.best_gain[leaf]),
+                split_gain=tree.split_gain.at[node].set(bf[0]),
                 internal_value=tree.internal_value.at[node].set(
                     tree.leaf_value[leaf]),
                 internal_weight=tree.internal_weight.at[node].set(Hp),
@@ -395,7 +388,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                            2**31 - 1)   # compared against an i32 counter
 
         def body(step, st: _SegState):
-            can_split = jnp.max(st.best_gain) > 0.0
+            can_split = jnp.max(st.best_f32[:, 0]) > 0.0
             st = lax.cond(can_split, lambda s: do_split(s, step),
                           lambda s: s, st)
             st = lax.cond(st.scanned_since >= limit_blocks,
@@ -445,14 +438,11 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                        if (p.use_cegb_coupled
                            and fmeta.cegb_used0 is not None)
                        else jnp.zeros(F, dtype=jnp.float32)),
-            best_gain=neg,
-            best_feature=jnp.full(L, -1, dtype=jnp.int32),
-            best_threshold=jnp.zeros(L, dtype=jnp.int32),
-            best_default_left=jnp.zeros(L, dtype=bool),
-            best_is_cat=jnp.zeros(L, dtype=bool),
+            best_f32=jnp.zeros((L, 6), dtype=jnp.float32)
+                        .at[:, 0].set(neg),
+            best_i32=jnp.zeros((L, 4), dtype=jnp.int32)
+                        .at[:, 0].set(-1),
             best_cat_bitset=jnp.zeros((L, 8), dtype=jnp.uint32),
-            best_left_g=zeros_l, best_left_h=zeros_l, best_left_c=zeros_l,
-            best_left_out=zeros_l, best_right_out=zeros_l,
             tree=tree0,
         )
         root_hist, root_blk = hist_leaf(st, jnp.int32(0), G_cols)
