@@ -190,17 +190,13 @@ class _GrowState(NamedTuple):
     seen: jax.Array
     # per-leaf best-split cache (best_split_per_leaf_,
     # serial_tree_learner.h:153)
-    best_gain: jax.Array
-    best_feature: jax.Array
-    best_threshold: jax.Array
-    best_default_left: jax.Array
-    best_is_cat: jax.Array
-    best_cat_bitset: jax.Array     # [L, 8]
-    best_left_g: jax.Array
-    best_left_h: jax.Array
-    best_left_c: jax.Array
-    best_left_out: jax.Array
-    best_right_out: jax.Array
+    # best-split cache PACKED into 3 tensors so each scan writes 3 rows
+    # instead of 11 scalar scatters: f32 [L, 6] = (gain, left_g, left_h,
+    # left_c, left_out, right_out); i32 [L, 4] = (feature, threshold,
+    # default_left, is_cat); cat bitset [L, 8] u32
+    best_f32: jax.Array
+    best_i32: jax.Array
+    best_cat_bitset: jax.Array
     tree: TreeArrays
 
 
@@ -380,19 +376,15 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
                                 lo=lo, hi=hi, gain_adjust=adjust)
         if comm.merge_split is not None:
             info, gain = comm.merge_split(info, gain)
+        f32 = jnp.stack([gain, info.left_g, info.left_h, info.left_c,
+                         info.left_out, info.right_out]).astype(jnp.float32)
+        i32 = jnp.stack([info.feature, info.threshold,
+                         info.default_left.astype(jnp.int32),
+                         info.is_cat.astype(jnp.int32)])
         return st._replace(
-            best_gain=st.best_gain.at[leaf_idx].set(gain),
-            best_feature=st.best_feature.at[leaf_idx].set(info.feature),
-            best_threshold=st.best_threshold.at[leaf_idx].set(info.threshold),
-            best_default_left=st.best_default_left.at[leaf_idx].set(
-                info.default_left),
-            best_is_cat=st.best_is_cat.at[leaf_idx].set(info.is_cat),
+            best_f32=st.best_f32.at[leaf_idx].set(f32),
+            best_i32=st.best_i32.at[leaf_idx].set(i32),
             best_cat_bitset=st.best_cat_bitset.at[leaf_idx].set(info.cat_bitset),
-            best_left_g=st.best_left_g.at[leaf_idx].set(info.left_g),
-            best_left_h=st.best_left_h.at[leaf_idx].set(info.left_h),
-            best_left_c=st.best_left_c.at[leaf_idx].set(info.left_c),
-            best_left_out=st.best_left_out.at[leaf_idx].set(info.left_out),
-            best_right_out=st.best_right_out.at[leaf_idx].set(info.right_out),
         )
 
     def grow(bins, grad, hess, member, fmeta: FeatureMeta, feature_mask, key):
@@ -411,19 +403,20 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             node = st.num_leaves - 1
 
             if forced is None:
-                leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
-                f = st.best_feature[leaf]
-                t = st.best_threshold[leaf]
-                dl = st.best_default_left[leaf]
-                cat = st.best_is_cat[leaf]
+                leaf = jnp.argmax(st.best_f32[:, 0]).astype(jnp.int32)
+                bf = st.best_f32[leaf]
+                bi = st.best_i32[leaf]
+                f = bi[0]
+                t = bi[1]
+                dl = bi[2].astype(bool)
+                cat = bi[3].astype(bool)
                 bitset = st.best_cat_bitset[leaf]
-                Gl, Hl, Cl = (st.best_left_g[leaf], st.best_left_h[leaf],
-                              st.best_left_c[leaf])
+                Gl, Hl, Cl = bf[1], bf[2], bf[3]
                 Gp, Hp, Cp = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
                 Gr, Hr, Cr = Gp - Gl, Hp - Hl, Cp - Cl
-                out_l = st.best_left_out[leaf]
-                out_r = st.best_right_out[leaf]
-                gain = st.best_gain[leaf]
+                out_l = bf[4]
+                out_r = bf[5]
+                gain = bf[0]
             else:
                 # forced numerical split (ForceSplits,
                 # serial_tree_learner.cpp:642): stats from the leaf's
@@ -591,7 +584,7 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             return st
 
         def body(step, st: _GrowState):
-            can_split = jnp.max(st.best_gain) > 0.0
+            can_split = jnp.max(st.best_f32[:, 0]) > 0.0
             return lax.cond(can_split,
                             lambda s: do_split(s, step),
                             lambda s: s, st)
@@ -643,14 +636,11 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
             feat_used=used0,
             seen=jnp.zeros((F, n) if p.use_cegb_lazy else (1, 1),
                            dtype=jnp.int8),
-            best_gain=neg,
-            best_feature=jnp.full(L, -1, dtype=jnp.int32),
-            best_threshold=jnp.zeros(L, dtype=jnp.int32),
-            best_default_left=jnp.zeros(L, dtype=bool),
-            best_is_cat=jnp.zeros(L, dtype=bool),
+            best_f32=jnp.zeros((L, 6), dtype=jnp.float32)
+                        .at[:, 0].set(neg),
+            best_i32=jnp.zeros((L, 4), dtype=jnp.int32)
+                        .at[:, 0].set(-1),
             best_cat_bitset=jnp.zeros((L, 8), dtype=jnp.uint32),
-            best_left_g=zeros_l, best_left_h=zeros_l, best_left_c=zeros_l,
-            best_left_out=zeros_l, best_right_out=zeros_l,
             tree=tree0,
         )
         fmask_root = _node_feature_mask(feature_mask, key, 2 * L, p)
