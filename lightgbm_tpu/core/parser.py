@@ -165,6 +165,10 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
         head = head[1:]
         skip_rows = 1
 
+    if not head:
+        raise LightGBMError(
+            f"Data file {filename} contains no data rows"
+            + (" (only a header)" if config.header else ""))
     fmt = _detect_format(head[:32])
     log_info(f"Loading {filename} as {fmt}")
     sep = "\t" if fmt == "tsv" else ","
@@ -208,10 +212,22 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
 
     feat_cols, feat_names, cat_idx = resolve_cols(ncol)
 
+    ds = None
     if fmt != "libsvm" and config.two_round:
-        ds = _load_two_round(filename, sep, skip_rows, config, label_col,
-                             weight_col, group_col, feat_cols, feat_names,
-                             cat_idx, reference, t0, ncol, resolve_cols)
+        try:
+            ds = _load_two_round(filename, sep, skip_rows, config, label_col,
+                                 weight_col, group_col, feat_cols, feat_names,
+                                 cat_idx, reference, t0, ncol, resolve_cols)
+        except LightGBMError:
+            raise
+        except Exception as e:
+            # the streaming C tokenizer rejects ragged/odd dense files the
+            # one-round path handles via its pure-Python fallback — keep
+            # behavior consistent between the two modes for the same file
+            log_warning(f"two_round streaming parse failed ({e}); "
+                        f"falling back to one-round loading")
+            ds = None
+    if ds is not None:
         qids = ds._qids_tmp
         del ds._qids_tmp
     else:

@@ -19,6 +19,7 @@ import numpy as np
 from jax import lax
 
 from ..core.binning import MISSING_NAN, MISSING_ZERO
+from ..utils.log import LightGBMError
 
 
 class TreeStack(NamedTuple):
@@ -44,7 +45,7 @@ def stack_trees(trees: List, num_features: int = -1) -> TreeStack:
     T = len(trees)
     for i, t in enumerate(trees):
         if not getattr(t, "bins_aligned", True):
-            raise ValueError(
+            raise LightGBMError(
                 f"tree {i} was loaded from a model file and its bin "
                 f"thresholds are not aligned with any dataset; remap "
                 f"before binned prediction")

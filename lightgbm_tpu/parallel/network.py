@@ -21,7 +21,7 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
-from ..utils.log import log_info, log_warning
+from ..utils.log import LightGBMError, log_info, log_warning
 
 _mesh: Optional["jax.sharding.Mesh"] = None
 _injected: Optional[dict] = None
@@ -101,8 +101,23 @@ def binning_world() -> tuple:
         from jax._src import distributed
         client = distributed.global_state.client
     except (ImportError, AttributeError):
-        # private-API drift: be LOUD, because silently reporting world=1
-        # on a real multi-process run would desynchronize bin mappers
+        # private-API drift: silently reporting world=1 on a real
+        # multi-process run would desynchronize bin mappers across hosts,
+        # so if any multi-process launch marker is in the environment this
+        # is fatal, not a warning
+        import os
+        markers = [v for v in (
+            "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+            "SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE",
+        ) if os.environ.get(v)]
+        if markers:
+            raise LightGBMError(
+                "cannot determine the multi-process world for distributed "
+                "bin finding (jax distributed-state API unavailable) but "
+                f"multi-process launch markers are set ({markers}); "
+                "refusing to fit bin mappers per-host — use "
+                "network.init_with_functions to inject the topology")
         log_warning("could not inspect jax.distributed state; assuming a "
                     "single-process run for bin finding")
         return 1, 0
