@@ -1,0 +1,101 @@
+# Booster training / prediction / model IO over the C API (the role of
+# the reference R-package's lgb.Booster.R + lgb.train.R, redesigned:
+# plain lists + external pointers, errors via Rf_error from the shim).
+
+#' Train a lightgbm_tpu model
+#'
+#' @param params named list of training parameters (objective,
+#'   num_leaves, learning_rate, ...).
+#' @param data lgb.Dataset with the training data.
+#' @param nrounds number of boosting iterations.
+#' @param valids named list of lgb.Dataset objects to evaluate.
+#' @param verbose print evaluation results every `eval_freq` rounds.
+#' @param eval_freq evaluation print frequency.
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), verbose = 1L, eval_freq = 1L) {
+  stopifnot(inherits(data, "lgb.Dataset.tpu"))
+  pstr <- .params_to_string(params)
+  ptr <- .Call(LGBMTPU_BoosterCreate_R, data$ptr, pstr)
+  bst <- list(ptr = ptr, train_set = data, valids = valids)
+  class(bst) <- "lgb.Booster.tpu"
+  for (vd in valids) {
+    stopifnot(inherits(vd, "lgb.Dataset.tpu"))
+    .Call(LGBMTPU_BoosterAddValidData_R, ptr, vd$ptr)
+  }
+  eval_names <- NULL
+  for (i in seq_len(nrounds)) {
+    finished <- .Call(LGBMTPU_BoosterUpdateOneIter_R, ptr)
+    if (verbose > 0L && length(valids) > 0L &&
+        (i %% eval_freq == 0L)) {
+      if (is.null(eval_names)) {
+        eval_names <- .Call(LGBMTPU_BoosterGetEvalNames_R, ptr)
+      }
+      for (j in seq_along(valids)) {
+        ev <- .Call(LGBMTPU_BoosterGetEval_R, ptr, j)  # 1-based: valid_j
+        message(sprintf("[%d] %s: %s", i, names(valids)[j],
+                        paste(eval_names, signif(ev, 6),
+                              sep = "=", collapse = " ")))
+      }
+    }
+    if (isTRUE(finished)) {
+      break
+    }
+  }
+  bst
+}
+
+#' Predict with a trained model
+#'
+#' @param object lgb.Booster.tpu.
+#' @param newdata numeric matrix.
+#' @param rawscore return margins instead of transformed scores.
+#' @param predleaf return per-tree leaf indices.
+#' @param num_iteration number of iterations to use (-1 = all).
+predict.lgb.Booster.tpu <- function(object, newdata, rawscore = FALSE,
+                                    predleaf = FALSE,
+                                    num_iteration = -1L, ...) {
+  newdata <- as.matrix(newdata)
+  storage.mode(newdata) <- "double"
+  ptype <- 0L                      # C_API_PREDICT_NORMAL
+  if (isTRUE(rawscore)) ptype <- 1L
+  if (isTRUE(predleaf)) ptype <- 2L
+  out <- .Call(LGBMTPU_BoosterPredictForMat_R, object$ptr, newdata,
+               ptype, as.integer(num_iteration), "")
+  n <- nrow(newdata)
+  if (length(out) > n && length(out) %% n == 0L) {
+    # multiclass / leaf-index outputs come back row-major [n, k]
+    matrix(out, nrow = n, byrow = TRUE)
+  } else {
+    out
+  }
+}
+
+#' Save a model to the reference text format
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  stopifnot(inherits(booster, "lgb.Booster.tpu"))
+  .Call(LGBMTPU_BoosterSaveModel_R, booster$ptr,
+        as.integer(num_iteration), filename)
+  invisible(booster)
+}
+
+#' Load a model from a text model file
+lgb.load <- function(filename) {
+  ptr <- .Call(LGBMTPU_BoosterCreateFromModelfile_R, filename)
+  bst <- list(ptr = ptr)
+  class(bst) <- "lgb.Booster.tpu"
+  bst
+}
+
+#' Serialize a model to a string
+lgb.model.to.string <- function(booster, num_iteration = -1L) {
+  .Call(LGBMTPU_BoosterSaveModelToString_R, booster$ptr,
+        as.integer(num_iteration))
+}
+
+#' Evaluation results for a data index (0 = train, 1.. = valids)
+lgb.get.eval <- function(booster, data_idx = 0L) {
+  ev <- .Call(LGBMTPU_BoosterGetEval_R, booster$ptr,
+              as.integer(data_idx))
+  names(ev) <- .Call(LGBMTPU_BoosterGetEvalNames_R, booster$ptr)
+  ev
+}

@@ -1,0 +1,76 @@
+# Dataset construction over the C API (the role of the reference
+# R-package's lgb.Dataset.R, re-designed around external-pointer handles
+# with finalizers instead of handle-slot R6 objects).
+
+.params_to_string <- function(params) {
+  if (is.null(params) || length(params) == 0L) {
+    return("")
+  }
+  paste(vapply(names(params), function(k) {
+    v <- params[[k]]
+    paste0(k, "=", paste(as.character(v), collapse = ","))
+  }, character(1L)), collapse = " ")
+}
+
+#' Create a lightgbm_tpu Dataset
+#'
+#' @param data numeric matrix (rows = observations) or path to a data
+#'   file (CSV/TSV/LibSVM or a saved binary dataset).
+#' @param label numeric response vector (ignored for file input when the
+#'   file carries its own label column).
+#' @param params named list of dataset parameters (max_bin, ...).
+#' @param weight optional per-row weights.
+#' @param group optional query sizes for ranking.
+#' @param reference optional lgb.Dataset whose bin mappers to reuse
+#'   (validation data).
+lgb.Dataset <- function(data, label = NULL, params = list(),
+                        weight = NULL, group = NULL, reference = NULL) {
+  pstr <- .params_to_string(params)
+  ref_ptr <- if (is.null(reference)) {
+    NULL
+  } else {
+    stopifnot(inherits(reference, "lgb.Dataset.tpu"))
+    reference$ptr
+  }
+  if (is.character(data)) {
+    ptr <- .Call(LGBMTPU_DatasetCreateFromFile_R, data, pstr)
+  } else {
+    data <- as.matrix(data)
+    storage.mode(data) <- "double"
+    ptr <- .Call(LGBMTPU_DatasetCreateFromMat_R, data, pstr,
+                 ref_ptr)
+  }
+  ds <- list(ptr = ptr)
+  class(ds) <- "lgb.Dataset.tpu"
+  if (!is.null(label)) {
+    lgb.Dataset.set.field(ds, "label", label)
+  }
+  if (!is.null(weight)) {
+    lgb.Dataset.set.field(ds, "weight", weight)
+  }
+  if (!is.null(group)) {
+    lgb.Dataset.set.field(ds, "group", group)
+  }
+  if (!is.null(colnames(data))) {
+    .Call(LGBMTPU_DatasetSetFeatureNames_R, ds$ptr,
+          as.character(colnames(data)))
+  }
+  ds
+}
+
+#' Set a metadata field (label / weight / group / init_score)
+lgb.Dataset.set.field <- function(dataset, field, values) {
+  stopifnot(inherits(dataset, "lgb.Dataset.tpu"))
+  if (field %in% c("group", "query")) {
+    values <- as.integer(values)
+  } else {
+    values <- as.double(values)
+  }
+  .Call(LGBMTPU_DatasetSetField_R, dataset$ptr, field, values)
+  invisible(dataset)
+}
+
+dim.lgb.Dataset.tpu <- function(x) {
+  c(.Call(LGBMTPU_DatasetGetNumData_R, x$ptr),
+    .Call(LGBMTPU_DatasetGetNumFeature_R, x$ptr))
+}

@@ -1,0 +1,357 @@
+/*
+ * R binding for lightgbm_tpu — .Call entry points over the C API
+ * (include/lightgbm_tpu/c_api.h), the role the reference's
+ * src/lightgbm_R.cpp:627 plays for its R package.
+ *
+ * Design differs from the reference deliberately: handles are R external
+ * pointers with finalizers (no caller-managed handle SEXPs), errors
+ * surface through Rf_error straight from LGBM_GetLastError, and the
+ * surface is the subset the R front end in R/ actually drives.
+ */
+#include <stdlib.h>
+#include <string.h>
+
+#include <R.h>
+#include <Rinternals.h>
+#include <R_ext/Rdynload.h>
+
+#include "lightgbm_tpu/c_api.h"
+
+#define CHECK_CALL(x)                                      \
+  if ((x) != 0) {                                          \
+    Rf_error("lightgbm_tpu: %s", LGBM_GetLastError());     \
+  }
+
+static void* get_handle(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h == NULL) {
+    Rf_error("lightgbm_tpu: handle is NULL (already freed?)");
+  }
+  return h;
+}
+
+/* ---------- finalizers ---------- */
+
+static void dataset_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    LGBM_DatasetFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void booster_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    LGBM_BoosterFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static SEXP wrap_handle(void* h, void (*fin)(SEXP)) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, fin, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+/* ---------- error ---------- */
+
+SEXP LGBMTPU_GetLastError_R(void) {
+  return Rf_mkString(LGBM_GetLastError());
+}
+
+/* ---------- Dataset ---------- */
+
+SEXP LGBMTPU_DatasetCreateFromFile_R(SEXP filename, SEXP params) {
+  DatasetHandle h = NULL;
+  CHECK_CALL(LGBM_DatasetCreateFromFile(
+      CHAR(STRING_ELT(filename, 0)), CHAR(STRING_ELT(params, 0)), NULL,
+      &h));
+  return wrap_handle(h, dataset_finalizer);
+}
+
+SEXP LGBMTPU_DatasetCreateFromMat_R(SEXP mat, SEXP params, SEXP reference) {
+  SEXP dim = Rf_getAttrib(mat, R_DimSymbol);
+  if (dim == R_NilValue || Rf_length(dim) != 2) {
+    Rf_error("lightgbm_tpu: data must be a numeric matrix");
+  }
+  int nrow = INTEGER(dim)[0];
+  int ncol = INTEGER(dim)[1];
+  DatasetHandle ref =
+      Rf_isNull(reference) ? NULL : get_handle(reference);
+  DatasetHandle h = NULL;
+  /* R matrices are column-major doubles */
+  CHECK_CALL(LGBM_DatasetCreateFromMat(
+      REAL(mat), C_API_DTYPE_FLOAT64, nrow, ncol, 0,
+      CHAR(STRING_ELT(params, 0)), ref, &h));
+  return wrap_handle(h, dataset_finalizer);
+}
+
+SEXP LGBMTPU_DatasetSetField_R(SEXP handle, SEXP name, SEXP vec) {
+  const char* field = CHAR(STRING_ELT(name, 0));
+  int n = Rf_length(vec);
+  /* group/query boundaries are int32; everything else float32 */
+  if (strcmp(field, "group") == 0 || strcmp(field, "query") == 0) {
+    int* buf = (int*)R_alloc(n, sizeof(int));
+    for (int i = 0; i < n; ++i) buf[i] = INTEGER(vec)[i];
+    CHECK_CALL(LGBM_DatasetSetField(get_handle(handle), field, buf, n,
+                                    C_API_DTYPE_INT32));
+  } else {
+    float* buf = (float*)R_alloc(n, sizeof(float));
+    double* src = REAL(vec);
+    for (int i = 0; i < n; ++i) buf[i] = (float)src[i];
+    CHECK_CALL(LGBM_DatasetSetField(get_handle(handle), field, buf, n,
+                                    C_API_DTYPE_FLOAT32));
+  }
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_DatasetGetNumData_R(SEXP handle) {
+  int n = 0;
+  CHECK_CALL(LGBM_DatasetGetNumData(get_handle(handle), &n));
+  return Rf_ScalarInteger(n);
+}
+
+SEXP LGBMTPU_DatasetGetNumFeature_R(SEXP handle) {
+  int n = 0;
+  CHECK_CALL(LGBM_DatasetGetNumFeature(get_handle(handle), &n));
+  return Rf_ScalarInteger(n);
+}
+
+/* The C API's name getters strcpy into caller buffers with no length
+ * parameter (the reference contract, c_api.cpp:712), so the set path
+ * must enforce the bound the get path allocates. */
+#define LGBMTPU_MAX_NAME 4096
+
+SEXP LGBMTPU_DatasetSetFeatureNames_R(SEXP handle, SEXP names) {
+  int n = Rf_length(names);
+  const char** arr =
+      (const char**)R_alloc(n, sizeof(const char*));
+  for (int i = 0; i < n; ++i) {
+    const char* s = CHAR(STRING_ELT(names, i));
+    if (strlen(s) >= LGBMTPU_MAX_NAME) {
+      Rf_error("lightgbm_tpu: feature name %d exceeds %d characters",
+               i + 1, LGBMTPU_MAX_NAME - 1);
+    }
+    arr[i] = s;
+  }
+  CHECK_CALL(LGBM_DatasetSetFeatureNames(get_handle(handle), arr, n));
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_DatasetGetFeatureNames_R(SEXP handle) {
+  int n = 0;
+  CHECK_CALL(LGBM_DatasetGetNumFeature(get_handle(handle), &n));
+  char** buf = (char**)R_alloc(n, sizeof(char*));
+  for (int i = 0; i < n; ++i) {
+    buf[i] = (char*)R_alloc(LGBMTPU_MAX_NAME, 1);
+    buf[i][0] = '\0';
+  }
+  int got = 0;
+  CHECK_CALL(LGBM_DatasetGetFeatureNames(get_handle(handle), buf, &got));
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, got));
+  for (int i = 0; i < got; ++i) {
+    SET_STRING_ELT(out, i, Rf_mkChar(buf[i]));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMTPU_DatasetSaveBinary_R(SEXP handle, SEXP filename) {
+  CHECK_CALL(LGBM_DatasetSaveBinary(get_handle(handle),
+                                    CHAR(STRING_ELT(filename, 0))));
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_DatasetFree_R(SEXP handle) {
+  dataset_finalizer(handle);
+  return R_NilValue;
+}
+
+/* ---------- Booster ---------- */
+
+SEXP LGBMTPU_BoosterCreate_R(SEXP train, SEXP params) {
+  BoosterHandle h = NULL;
+  CHECK_CALL(LGBM_BoosterCreate(get_handle(train),
+                                CHAR(STRING_ELT(params, 0)), &h));
+  return wrap_handle(h, booster_finalizer);
+}
+
+SEXP LGBMTPU_BoosterCreateFromModelfile_R(SEXP filename) {
+  BoosterHandle h = NULL;
+  int iters = 0;
+  CHECK_CALL(LGBM_BoosterCreateFromModelfile(
+      CHAR(STRING_ELT(filename, 0)), &iters, &h));
+  SEXP ptr = PROTECT(wrap_handle(h, booster_finalizer));
+  Rf_setAttrib(ptr, Rf_install("num_iterations"),
+               Rf_ScalarInteger(iters));
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP LGBMTPU_BoosterLoadModelFromString_R(SEXP model_str) {
+  BoosterHandle h = NULL;
+  int iters = 0;
+  CHECK_CALL(LGBM_BoosterLoadModelFromString(
+      CHAR(STRING_ELT(model_str, 0)), &iters, &h));
+  return wrap_handle(h, booster_finalizer);
+}
+
+SEXP LGBMTPU_BoosterAddValidData_R(SEXP handle, SEXP valid) {
+  CHECK_CALL(LGBM_BoosterAddValidData(get_handle(handle),
+                                      get_handle(valid)));
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_BoosterResetParameter_R(SEXP handle, SEXP params) {
+  CHECK_CALL(LGBM_BoosterResetParameter(get_handle(handle),
+                                        CHAR(STRING_ELT(params, 0))));
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_BoosterUpdateOneIter_R(SEXP handle) {
+  int finished = 0;
+  CHECK_CALL(LGBM_BoosterUpdateOneIter(get_handle(handle), &finished));
+  return Rf_ScalarLogical(finished);
+}
+
+SEXP LGBMTPU_BoosterRollbackOneIter_R(SEXP handle) {
+  CHECK_CALL(LGBM_BoosterRollbackOneIter(get_handle(handle)));
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_BoosterGetCurrentIteration_R(SEXP handle) {
+  int it = 0;
+  CHECK_CALL(LGBM_BoosterGetCurrentIteration(get_handle(handle), &it));
+  return Rf_ScalarInteger(it);
+}
+
+SEXP LGBMTPU_BoosterGetNumClasses_R(SEXP handle) {
+  int n = 0;
+  CHECK_CALL(LGBM_BoosterGetNumClasses(get_handle(handle), &n));
+  return Rf_ScalarInteger(n);
+}
+
+SEXP LGBMTPU_BoosterGetEvalNames_R(SEXP handle) {
+  int n = 0;
+  CHECK_CALL(LGBM_BoosterGetEvalCounts(get_handle(handle), &n));
+  char** buf = (char**)R_alloc(n > 0 ? n : 1, sizeof(char*));
+  for (int i = 0; i < n; ++i) {
+    buf[i] = (char*)R_alloc(LGBMTPU_MAX_NAME, 1);
+    buf[i][0] = '\0';
+  }
+  int got = 0;
+  CHECK_CALL(LGBM_BoosterGetEvalNames(get_handle(handle), &got, buf));
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, got));
+  for (int i = 0; i < got; ++i) {
+    SET_STRING_ELT(out, i, Rf_mkChar(buf[i]));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMTPU_BoosterGetEval_R(SEXP handle, SEXP data_idx) {
+  int n = 0;
+  CHECK_CALL(LGBM_BoosterGetEvalCounts(get_handle(handle), &n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  int got = 0;
+  CHECK_CALL(LGBM_BoosterGetEval(get_handle(handle),
+                                 Rf_asInteger(data_idx), &got,
+                                 REAL(out)));
+  SEXP trimmed = out;
+  if (got != n) {
+    trimmed = PROTECT(Rf_allocVector(REALSXP, got));
+    memcpy(REAL(trimmed), REAL(out), got * sizeof(double));
+    UNPROTECT(2);
+    return trimmed;
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMTPU_BoosterPredictForMat_R(SEXP handle, SEXP mat,
+                                    SEXP predict_type,
+                                    SEXP num_iteration, SEXP params) {
+  SEXP dim = Rf_getAttrib(mat, R_DimSymbol);
+  if (dim == R_NilValue || Rf_length(dim) != 2) {
+    Rf_error("lightgbm_tpu: data must be a numeric matrix");
+  }
+  int nrow = INTEGER(dim)[0];
+  int ncol = INTEGER(dim)[1];
+  int ptype = Rf_asInteger(predict_type);
+  int niter = Rf_asInteger(num_iteration);
+  int64_t want = 0;
+  CHECK_CALL(LGBM_BoosterCalcNumPredict(get_handle(handle), nrow, ptype,
+                                        niter, &want));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)want));
+  int64_t got = 0;
+  CHECK_CALL(LGBM_BoosterPredictForMat(
+      get_handle(handle), REAL(mat), C_API_DTYPE_FLOAT64, nrow, ncol, 0,
+      ptype, niter, CHAR(STRING_ELT(params, 0)), &got, REAL(out)));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMTPU_BoosterSaveModel_R(SEXP handle, SEXP num_iteration,
+                                SEXP filename) {
+  CHECK_CALL(LGBM_BoosterSaveModel(get_handle(handle), 0,
+                                   Rf_asInteger(num_iteration),
+                                   CHAR(STRING_ELT(filename, 0))));
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_BoosterSaveModelToString_R(SEXP handle, SEXP num_iteration) {
+  int niter = Rf_asInteger(num_iteration);
+  int64_t len = 0;
+  /* first call sizes the buffer, second fills it */
+  CHECK_CALL(LGBM_BoosterSaveModelToString(get_handle(handle), 0, niter,
+                                           0, &len, NULL));
+  char* buf = (char*)R_alloc((size_t)len + 1, 1);
+  int64_t got = 0;
+  CHECK_CALL(LGBM_BoosterSaveModelToString(get_handle(handle), 0, niter,
+                                           len + 1, &got, buf));
+  return Rf_mkString(buf);
+}
+
+SEXP LGBMTPU_BoosterFree_R(SEXP handle) {
+  booster_finalizer(handle);
+  return R_NilValue;
+}
+
+/* ---------- registration ---------- */
+
+#define CALLDEF(name, n) {#name, (DL_FUNC)&name, n}
+
+static const R_CallMethodDef CallEntries[] = {
+    CALLDEF(LGBMTPU_GetLastError_R, 0),
+    CALLDEF(LGBMTPU_DatasetCreateFromFile_R, 2),
+    CALLDEF(LGBMTPU_DatasetCreateFromMat_R, 3),
+    CALLDEF(LGBMTPU_DatasetSetField_R, 3),
+    CALLDEF(LGBMTPU_DatasetGetNumData_R, 1),
+    CALLDEF(LGBMTPU_DatasetGetNumFeature_R, 1),
+    CALLDEF(LGBMTPU_DatasetSetFeatureNames_R, 2),
+    CALLDEF(LGBMTPU_DatasetGetFeatureNames_R, 1),
+    CALLDEF(LGBMTPU_DatasetSaveBinary_R, 2),
+    CALLDEF(LGBMTPU_DatasetFree_R, 1),
+    CALLDEF(LGBMTPU_BoosterCreate_R, 2),
+    CALLDEF(LGBMTPU_BoosterCreateFromModelfile_R, 1),
+    CALLDEF(LGBMTPU_BoosterLoadModelFromString_R, 1),
+    CALLDEF(LGBMTPU_BoosterAddValidData_R, 2),
+    CALLDEF(LGBMTPU_BoosterResetParameter_R, 2),
+    CALLDEF(LGBMTPU_BoosterUpdateOneIter_R, 1),
+    CALLDEF(LGBMTPU_BoosterRollbackOneIter_R, 1),
+    CALLDEF(LGBMTPU_BoosterGetCurrentIteration_R, 1),
+    CALLDEF(LGBMTPU_BoosterGetNumClasses_R, 1),
+    CALLDEF(LGBMTPU_BoosterGetEvalNames_R, 1),
+    CALLDEF(LGBMTPU_BoosterGetEval_R, 2),
+    CALLDEF(LGBMTPU_BoosterPredictForMat_R, 5),
+    CALLDEF(LGBMTPU_BoosterSaveModel_R, 3),
+    CALLDEF(LGBMTPU_BoosterSaveModelToString_R, 2),
+    CALLDEF(LGBMTPU_BoosterFree_R, 1),
+    {NULL, NULL, 0}};
+
+void R_init_lightgbm_tpu(DllInfo* dll) {
+  R_registerRoutines(dll, NULL, CallEntries, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}

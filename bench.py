@@ -194,9 +194,12 @@ def main():
             "unit": "s",
             "vs_baseline": round(total_500 / baseline, 3),
         }
-        if platform == "cpu":
-            # outage fallback: a single-core XLA run at toy row counts —
-            # NOT a TPU measurement, never comparable across rounds
+        if r["backend"] == "cpu":
+            # outage fallback: a single-core XLA run — NOT a TPU
+            # measurement, never comparable across rounds.  Keyed on the
+            # MEASURED backend, not the tier label: a TPU tier whose
+            # child lost the chip and silently fell back to CPU must be
+            # stamped too.
             out["fallback"] = True
         print(json.dumps(out))
         return

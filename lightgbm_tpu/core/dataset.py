@@ -387,6 +387,17 @@ class TpuDataset:
         return (int(self.bundle.group_num_bin.max(initial=1))
                 if self.bundle is not None else self.max_num_bin)
 
+    @property
+    def column_bins(self) -> np.ndarray:
+        """Per-column bin counts (feature-parallel stripes balance on this,
+        as the reference balances shards by #bins —
+        feature_parallel_tree_learner.cpp:36-47)."""
+        if self.bundle is not None:
+            return np.asarray(self.bundle.group_num_bin, dtype=np.int64)
+        return np.asarray([self.bin_mappers[f].num_bin
+                           for f in self.used_feature_indices],
+                          dtype=np.int64)
+
     def feature_infos(self) -> List[FeatureInfo]:
         infos = []
         for j, f in enumerate(self.used_feature_indices):
@@ -545,7 +556,8 @@ class TpuDataset:
                        else None),
         }
         blob = json.dumps(meta).encode()
-        with open(filename, "wb") as fh:
+        from ..utils.file_io import open_file
+        with open_file(filename, "wb") as fh:
             fh.write(_BINARY_MAGIC)
             fh.write(struct.pack("<q", len(blob)))
             fh.write(blob)
@@ -564,7 +576,9 @@ class TpuDataset:
     @classmethod
     def load_binary(cls, filename: str) -> "TpuDataset":
         import json
-        with open(filename, "rb") as fh:
+
+        from ..utils.file_io import open_file
+        with open_file(filename, "rb") as fh:
             magic = fh.read(len(_BINARY_MAGIC))
             if magic != _BINARY_MAGIC:
                 log_fatal(f"{filename} is not a lightgbm_tpu binary dataset")

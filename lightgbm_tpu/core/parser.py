@@ -14,6 +14,8 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..config import Config
+from ..utils.file_io import open_file, uri_scheme
+from ..utils import file_io
 from ..utils.log import LightGBMError, check, log_info, log_warning
 
 
@@ -92,7 +94,7 @@ def _read_head(filename: str, max_bytes: int = 1 << 16,
     stance; the old readlines() path held ~2GB of str objects at 10M
     rows).  The buffer grows until it holds ``want_lines`` complete lines
     (very wide rows — thousands of features — exceed a fixed buffer)."""
-    with open(filename) as fh:
+    with open_file(filename) as fh:
         head = fh.read(max_bytes)
         truncated = len(head) == max_bytes
         while truncated and head.count("\n") < want_lines:
@@ -113,12 +115,21 @@ def _iter_dense_chunks(filename: str, sep: str, skip_rows: int,
     C tokenizer (the numpy-tokenized chunked reader; peak memory is one
     chunk)."""
     import pandas as pd
-    reader = pd.read_csv(filename, sep=sep, header=None,
-                         skiprows=skip_rows, chunksize=chunk_rows,
-                         na_values=list(_NA_TOKENS), dtype=np.float64,
-                         keep_default_na=True)
-    for chunk in reader:
-        yield chunk.to_numpy(dtype=np.float64)
+    handle = None
+    if uri_scheme(filename):
+        # pandas accepts file objects but does not close caller-supplied
+        # handles — close deterministically even on a mid-parse failure
+        handle = filename = open_file(filename)
+    try:
+        reader = pd.read_csv(filename, sep=sep, header=None,
+                             skiprows=skip_rows, chunksize=chunk_rows,
+                             na_values=list(_NA_TOKENS), dtype=np.float64,
+                             keep_default_na=True)
+        for chunk in reader:
+            yield chunk.to_numpy(dtype=np.float64)
+    finally:
+        if handle is not None:
+            handle.close()
 
 
 def _read_dense_matrix(filename: str, sep: str, skip_rows: int) -> np.ndarray:
@@ -128,7 +139,7 @@ def _read_dense_matrix(filename: str, sep: str, skip_rows: int) -> np.ndarray:
         chunks = list(_iter_dense_chunks(filename, sep, skip_rows))
         return (np.vstack(chunks) if len(chunks) > 1 else chunks[0])
     except Exception:
-        with open(filename) as fh:
+        with open_file(filename) as fh:
             lines = fh.readlines()[skip_rows:]
         return _parse_dense(lines, sep)
 
@@ -148,7 +159,7 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
     dataset_loader.cpp:162)."""
     from .dataset import TpuDataset
 
-    if not os.path.exists(filename):
+    if not file_io.exists(filename):
         raise LightGBMError(f"Data file {filename} doesn't exist")
     if filename.endswith(".bin") or _is_binary(filename):
         return TpuDataset.load_binary(filename)
@@ -218,7 +229,10 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
             ds = _load_two_round(filename, sep, skip_rows, config, label_col,
                                  weight_col, group_col, feat_cols, feat_names,
                                  cat_idx, reference, t0, ncol, resolve_cols)
-        except LightGBMError:
+        except (LightGBMError, MemoryError):
+            # MemoryError must not fall through: two_round exists BECAUSE
+            # the file doesn't fit in RAM, and the one-round fallback
+            # would only OOM harder
             raise
         except Exception as e:
             # the streaming C tokenizer rejects ragged/odd dense files the
@@ -232,7 +246,7 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
         del ds._qids_tmp
     else:
         if fmt == "libsvm":
-            with open(filename) as fh:
+            with open_file(filename) as fh:
                 lines = fh.readlines()[skip_rows:]
             mat = _parse_libsvm(lines)
         else:
@@ -393,7 +407,7 @@ def _load_two_round(filename: str, sep: str, skip_rows: int, config: Config,
 
 def _is_binary(filename: str) -> bool:
     from .dataset import _BINARY_MAGIC
-    with open(filename, "rb") as fh:
+    with open_file(filename, "rb") as fh:
         head = fh.read(len(_BINARY_MAGIC))
     return head == _BINARY_MAGIC
 

@@ -350,7 +350,8 @@ class GBDT:
                 self.num_bins, self.grower_params, mesh, tl,
                 top_k=cfg.top_k, num_columns=train_set.num_columns,
                 feat_group=(bundle.feat_group if bundle is not None
-                            else None))
+                            else None),
+                column_bins=train_set.column_bins)
             self._mesh = mesh
         elif self._use_segment and impl in ("auto", "segment"):
             from .grower_seg import make_grow_tree_segment

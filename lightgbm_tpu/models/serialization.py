@@ -117,12 +117,28 @@ def save_model_to_string(gbdt, config: Config, num_iteration: int = -1,
         lines.append("average_output")
     lines.append("feature_names=" + " ".join(gbdt.feature_names))
     lines.append("feature_infos=" + " ".join(_feature_infos_strings(gbdt)))
-    lines.append("init_scores=" + _join(gbdt.init_scores, _fmt))
+
+    def _tree_for_save(i: int):
+        """Boost-from-average is a bias folded into the FIRST iteration's
+        leaves (gbdt.cpp:503 AddBias, shrinkage forced to 1.0), so the
+        model file is self-contained and the reference CLI reads it
+        back bit-identically; in memory the bias stays separate
+        (GBDT.init_scores) and is added at predict time."""
+        t = gbdt.models[i]
+        init = (gbdt.init_scores[i] if start_iteration == 0 and i < C
+                and i < len(gbdt.init_scores) else 0.0)
+        if abs(init) < 1e-35:
+            return t
+        import copy
+        biased = copy.copy(t)
+        biased.leaf_value = np.asarray(t.leaf_value, dtype=np.float64) + init
+        biased.shrinkage = 1.0
+        return biased
 
     tree_strs = []
     for i in range(start_iteration * C, end_iter * C):
         s = f"Tree={i - start_iteration * C}\n" + tree_to_string(
-            gbdt.models[i]) + "\n"
+            _tree_for_save(i)) + "\n"
         tree_strs.append(s)
     lines.append("tree_sizes=" + _join(len(s) for s in tree_strs))
     lines.append("")

@@ -79,6 +79,52 @@ def _stripe_feature_mask(fmask, axis, start, per, feat_group):
     return fmask * stripe.astype(fmask.dtype)
 
 
+def _balanced_stripes(column_bins, D: int):
+    """Contiguous column stripes with ~equal Σbins per shard (the
+    reference re-balances feature-parallel shards by #bins,
+    feature_parallel_tree_learner.cpp:36-47; an even column split skews
+    badly when EFB bundles concentrate bins in few columns).
+
+    Returns (starts [D], widths [D], per) where ``per`` is the max stripe
+    width — the static column-block size every shard reads (narrower
+    stripes mask the surplus columns out of the scan).  Because every
+    shard's histogram block is ``per`` wide regardless of its own stripe,
+    widths are capped at 2x the even split: bins-balance may only double
+    the static block, never degenerate into one shard reading almost all
+    columns.  Each boundary picks the side of the Σbins-crossing column
+    closer to the target, so profiles the even split already handles
+    optimally (e.g. [3, 5] on 2 shards) are never made worse."""
+    cb = np.maximum(np.asarray(column_bins, dtype=np.int64), 1)
+    G = len(cb)
+    csum = np.cumsum(cb)
+    total = int(csum[-1])
+    even = -(-G // D)
+    cap = min(2 * even, G)
+    starts = np.zeros(D, dtype=np.int32)
+    ends = np.zeros(D, dtype=np.int32)
+    pos = 0
+    for d in range(D):
+        starts[d] = pos
+        if d == D - 1:
+            ends[d] = G
+            break
+        target = (d + 1) * total / D
+        e = int(np.searchsorted(csum, target, side="left")) + 1
+        # nearer boundary of the crossing column
+        if e - 1 > pos and abs(csum[e - 2] - target) <= \
+                abs(csum[e - 1] - target):
+            e -= 1
+        # feasibility: the remaining shards (cap wide each) must be able
+        # to cover the remaining columns; this shard must respect cap
+        e = max(e, pos, G - cap * (D - 1 - d))
+        e = min(e, pos + cap, G)
+        ends[d] = e
+        pos = e
+    widths = (ends - starts).astype(np.int32)
+    assert int(widths.sum()) == G
+    return starts, widths, int(widths.max(initial=1))
+
+
 def _log_collective_estimate(mode: str, D: int, num_columns: int,
                              num_bins: int, num_leaves: int,
                              top_k: int = 0):
@@ -105,13 +151,16 @@ def _log_collective_estimate(mode: str, D: int, num_columns: int,
 
 def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
                          mode: str, top_k: int = 20,
-                         num_columns: int = 0, feat_group=None):
+                         num_columns: int = 0, feat_group=None,
+                         column_bins=None):
     """shard_map-wrapped grower for mode in {'data', 'feature', 'voting'}.
 
     Argument order of the returned fn matches the serial grower:
     (bins, grad, hess, member, fmeta, feature_mask, key).
     ``num_columns``/``feat_group`` locate features in the physical bin
-    matrix for the feature-parallel column stripes (EFB, core/bundle.py).
+    matrix for the feature-parallel column stripes (EFB, core/bundle.py);
+    ``column_bins`` (per-column bin counts) balances those stripes by
+    Σbins the way the reference does.
     """
     axis = mesh.axis_names[0]
     D = int(mesh.devices.size)
@@ -163,23 +212,33 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
         # contiguous COLUMN stripe; the winning SplitInfo merges by
         # max-gain and all shards split locally — the reference's
         # feature-parallel contract (feature_parallel_tree_learner.cpp:
-        # 36-75, histograms only for the rank's own features).  The
-        # reference re-balances shards by #bins per tree (:36-47); an even
-        # column split is equivalent when bins are uniform.
+        # 36-75, histograms only for the rank's own features).  Stripe
+        # boundaries balance per-shard Σbins like the reference (:36-47)
+        # when per-column bin counts are known; even column split is the
+        # uniform-bins special case.
         G = num_columns
-        per = -(-G // D)
-
-        def my_start():
-            me = lax.axis_index(axis)
-            return jnp.minimum(me * per,
-                               jnp.maximum(G - per, 0)).astype(jnp.int32)
+        if column_bins is not None and len(column_bins) == G and D > 1:
+            starts_np, widths_np, per = _balanced_stripes(column_bins, D)
+        else:
+            per = -(-G // D)
+            starts_np = (np.arange(D) * per).astype(np.int32)
+            widths_np = np.minimum(per, np.maximum(
+                G - starts_np, 0)).astype(np.int32)
+        # the static block every shard READS is `per` wide; clamp its
+        # start so the read stays in-bounds (mask start stays exact)
+        block_starts_np = np.minimum(starts_np,
+                                     max(G - per, 0)).astype(np.int32)
+        starts_d = jnp.asarray(starts_np)
+        widths_d = jnp.asarray(widths_np)
+        block_starts_d = jnp.asarray(block_starts_np)
 
         def column_block(bins):
-            return my_start(), per
+            return block_starts_d[lax.axis_index(axis)], per
 
         def shard_mask(fmask):
-            return _stripe_feature_mask(fmask, axis, my_start(), per,
-                                        feat_group)
+            me = lax.axis_index(axis)
+            return _stripe_feature_mask(fmask, axis, starts_d[me],
+                                        widths_d[me], feat_group)
 
         comm = CommHooks(
             merge_split=lambda info, gain: _merge_split_by_gain(

@@ -106,11 +106,25 @@ def binning_world() -> tuple:
         # so if any multi-process launch marker is in the environment this
         # is fatal, not a warning
         import os
+
+        def _multi(var: str) -> bool:
+            val = os.environ.get(var, "")
+            if not val:
+                return False
+            if var in ("SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE"):
+                try:
+                    return int(val) > 1   # 1-node/1-rank runs are serial
+                except ValueError:
+                    return True
+            if var == "TPU_WORKER_HOSTNAMES":
+                return "," in val         # single-host pod slice is serial
+            return True                    # coordinator address present
+
         markers = [v for v in (
             "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
             "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
             "SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE",
-        ) if os.environ.get(v)]
+        ) if _multi(v)]
         if markers:
             raise LightGBMError(
                 "cannot determine the multi-process world for distributed "

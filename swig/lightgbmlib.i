@@ -25,4 +25,73 @@
 %array_functions(int, intArray)
 %array_functions(int64_t, int64Array)
 
+/* String-array helpers: the name-returning entry points
+ * (LGBM_BoosterGetEvalNames / GetFeatureNames / DatasetGetFeatureNames)
+ * follow the caller-pre-allocates contract — the caller passes a char**
+ * whose slots each point at a writable buffer.  Generated bindings (JNI
+ * and the Python smoke test alike) cannot express that allocation
+ * natively, so provide it here: a fixed-width buffer table plus
+ * getters/setters, the same facility the reference's interface file
+ * ships for its JNI consumer. */
+%inline %{
+#include <stdlib.h>
+#include <string.h>
+
+/* The table remembers its own n/width so per-call size arguments (a
+ * mismatch-prone contract) never exist: every access is bounds-checked
+ * against the stored allocation. */
+typedef struct {
+  int n;
+  int width;
+  char** arr;
+} StringBuffers;
+
+static StringBuffers* new_stringBuffers(int n, int width) {
+  StringBuffers* sb;
+  int i;
+  if (n <= 0 || width <= 1) return NULL;
+  sb = (StringBuffers*)calloc(1, sizeof(StringBuffers));
+  if (sb == NULL) return NULL;
+  sb->n = n;
+  sb->width = width;
+  sb->arr = (char**)calloc((size_t)n, sizeof(char*));
+  if (sb->arr == NULL) { free(sb); return NULL; }
+  for (i = 0; i < n; ++i) {
+    sb->arr[i] = (char*)calloc((size_t)width, 1);
+    if (sb->arr[i] == NULL) { /* unwind on partial failure */
+      while (--i >= 0) free(sb->arr[i]);
+      free(sb->arr);
+      free(sb);
+      return NULL;
+    }
+  }
+  return sb;
+}
+
+/* the char** view the LGBM_* name getters/setters expect */
+static char** stringBuffers_ptr(StringBuffers* sb) {
+  return sb != NULL ? sb->arr : NULL;
+}
+
+static const char* stringBuffers_getitem(StringBuffers* sb, int i) {
+  if (sb == NULL || i < 0 || i >= sb->n) return NULL;
+  return sb->arr[i];
+}
+
+static void stringBuffers_setitem(StringBuffers* sb, int i,
+                                  const char* s) {
+  if (sb == NULL || i < 0 || i >= sb->n || s == NULL) return;
+  strncpy(sb->arr[i], s, (size_t)(sb->width - 1));
+  sb->arr[i][sb->width - 1] = '\0';
+}
+
+static void delete_stringBuffers(StringBuffers* sb) {
+  int i;
+  if (sb == NULL) return;
+  for (i = 0; i < sb->n; ++i) free(sb->arr[i]);
+  free(sb->arr);
+  free(sb);
+}
+%}
+
 %include "lightgbm_tpu/c_api.h"

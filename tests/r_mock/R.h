@@ -1,0 +1,5 @@
+/* Mock of R.h — everything lives in the mock Rinternals.h. */
+#ifndef LGBMTPU_R_MOCK_R_H_
+#define LGBMTPU_R_MOCK_R_H_
+#include "Rinternals.h"
+#endif

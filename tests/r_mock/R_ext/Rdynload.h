@@ -1,0 +1,34 @@
+/* Mock of R_ext/Rdynload.h — registration becomes a no-op. */
+#ifndef LGBMTPU_R_MOCK_RDYNLOAD_H_
+#define LGBMTPU_R_MOCK_RDYNLOAD_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* (*DL_FUNC)(void);
+typedef struct {
+  const char* name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+typedef struct mock_dllinfo {
+  int unused;
+} DllInfo;
+
+static inline int R_registerRoutines(DllInfo* dll, const void* c,
+                                     const R_CallMethodDef* call,
+                                     const void* f, const void* ext) {
+  (void)dll; (void)c; (void)call; (void)f; (void)ext;
+  return 0;
+}
+static inline int R_useDynamicSymbols(DllInfo* dll, int v) {
+  (void)dll; (void)v;
+  return 0;
+}
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif

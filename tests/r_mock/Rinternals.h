@@ -1,0 +1,178 @@
+/*
+ * Minimal functional mock of the R C API — just enough to compile AND
+ * RUN R-package/src/lightgbm_tpu_R.c without an R installation, so the
+ * test suite exercises the .Call shim's real behavior (tests/
+ * test_r_package.py drives a train/predict round trip through it).
+ *
+ * SEXP here is a tagged heap object; "protection" is a no-op (the
+ * driver never triggers GC because there is none).  This is a test
+ * double, NOT an R reimplementation.
+ */
+#ifndef LGBMTPU_R_MOCK_INTERNALS_H_
+#define LGBMTPU_R_MOCK_INTERNALS_H_
+
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define NILSXP 0
+#define REALSXP 14
+#define INTSXP 13
+#define STRSXP 16
+#define CHARSXP 9
+#define LGLSXP 10
+#define EXTPTRSXP 22
+
+typedef long R_xlen_t;
+
+typedef struct mock_sexp {
+  int type;
+  R_xlen_t length;
+  double* reals;
+  int* ints;
+  char* chars;                   /* CHARSXP payload */
+  struct mock_sexp** strs;       /* STRSXP elements (CHARSXPs) */
+  void* extptr;
+  void (*finalizer)(struct mock_sexp*);
+  /* one attribute slot is all the shim uses (dim / num_iterations) */
+  const char* attr_name;
+  struct mock_sexp* attr_value;
+} mock_sexp;
+
+typedef mock_sexp* SEXP;
+
+extern SEXP R_NilValue;
+extern const char* R_DimSymbol;
+
+/* ---- allocation ---- */
+
+static inline SEXP mock_alloc_sexp(int type) {
+  SEXP s = (SEXP)calloc(1, sizeof(mock_sexp));
+  s->type = type;
+  return s;
+}
+
+static inline SEXP Rf_allocVector(int type, R_xlen_t n) {
+  SEXP s = mock_alloc_sexp(type);
+  s->length = n;
+  if (type == REALSXP) {
+    s->reals = (double*)calloc(n > 0 ? n : 1, sizeof(double));
+  } else if (type == INTSXP || type == LGLSXP) {
+    s->ints = (int*)calloc(n > 0 ? n : 1, sizeof(int));
+  } else if (type == STRSXP) {
+    s->strs = (mock_sexp**)calloc(n > 0 ? n : 1, sizeof(mock_sexp*));
+  }
+  return s;
+}
+
+static inline SEXP Rf_mkChar(const char* str) {
+  SEXP s = mock_alloc_sexp(CHARSXP);
+  s->length = (R_xlen_t)strlen(str);
+  s->chars = strdup(str);
+  return s;
+}
+
+static inline SEXP Rf_mkString(const char* str) {
+  SEXP v = Rf_allocVector(STRSXP, 1);
+  v->strs[0] = Rf_mkChar(str);
+  return v;
+}
+
+/* ---- accessors ---- */
+
+static inline double* REAL(SEXP s) { return s->reals; }
+static inline int* INTEGER(SEXP s) { return s->ints; }
+static inline const char* CHAR(SEXP s) { return s->chars; }
+static inline SEXP STRING_ELT(SEXP s, R_xlen_t i) { return s->strs[i]; }
+static inline void SET_STRING_ELT(SEXP s, R_xlen_t i, SEXP v) {
+  s->strs[i] = v;
+}
+static inline R_xlen_t Rf_length(SEXP s) { return s->length; }
+static inline int Rf_isNull(SEXP s) {
+  return s == NULL || s->type == NILSXP;
+}
+static inline int Rf_asInteger(SEXP s) {
+  if (s->type == REALSXP) return (int)s->reals[0];
+  return s->ints[0];
+}
+static inline SEXP Rf_ScalarInteger(int v) {
+  SEXP s = Rf_allocVector(INTSXP, 1);
+  s->ints[0] = v;
+  return s;
+}
+static inline SEXP Rf_ScalarLogical(int v) {
+  SEXP s = Rf_allocVector(LGLSXP, 1);
+  s->ints[0] = v;
+  return s;
+}
+
+/* ---- attributes (single slot) ---- */
+
+static inline const char* Rf_install(const char* name) { return name; }
+static inline SEXP Rf_getAttrib(SEXP s, const char* name) {
+  if (s->attr_name != NULL && strcmp(s->attr_name, name) == 0) {
+    return s->attr_value;
+  }
+  return R_NilValue;
+}
+static inline void Rf_setAttrib(SEXP s, const char* name, SEXP v) {
+  s->attr_name = name;
+  s->attr_value = v;
+}
+
+/* ---- protection: no GC in the mock ---- */
+
+#define PROTECT(x) (x)
+#define UNPROTECT(n) ((void)(n))
+
+/* ---- error: print + abort (the driver treats abort as failure) ---- */
+
+static inline void Rf_error(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "R mock error: ");
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+  exit(77);
+}
+
+/* ---- external pointers ---- */
+
+typedef int Rboolean;
+#ifndef TRUE
+#define TRUE 1
+#define FALSE 0
+#endif
+
+static inline SEXP R_MakeExternalPtr(void* p, SEXP tag, SEXP prot) {
+  (void)tag;
+  (void)prot;
+  SEXP s = mock_alloc_sexp(EXTPTRSXP);
+  s->extptr = p;
+  return s;
+}
+static inline void* R_ExternalPtrAddr(SEXP s) { return s->extptr; }
+static inline void R_ClearExternalPtr(SEXP s) { s->extptr = NULL; }
+static inline void R_RegisterCFinalizerEx(SEXP s, void (*fin)(SEXP),
+                                          Rboolean onexit) {
+  (void)onexit;
+  s->finalizer = fin;
+}
+
+/* ---- transient allocation: leaked by the mock (no R heap) ---- */
+
+static inline char* R_alloc(size_t n, int size) {
+  return (char*)calloc(n > 0 ? n : 1, (size_t)size);
+}
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* LGBMTPU_R_MOCK_INTERNALS_H_ */

@@ -1,0 +1,152 @@
+/*
+ * Behavioral test driver for the R .Call shim, run without R: builds
+ * mock SEXPs (tests/r_mock/Rinternals.h), then drives dataset
+ * construction, training, prediction, eval introspection, and model
+ * save/load through R-package/src/lightgbm_tpu_R.c exactly as the R
+ * front end would.  Exit 0 = pass; any Rf_error exits 77.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "Rinternals.h"
+
+SEXP R_NilValue = NULL;
+const char* R_DimSymbol = "dim";
+
+/* shim entry points (R-package/src/lightgbm_tpu_R.c) */
+extern SEXP LGBMTPU_GetLastError_R(void);
+extern SEXP LGBMTPU_DatasetCreateFromMat_R(SEXP, SEXP, SEXP);
+extern SEXP LGBMTPU_DatasetSetField_R(SEXP, SEXP, SEXP);
+extern SEXP LGBMTPU_DatasetGetNumData_R(SEXP);
+extern SEXP LGBMTPU_DatasetGetNumFeature_R(SEXP);
+extern SEXP LGBMTPU_DatasetSetFeatureNames_R(SEXP, SEXP);
+extern SEXP LGBMTPU_DatasetGetFeatureNames_R(SEXP);
+extern SEXP LGBMTPU_DatasetFree_R(SEXP);
+extern SEXP LGBMTPU_BoosterCreate_R(SEXP, SEXP);
+extern SEXP LGBMTPU_BoosterCreateFromModelfile_R(SEXP);
+extern SEXP LGBMTPU_BoosterUpdateOneIter_R(SEXP);
+extern SEXP LGBMTPU_BoosterGetCurrentIteration_R(SEXP);
+extern SEXP LGBMTPU_BoosterGetEvalNames_R(SEXP);
+extern SEXP LGBMTPU_BoosterGetEval_R(SEXP, SEXP);
+extern SEXP LGBMTPU_BoosterPredictForMat_R(SEXP, SEXP, SEXP, SEXP, SEXP);
+extern SEXP LGBMTPU_BoosterSaveModel_R(SEXP, SEXP, SEXP);
+extern SEXP LGBMTPU_BoosterFree_R(SEXP);
+
+#define N 400
+#define F 4
+
+static SEXP make_matrix(const double* colmajor, int nrow, int ncol) {
+  SEXP m = Rf_allocVector(REALSXP, (R_xlen_t)nrow * ncol);
+  for (long i = 0; i < (long)nrow * ncol; ++i) {
+    m->reals[i] = colmajor[i];
+  }
+  SEXP dim = Rf_allocVector(INTSXP, 2);
+  dim->ints[0] = nrow;
+  dim->ints[1] = ncol;
+  Rf_setAttrib(m, R_DimSymbol, dim);
+  return m;
+}
+
+int main(int argc, char** argv) {
+  const char* model_path = argc > 1 ? argv[1] : "/tmp/r_mock_model.txt";
+  /* deterministic column-major data; label = x0 > 0 */
+  static double X[N * F];
+  static double y[N];
+  unsigned s = 123456789u;
+  for (int i = 0; i < N * F; ++i) {
+    s = s * 1103515245u + 12345u;
+    X[i] = ((double)(s >> 8) / (double)(1u << 24)) * 4.0 - 2.0;
+  }
+  for (int i = 0; i < N; ++i) {
+    y[i] = X[i] > 0.0 ? 1.0 : 0.0;   /* column 0 is X[0..N-1] */
+  }
+
+  SEXP params = Rf_mkString(
+      "objective=binary verbosity=-1 min_data_in_leaf=5 num_leaves=15");
+  SEXP mat = make_matrix(X, N, F);
+  SEXP ds = LGBMTPU_DatasetCreateFromMat_R(mat, params, R_NilValue);
+
+  SEXP lab = Rf_allocVector(REALSXP, N);
+  for (int i = 0; i < N; ++i) lab->reals[i] = y[i];
+  LGBMTPU_DatasetSetField_R(ds, Rf_mkString("label"), lab);
+
+  if (Rf_asInteger(LGBMTPU_DatasetGetNumData_R(ds)) != N) {
+    fprintf(stderr, "num_data mismatch\n");
+    return 1;
+  }
+  if (Rf_asInteger(LGBMTPU_DatasetGetNumFeature_R(ds)) != F) {
+    fprintf(stderr, "num_feature mismatch\n");
+    return 1;
+  }
+  SEXP fn = Rf_allocVector(STRSXP, F);
+  SET_STRING_ELT(fn, 0, Rf_mkChar("alpha"));
+  SET_STRING_ELT(fn, 1, Rf_mkChar("beta"));
+  SET_STRING_ELT(fn, 2, Rf_mkChar("gamma"));
+  SET_STRING_ELT(fn, 3, Rf_mkChar("delta"));
+  LGBMTPU_DatasetSetFeatureNames_R(ds, fn);
+  SEXP back = LGBMTPU_DatasetGetFeatureNames_R(ds);
+  if (Rf_length(back) != F ||
+      strcmp(CHAR(STRING_ELT(back, 0)), "alpha") != 0) {
+    fprintf(stderr, "feature-name round trip failed\n");
+    return 1;
+  }
+
+  SEXP bst = LGBMTPU_BoosterCreate_R(ds, params);
+  for (int i = 0; i < 8; ++i) {
+    LGBMTPU_BoosterUpdateOneIter_R(bst);
+  }
+  if (Rf_asInteger(LGBMTPU_BoosterGetCurrentIteration_R(bst)) != 8) {
+    fprintf(stderr, "iteration count mismatch\n");
+    return 1;
+  }
+  SEXP enames = LGBMTPU_BoosterGetEvalNames_R(bst);
+  if (Rf_length(enames) < 1) {
+    fprintf(stderr, "no eval names\n");
+    return 1;
+  }
+  SEXP ev = LGBMTPU_BoosterGetEval_R(bst, Rf_ScalarInteger(0));
+  if (Rf_length(ev) != Rf_length(enames)) {
+    fprintf(stderr, "eval length mismatch\n");
+    return 1;
+  }
+
+  SEXP zero = Rf_ScalarInteger(0);
+  SEXP all_iters = Rf_ScalarInteger(-1);
+  SEXP empty = Rf_mkString("");
+  SEXP pred = LGBMTPU_BoosterPredictForMat_R(bst, mat, zero, all_iters,
+                                             empty);
+  if (Rf_length(pred) != N) {
+    fprintf(stderr, "prediction length mismatch\n");
+    return 1;
+  }
+  int correct = 0;
+  for (int i = 0; i < N; ++i) {
+    correct += (pred->reals[i] > 0.5) == (y[i] > 0.5);
+  }
+  double acc = (double)correct / N;
+  if (acc < 0.9) {
+    fprintf(stderr, "accuracy too low: %.3f\n", acc);
+    return 1;
+  }
+
+  /* model file round trip through the shim's load path */
+  LGBMTPU_BoosterSaveModel_R(bst, all_iters, Rf_mkString(model_path));
+  SEXP bst2 = LGBMTPU_BoosterCreateFromModelfile_R(
+      Rf_mkString(model_path));
+  SEXP pred2 = LGBMTPU_BoosterPredictForMat_R(bst2, mat, zero, all_iters,
+                                              empty);
+  for (int i = 0; i < N; ++i) {
+    if (fabs(pred->reals[i] - pred2->reals[i]) > 1e-6) {
+      fprintf(stderr, "loaded-model prediction mismatch at %d\n", i);
+      return 1;
+    }
+  }
+
+  LGBMTPU_BoosterFree_R(bst);
+  LGBMTPU_BoosterFree_R(bst2);
+  LGBMTPU_DatasetFree_R(ds);
+  printf("r_mock driver OK: acc=%.3f evals=%ld\n", acc,
+         (long)Rf_length(enames));
+  return 0;
+}

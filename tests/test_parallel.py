@@ -158,3 +158,60 @@ def test_voting_parallel_with_bundling(rng):
     p = bst.predict(X)
     ll = -np.mean(yb * np.log(p + 1e-9) + (1 - yb) * np.log(1 - p + 1e-9))
     assert ll < 0.55
+
+
+def test_balanced_stripes_by_bins():
+    """Stripe boundaries cut per-shard Σbins skew (the reference balances
+    feature-parallel shards by #bins,
+    feature_parallel_tree_learner.cpp:36-47) while the width cap bounds
+    every shard's static histogram block at 2x the even split."""
+    from lightgbm_tpu.parallel.learners import _balanced_stripes
+    rng = np.random.RandomState(0)
+    # EFB-like skew: a few fat bundled columns among many tiny ones
+    cb = np.concatenate([np.full(4, 255), rng.randint(2, 8, size=60)])
+    D = 8
+    starts, widths, per = _balanced_stripes(cb, D)
+    sums = np.asarray([cb[s:s + w].sum() for s, w in zip(starts, widths)])
+    assert sums.sum() == cb.sum()           # partition covers every column
+    even = -(-len(cb) // D)
+    assert per <= 2 * even                   # histogram block stays bounded
+    ideal = cb.sum() / D
+    # a fat column alone is ~2x the ideal shard load and the width cap
+    # forces the small-column tail onto few shards, so the capped optimum
+    # is one fat column + a slice of tail, not perfect balance
+    assert sums.max() <= 1.5 * max(cb.max(), ideal), (sums, ideal)
+    # and the even split must be far WORSE on this profile
+    even_sums = np.asarray([cb[i * even:(i + 1) * even].sum()
+                            for i in range(D)])
+    assert sums.max() < 0.5 * even_sums.max()
+
+    # a profile the even split already handles optimally is never worsened
+    s2, w2, p2 = _balanced_stripes(np.asarray([3, 5]), 2)
+    assert list(w2) == [1, 1] and p2 == 1
+
+    # degenerate: one giant column among few — no empty-shard blowup
+    s3, w3, p3 = _balanced_stripes(np.asarray([10000] + [1] * 15), 4)
+    assert w3.sum() == 16 and p3 <= 2 * 4
+
+
+def test_feature_parallel_skewed_bundles(rng):
+    """Feature-parallel over an EFB dataset whose bundles concentrate
+    bins in few physical columns still matches the serial learner."""
+    n = 2000
+    # 3 dense high-cardinality features + 40 sparse one-hot-ish columns
+    # that EFB packs into few bundles
+    dense = rng.normal(size=(n, 3))
+    width, blocks = 10, 4
+    sparse = np.zeros((n, width * blocks))
+    picks = rng.randint(0, width, size=(n, blocks))
+    for b in range(blocks):
+        sparse[np.arange(n), b * width + picks[:, b]] = rng.normal(2, 1, n)
+    X = np.hstack([dense, sparse])
+    y = dense[:, 0] * 2 + sparse[:, :width].sum(1) \
+        + rng.normal(size=n) * 0.1
+    serial = _train(X, y, "serial", max_bin=255, min_data_in_leaf=5)
+    feat = _train(X, y, "feature", max_bin=255, min_data_in_leaf=5)
+    assert feat.gbdt.train_set.bundle is not None, \
+        "EFB must bundle the sparse block or this test covers nothing"
+    np.testing.assert_allclose(serial.predict(X), feat.predict(X),
+                               rtol=1e-3, atol=1e-4)

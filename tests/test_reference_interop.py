@@ -1,0 +1,247 @@
+"""Interop proof against the ACTUAL reference binary (round-3 verdict
+item 3): build the reference CLI from /root/reference with cmake, train
+models with it, cross-load the model files in both directions, and
+assert prediction parity.
+
+The fork's CMakeLists hard-requires two vendored dependencies that are
+absent from the source drop (the easy_profiler submodule and the PHub
+parameter-server library, CMakeLists.txt:42,253).  Neither is used on a
+single-machine CPU run, so the build fixture copies the tree to a scratch
+dir and installs no-op stand-ins before building.  Skips cleanly when the
+reference tree or toolchain is unavailable.
+"""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = os.environ.get("LIGHTGBM_REFERENCE_DIR", "/root/reference")
+CACHE_DIR = os.environ.get("LIGHTGBM_REF_BUILD_CACHE",
+                           "/tmp/lightgbm_tpu_ref_build")
+
+EASY_PROFILER_STUB = """\
+#pragma once
+#include <cstdint>
+#define EASY_FUNCTION(...)
+#define EASY_BLOCK(...)
+#define EASY_END_BLOCK
+#define EASY_PROFILER_ENABLE
+#define EASY_PROFILER_DISABLE
+namespace profiler {
+namespace colors {
+typedef uint32_t color_t;
+const color_t Blue500 = 0, BlueA700 = 0, Cyan = 0, Green = 0,
+    Green200 = 0, Magenta = 0, Orange = 0, PaleGold = 0, Purple = 0,
+    Red50 = 0, Yellow100 = 0;
+}
+inline int dumpBlocksToFile(const char*) { return 0; }
+inline void startListen(int = 0) {}
+}
+"""
+
+PHUB_STUB = """\
+#pragma once
+#include <cstdlib>
+#include <cstring>
+#include <cstddef>
+#include <iostream>
+#include <memory>
+#include <string>
+#include <vector>
+#define PHUB_CHECK(x) if (!(x)) ::abort(); else std::cerr << ""
+#define COMPILER_BARRIER() asm volatile("" ::: "memory")
+typedef int PLinkKey;
+enum class PHubDataType { CUSTOM, FLOAT };
+class PHub {
+ public:
+  std::vector<int> keySizes;
+  std::vector<void*> ApplicationSuppliedAddrs;
+  std::vector<void*> ApplicationSuppliedOutputAddrs;
+  void SetReductionFunction(void (*)(char*, char*)) { ::abort(); }
+  void Reduce() { ::abort(); }
+  void Reduce(const std::vector<PLinkKey>&) { ::abort(); }
+  void FastTerminate() {}
+};
+inline std::shared_ptr<PHub> createPHubInstance(
+    void*, size_t, int, int, int, PHubDataType, size_t,
+    const std::string& = std::string()) {
+  ::abort();
+  return nullptr;
+}
+inline std::string pHubGetOptionalEnvironmentVariable(
+    const std::string& name, const std::string& dflt = std::string()) {
+  const char* v = std::getenv(name.c_str());
+  return v ? std::string(v) : dflt;
+}
+inline std::string pHubGetMandatoryEnvironmemtVariable(
+    const std::string& name) {
+  const char* v = std::getenv(name.c_str());
+  if (v == NULL) ::abort();
+  return std::string(v);
+}
+template <typename T, typename U>
+inline T RoundUp(T value, U multiple) {
+  T m = (T)multiple;
+  return m == 0 ? value : ((value + m - 1) / m) * m;
+}
+"""
+
+
+def _build_reference() -> str:
+    """Copy + patch + build the reference CLI; returns the binary path."""
+    binary = os.path.join(CACHE_DIR, "src", "lightgbm")
+    if os.path.exists(binary):
+        return binary
+    if not os.path.exists(os.path.join(REFERENCE, "CMakeLists.txt")):
+        pytest.skip(f"reference tree not found at {REFERENCE}")
+    if shutil.which("cmake") is None or shutil.which("make") is None:
+        pytest.skip("cmake/make not available")
+    src = os.path.join(CACHE_DIR, "src")
+    bld = os.path.join(CACHE_DIR, "build")
+    shutil.rmtree(CACHE_DIR, ignore_errors=True)
+    shutil.copytree(REFERENCE, src)
+    subprocess.run(["chmod", "-R", "u+w", src], check=True)
+    stub = os.path.join(src, "stub_deps")
+    os.makedirs(os.path.join(stub, "easy"))
+    with open(os.path.join(stub, "easy", "profiler.h"), "w") as fh:
+        fh.write(EASY_PROFILER_STUB)
+    with open(os.path.join(stub, "Integration.h"), "w") as fh:
+        fh.write(PHUB_STUB)
+    cml = os.path.join(src, "CMakeLists.txt")
+    text = open(cml).read()
+    text = text.replace(
+        "ADD_DEFINITIONS(-DBUILD_WITH_EASY_PROFILER)\n"
+        "include_directories(easy_profiler/easy_profiler_core/include)\n"
+        "add_subdirectory(easy_profiler)",
+        "include_directories(stub_deps)")
+    text = text.replace("TARGET_LINK_LIBRARIES(lightgbm PHub)", "")
+    with open(cml, "w") as fh:
+        fh.write(text)
+    os.makedirs(bld)
+    try:
+        subprocess.run(["cmake", "-S", src, "-B", bld,
+                        "-DCMAKE_BUILD_TYPE=Release"],
+                       check=True, capture_output=True, timeout=300)
+        subprocess.run(["make", "-C", bld, "-j8", "lightgbm"],
+                       check=True, capture_output=True, timeout=1200)
+    except subprocess.CalledProcessError as e:
+        pytest.skip(f"reference build failed: "
+                    f"{e.stderr.decode(errors='replace')[-500:]}")
+    assert os.path.exists(binary)
+    return binary
+
+
+@pytest.fixture(scope="module")
+def ref_cli():
+    return _build_reference()
+
+
+def _run_ref(binary, workdir, **params):
+    args = [binary] + [f"{k}={v}" for k, v in params.items()]
+    proc = subprocess.run(args, cwd=workdir, capture_output=True,
+                          text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc
+
+
+def _example(name):
+    return os.path.join(REFERENCE, "examples", name)
+
+
+def _load_examples_data(example, train_file, n_features):
+    data = np.loadtxt(os.path.join(_example(example), train_file),
+                      delimiter="\t")
+    y = data[:, 0]
+    X = data[:, 1:1 + n_features]
+    return X, y
+
+
+def test_reference_model_loads_and_matches(ref_cli, tmp_path):
+    """Reference-trained binary model -> our Booster: identical preds."""
+    import lightgbm_tpu as lgb
+
+    ex = _example("binary_classification")
+    model = tmp_path / "ref_model.txt"
+    _run_ref(ref_cli, ex, task="train", config="train.conf",
+             num_trees=10, output_model=str(model), verbosity=-1)
+    pred_file = tmp_path / "ref_preds.txt"
+    _run_ref(ref_cli, ex, task="predict", data="binary.test",
+             input_model=str(model), output_result=str(pred_file),
+             verbosity=-1)
+    ref_preds = np.loadtxt(pred_file)
+
+    X, _ = _load_examples_data("binary_classification", "binary.test", 28)
+    bst = lgb.Booster(model_file=str(model))
+    ours = bst.predict(X)
+    np.testing.assert_allclose(ours, ref_preds, rtol=1e-5, atol=1e-6)
+
+
+def test_our_model_loads_in_reference(ref_cli, tmp_path):
+    """Our trained model file -> reference CLI predict: identical preds."""
+    import lightgbm_tpu as lgb
+
+    X, y = _load_examples_data("binary_classification", "binary.train", 28)
+    params = {"objective": "binary", "num_leaves": 31, "max_bin": 255,
+              "learning_rate": 0.1, "verbose": -1, "min_data_in_leaf": 20}
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train(params, ds, num_boost_round=10, verbose_eval=False)
+    model = tmp_path / "tpu_model.txt"
+    bst.save_model(str(model))
+
+    Xt, _ = _load_examples_data("binary_classification", "binary.test", 28)
+    ours = bst.predict(Xt)
+
+    pred_file = tmp_path / "ref_preds.txt"
+    _run_ref(ref_cli, _example("binary_classification"), task="predict",
+             data="binary.test", input_model=str(model),
+             output_result=str(pred_file), verbosity=-1)
+    ref_preds = np.loadtxt(pred_file)
+    np.testing.assert_allclose(ref_preds, ours, rtol=1e-5, atol=1e-6)
+
+
+def test_reference_multiclass_model_matches(ref_cli, tmp_path):
+    """Multiclass softmax cross-load (reference -> ours)."""
+    import lightgbm_tpu as lgb
+
+    ex = _example("multiclass_classification")
+    model = tmp_path / "ref_model.txt"
+    _run_ref(ref_cli, ex, task="train", config="train.conf",
+             num_trees=8, output_model=str(model), verbosity=-1)
+    pred_file = tmp_path / "ref_preds.txt"
+    _run_ref(ref_cli, ex, task="predict", data="multiclass.test",
+             input_model=str(model), output_result=str(pred_file),
+             verbosity=-1)
+    ref_preds = np.loadtxt(pred_file)
+
+    data = np.loadtxt(os.path.join(ex, "multiclass.test"), delimiter="\t")
+    X = data[:, 1:]
+    bst = lgb.Booster(model_file=str(model))
+    ours = bst.predict(X)
+    np.testing.assert_allclose(ours, ref_preds, rtol=1e-5, atol=1e-6)
+
+
+def test_reference_lambdarank_model_matches(ref_cli, tmp_path):
+    """Lambdarank cross-load (reference -> ours), raw ranking scores."""
+    import lightgbm_tpu as lgb
+
+    ex = _example("lambdarank")
+    model = tmp_path / "ref_model.txt"
+    _run_ref(ref_cli, ex, task="train", config="train.conf",
+             num_trees=8, output_model=str(model), verbosity=-1)
+    pred_file = tmp_path / "ref_preds.txt"
+    _run_ref(ref_cli, ex, task="predict", data="rank.test",
+             input_model=str(model), output_result=str(pred_file),
+             verbosity=-1)
+    ref_preds = np.loadtxt(pred_file)
+
+    from lightgbm_tpu.core.parser import parse_file_to_matrix
+    bst = lgb.Booster(model_file=str(model))
+    n_feat = bst.gbdt.max_feature_idx + 1   # libsvm tails under-read
+    X, _ = parse_file_to_matrix(os.path.join(ex, "rank.test"), False,
+                                n_feat)
+    ours = bst.predict(X)
+    np.testing.assert_allclose(ours, ref_preds, rtol=1e-5, atol=1e-6)

@@ -85,5 +85,56 @@ def test_swig_round_trip(swig_module, rng, tmp_path):
     acc = float(np.mean((p > 0.5) == y))
     assert acc > 0.9, acc
 
+    # string-array helpers: eval/feature names through the
+    # caller-pre-allocates char** contract (reference .i's StringArray
+    # machinery; ours is the stringBuffers table)
+    W = 128
+    cnt = lib.new_intp()
+    assert lib.LGBM_BoosterGetEvalCounts(booster, cnt) == 0
+    n_eval = lib.intp_value(cnt)
+    assert n_eval >= 1
+    names = lib.new_stringBuffers(n_eval, W)
+    got = lib.new_intp()
+    assert lib.LGBM_BoosterGetEvalNames(booster, got,
+                                        lib.stringBuffers_ptr(names)) == 0
+    assert lib.intp_value(got) == n_eval
+    evals = [lib.stringBuffers_getitem(names, i) for i in range(n_eval)]
+    assert "binary_logloss" in evals, evals
+    # out-of-range access is bounds-checked, not memory-unsafe
+    assert lib.stringBuffers_getitem(names, n_eval) is None
+    assert lib.stringBuffers_getitem(names, -1) is None
+    lib.delete_stringBuffers(names)
+
+    fnames = lib.new_stringBuffers(f, W)
+    assert lib.LGBM_BoosterGetFeatureNames(
+        booster, got, lib.stringBuffers_ptr(fnames)) == 0
+    assert lib.intp_value(got) == f
+    feats = [lib.stringBuffers_getitem(fnames, i) for i in range(f)]
+    assert feats == [f"Column_{i}" for i in range(f)], feats
+    lib.delete_stringBuffers(fnames)
+
     assert lib.LGBM_BoosterFree(booster) == 0
+
+    # writable direction: rename dataset features through the same table
+    # (width stored at allocation; oversize values truncate safely)
+    custom = lib.new_stringBuffers(f, 8)
+    for i in range(f):
+        lib.stringBuffers_setitem(custom, i, f"feat_{i}" + "x" * 40)
+    assert lib.LGBM_DatasetSetFeatureNames(
+        ds, lib.stringBuffers_ptr(custom), f) == 0
+    back = lib.new_stringBuffers(f, W)
+    nf = lib.new_intp()
+    assert lib.LGBM_DatasetGetFeatureNames(
+        ds, lib.stringBuffers_ptr(back), nf) == 0
+    assert lib.intp_value(nf) == f
+    assert [lib.stringBuffers_getitem(back, i)
+            for i in range(f)] == [(f"feat_{i}" + "x" * 40)[:7]
+                                   for i in range(f)]
+    lib.delete_stringBuffers(custom)
+    lib.delete_stringBuffers(back)
+
+    # degenerate allocations are rejected, not corrupted
+    assert lib.new_stringBuffers(0, W) is None
+    assert lib.new_stringBuffers(4, 0) is None
+
     assert lib.LGBM_DatasetFree(ds) == 0
