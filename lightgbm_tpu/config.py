@@ -169,8 +169,11 @@ _PARAMS: Dict[str, _P] = {
     "gpu_use_dp": _P(False),
     # -- tpu-specific (new in this framework) --
     "tpu_histogram_backend": _P("auto"),   # auto | onehot | pallas
-    "tpu_tree_impl": _P("auto"),           # auto | fused | segment
+    "tpu_tree_impl": _P("auto"),           # auto | fused | segment | frontier
     "tpu_row_chunk": _P(0),                # 0 = auto-pick row chunk for histogram scan
+    # frontier impl: leaves batched per growth round (0 = auto: fill the
+    # 128-wide MXU tile, 8 channels x 16 leaves); 1 = strict best-first
+    "tpu_frontier_width": _P(0),
     "tpu_double_precision": _P(False),     # accumulate histograms in f64-equivalent
 }
 

@@ -320,14 +320,19 @@ class GBDT:
         # forced splits and CEGB-lazy are fused-grower features
         self._use_segment = (backend == "pallas" and impl != "fused"
                              and not forced_plan and not use_cegb_lazy)
-        if impl == "segment" and not self._use_segment:
+        if impl in ("segment", "frontier") and not self._use_segment:
             if parallel:
-                log_warning("tpu_tree_impl=segment is unavailable for the "
+                log_warning(f"tpu_tree_impl={impl} is unavailable for the "
                             "feature/voting learners; using the fused "
                             "grower")
             else:
-                log_warning("tpu_tree_impl=segment requires the pallas "
-                            "histogram backend; using the fused grower")
+                log_warning(f"tpu_tree_impl={impl} requires the pallas "
+                            "histogram backend (and no forced splits / "
+                            "CEGB-lazy); using the fused grower")
+        elif impl == "frontier" and parallel:
+            log_warning("tpu_tree_impl=frontier is serial-only for now; "
+                        "the distributed learners use the strict segment "
+                        "grower")
         if parallel and self._use_segment:
             from ..parallel.learners import make_data_parallel_segment_grower
             bundle = train_set.bundle
@@ -353,6 +358,26 @@ class GBDT:
                             else None),
                 column_bins=train_set.column_bins)
             self._mesh = mesh
+        elif self._use_segment and impl == "frontier":
+            # batched best-first: K splits per round, one K-leaf batched
+            # histogram kernel whose matmul output fills the 128-wide MXU
+            # tile (grower_frontier.py); opt-in — trees can differ
+            # slightly from strict best-first when K > 1
+            from ..ops.pallas_histogram import frontier_width
+            from .grower_frontier import make_grow_tree_frontier
+            if cfg.tpu_frontier_width > 0:
+                k = cfg.tpu_frontier_width
+            else:
+                # auto width: cap the batch at ~L/16 (rounded up) so
+                # small trees stay near strict best-first (K=16 on a
+                # 31-leaf tree is level-wise growth and measurably hurts
+                # fit) while 255-leaf benchmark trees get the full
+                # 16-leaf / 128-channel MXU tile
+                k = min(frontier_width(train_set.num_columns,
+                                       self.num_bins),
+                        max(1, -(-max(2, cfg.num_leaves) // 16)))
+            self._grow_fn = make_grow_tree_frontier(
+                self.num_bins, self.grower_params, rb, batch_k=k)
         elif self._use_segment and impl in ("auto", "segment"):
             from .grower_seg import make_grow_tree_segment
             self._grow_fn = make_grow_tree_segment(

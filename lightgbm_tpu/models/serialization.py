@@ -119,14 +119,19 @@ def save_model_to_string(gbdt, config: Config, num_iteration: int = -1,
     lines.append("feature_infos=" + " ".join(_feature_infos_strings(gbdt)))
 
     def _tree_for_save(i: int):
-        """Boost-from-average is a bias folded into the FIRST iteration's
-        leaves (gbdt.cpp:503 AddBias, shrinkage forced to 1.0), so the
-        model file is self-contained and the reference CLI reads it
-        back bit-identically; in memory the bias stays separate
-        (GBDT.init_scores) and is added at predict time."""
+        """Boost-from-average is a bias folded into the FIRST SAVED
+        iteration's leaves (gbdt.cpp:503 AddBias, shrinkage forced to
+        1.0), so the model file is self-contained and the reference CLI
+        reads it back bit-identically; in memory the bias stays separate
+        (GBDT.init_scores) and is added at predict time.  Sliced saves
+        (start_iteration > 0) fold into their own first iteration too:
+        every file reproduces "its trees + the init score", matching
+        what predicting with the in-memory booster over those iterations
+        returns."""
         t = gbdt.models[i]
-        init = (gbdt.init_scores[i] if start_iteration == 0 and i < C
-                and i < len(gbdt.init_scores) else 0.0)
+        first_saved = (i - start_iteration * C) < C
+        init = (gbdt.init_scores[i % C] if first_saved
+                and (i % C) < len(gbdt.init_scores) else 0.0)
         if abs(init) < 1e-35:
             return t
         import copy

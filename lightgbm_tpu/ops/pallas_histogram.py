@@ -371,6 +371,148 @@ def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
                           n_blocks, target_leaf)
 
 
+_FRONTIER_K = 16   # leaves per batched kernel call: 8 channels x 16 = 128
+
+
+def frontier_width(num_features: int, num_bins: int) -> int:
+    """Batched-frontier width K for this shape: 8*K output channels fill
+    the 128-wide MXU tile at K=16; shrink K when the [F*B, 8K] f32
+    accumulator would blow the VMEM budget (wide-bin datasets)."""
+    F4 = -(-num_features // 4) * 4
+    k = _FRONTIER_K
+    while k > 1 and F4 * num_bins * NUM_CHANNELS * k * 4 > 6 * 1024 * 1024:
+        k //= 2
+    return k
+
+
+def _kernel_frontier(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref, *,
+                     num_bins, K, packed4):
+    """K-leaf batched histogram: one [F*B, 8K] accumulator, the one-hot
+    matmul's output dim carries K leaves' channel sets — the structural
+    fix for the 8-wide output that capped MXU utilization at ~6%
+    (PERF_NOTES round 3): 8*K = 128 fills the MXU lane tile.
+
+    sref layout: [2 + K + n_grid] i32 =
+      (n_blocks, pad, targets[K], block_list[n_grid]) — ``block_list``
+    holds the union of the K leaves' confinement blocks, so DMA is
+    proportional to the union, not to N and not to K separate interval
+    scans (siblings share blocks; after compaction the union is small).
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(i < sref[0])
+    def _():
+        def wfn(c, chunk):
+            wc = w_ref[:, pl.ds(c * chunk, chunk)]          # [8, chunk]
+            lc = lid_ref[:, pl.ds(c * chunk, chunk)]        # [1, chunk]
+            # [K, chunk] leaf masks -> [K, 8, chunk] -> [8K, chunk]
+            targets = sref[2:2 + K]
+            masks = (lc == targets[:, None]).astype(jnp.bfloat16)
+            wk = masks[:, None, :] * wc[None, :, :]
+            return wk.reshape(K * NUM_CHANNELS, chunk)
+
+        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block_rows", "grid_blocks",
+                                    "K", "interpret", "packed4"))
+def _histogram_frontier_fixed(binsT: jax.Array, w8: jax.Array,
+                              leaf_id: jax.Array, block_list: jax.Array,
+                              n_blocks: jax.Array, targets: jax.Array,
+                              num_bins: int, block_rows: int,
+                              grid_blocks: int, K: int,
+                              interpret: bool | None = None,
+                              packed4: bool = False) -> jax.Array:
+    F, n = binsT.shape
+    F_log = 2 * F if packed4 else F
+    if interpret is None:
+        interpret = _interpret_default()
+    max_blocks = n // block_rows
+    bl = jnp.pad(block_list.astype(jnp.int32),
+                 (0, max(0, grid_blocks - block_list.shape[0])))[:grid_blocks]
+    scalars = jnp.concatenate([
+        jnp.stack([n_blocks.astype(jnp.int32), jnp.int32(0)]),
+        targets.astype(jnp.int32), bl])
+
+    def im_data(i, s):
+        # out-of-range grid steps re-read the last in-range block (no new
+        # DMA); pl.when skips their compute
+        idx = jnp.minimum(i, jnp.maximum(s[0] - 1, 0))
+        return (0, jnp.minimum(s[2 + K + idx], max_blocks - 1))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(grid_blocks,),
+        in_specs=[
+            pl.BlockSpec((F, block_rows), im_data),
+            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((1, block_rows), im_data),
+        ],
+        out_specs=pl.BlockSpec((F_log * num_bins, K * NUM_CHANNELS),
+                               lambda i, s: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, K * NUM_CHANNELS),
+                                   jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel_frontier, num_bins=num_bins, K=K,
+                          packed4=packed4),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, K * NUM_CHANNELS),
+                                       jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(scalars, binsT, w8, leaf_id.reshape(1, -1))
+    # [F*B, K*8] -> [K, F, B, 8]
+    return out.reshape(F_log, num_bins, K, NUM_CHANNELS).transpose(
+        2, 0, 1, 3)
+
+
+def histogram_frontier(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
+                       block_list: jax.Array, n_blocks: jax.Array,
+                       targets: jax.Array, num_bins: int,
+                       block_rows: int = 0,
+                       interpret: bool | None = None,
+                       packed4: bool = False) -> jax.Array:
+    """Histograms of K frontier leaves in ONE kernel pass.
+
+    ``block_list`` [M] i32 lists the row blocks to scan (union of the K
+    leaves' confinement intervals; entries past ``n_blocks`` are ignored);
+    ``targets`` [K] i32 are the leaf ids (-1 entries produce zero
+    histograms — masks never match, since real leaf ids are >= 0).
+    Returns [K, F, B, 8] (logical features when ``packed4``).
+    """
+    F, n = binsT.shape
+    K = int(targets.shape[0])
+    if block_rows <= 0:
+        block_rows = pick_block_rows(2 * F if packed4 else F, num_bins)
+    assert n % block_rows == 0, (n, block_rows)
+    max_blocks = n // block_rows
+    cap = min(int(block_list.shape[0]), max_blocks)
+    buckets = _segment_buckets(cap)
+    n_blocks = jnp.asarray(n_blocks, jnp.int32)
+    if len(buckets) == 1:
+        return _histogram_frontier_fixed(
+            binsT, w8, leaf_id, block_list, n_blocks, targets, num_bins,
+            block_rows, buckets[0], K, interpret, packed4)
+    idx = jnp.sum(jnp.asarray(buckets, jnp.int32) < n_blocks)
+    branches = [
+        (lambda gb: lambda b, w, l, bl, nb, tg: _histogram_frontier_fixed(
+            b, w, l, bl, nb, tg, num_bins, block_rows, gb, K, interpret,
+            packed4))(gb)
+        for gb in buckets
+    ]
+    return jax.lax.switch(idx, branches, binsT, w8, leaf_id, block_list,
+                          n_blocks, targets)
+
+
 def leaf_histogram_pallas(binsT: jax.Array, grad: jax.Array,
                           hess: jax.Array, member: jax.Array,
                           num_bins: int, block_rows: int = 0,

@@ -75,5 +75,13 @@ def exists(path: str) -> bool:
             return True
     except LightGBMError:
         raise
-    except Exception:
+    except (FileNotFoundError, KeyError, IndexError):
+        return False                 # not-found-shaped: quietly missing
+    except Exception as e:
+        # auth/network failures must not masquerade silently as a
+        # missing file — report what actually happened, then treat as
+        # missing so the caller's diagnostic still names the path
+        from .log import log_warning
+        log_warning(f"treating {path} as missing after "
+                    f"{type(e).__name__}: {e}")
         return False

@@ -1,0 +1,102 @@
+"""Frontier-batched grower (models/grower_frontier.py).
+
+K=1 must reproduce the strict best-first segment tree exactly; K>1 is
+"batched best-first" — same locally-greedy family, trees may differ
+slightly, so quality (not structure) is asserted.  The K-leaf batched
+kernel itself is pinned against per-leaf scans in test_pallas.py.
+"""
+
+import numpy as np
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.dataset import TpuDataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objective import create_objective
+
+
+def _train(X, y, impl, n_iters=3, **params):
+    cfg = Config(verbosity=-1, tpu_histogram_backend="pallas",
+                 tpu_tree_impl=impl, **params)
+    ds = TpuDataset.from_numpy(X, y, config=cfg)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    bst = GBDT(cfg, ds, obj)
+    for _ in range(n_iters):
+        bst.train_one_iter()
+    return bst
+
+
+def test_frontier_k1_matches_segment_exactly(rng):
+    """With a 1-leaf batch every round is one strict best-first split, so
+    the trees must be identical."""
+    n = 2500
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] - 0.3 * X[:, 2] ** 2
+         + rng.normal(size=n) * 0.2 > 0).astype(np.float64)
+    seg = _train(X, y, "segment", objective="binary", num_leaves=15,
+                 min_data_in_leaf=5, tpu_row_chunk=256)
+    fro = _train(X, y, "frontier", objective="binary", num_leaves=15,
+                 min_data_in_leaf=5, tpu_row_chunk=256,
+                 tpu_frontier_width=1)
+    assert len(seg.models) == len(fro.models)
+    for i, (ts, tf) in enumerate(zip(seg.models, fro.models)):
+        assert ts.num_leaves == tf.num_leaves, f"tree {i}"
+        nsp = ts.num_leaves - 1
+        assert np.array_equal(ts.split_feature[:nsp],
+                              tf.split_feature[:nsp]), f"tree {i}"
+        assert np.array_equal(ts.threshold_in_bin[:nsp],
+                              tf.threshold_in_bin[:nsp]), f"tree {i}"
+        np.testing.assert_allclose(ts.leaf_value[:ts.num_leaves],
+                                   tf.leaf_value[:tf.num_leaves],
+                                   rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(seg._raw_predict(X), fro._raw_predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_frontier_batched_quality(rng):
+    """K=4 batched rounds: the tree fills its leaf budget, every split is
+    locally optimal, and fit quality matches strict best-first closely."""
+    n = 4000
+    X = rng.normal(size=(n, 8))
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 2) + (X[:, 2] > 0.5)
+         + rng.normal(size=n) * 0.1)
+    seg = _train(X, y, "segment", objective="regression", num_leaves=31,
+                 min_data_in_leaf=5, tpu_row_chunk=256, n_iters=10,
+                 learning_rate=0.3)
+    fro = _train(X, y, "frontier", objective="regression", num_leaves=31,
+                 min_data_in_leaf=5, tpu_row_chunk=256,
+                 tpu_frontier_width=4, n_iters=10, learning_rate=0.3)
+    assert fro.models[0].num_leaves == 31
+    mse_seg = float(np.mean((seg._raw_predict(X).ravel() - y) ** 2))
+    mse_fro = float(np.mean((fro._raw_predict(X).ravel() - y) ** 2))
+    assert mse_fro < mse_seg * 1.15, (mse_fro, mse_seg)
+    assert mse_fro < 0.1 * y.var()
+
+
+def test_frontier_respects_leaf_budget_and_gain_floor(rng):
+    """A round near the leaf budget must not overshoot num_leaves, and a
+    separable-in-one-split target stops early (gain prefix logic)."""
+    n = 1200
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] > 0).astype(np.float64)      # one split suffices
+    fro = _train(X, y, "frontier", objective="regression", num_leaves=12,
+                 min_data_in_leaf=5, min_gain_to_split=1e-3,
+                 tpu_row_chunk=256, tpu_frontier_width=8, n_iters=1)
+    t = fro.models[0]
+    assert t.num_leaves <= 12
+    # the dominant first split must be on feature 0
+    assert t.split_feature[0] == 0
+
+
+def test_frontier_binary_accuracy_default_width(rng):
+    """Auto width caps K at ~num_leaves/16, so a 31-leaf tree batches
+    only 1-2 leaves per round and fit stays at strict-best-first level."""
+    n = 3000
+    X = rng.normal(size=(n, 10))
+    logit = 2 * X[:, 0] + X[:, 1] - X[:, 2] * X[:, 3]
+    y = (logit + rng.normal(size=n) * 0.3 > 0).astype(np.float64)
+    fro = _train(X, y, "frontier", objective="binary", num_leaves=31,
+                 min_data_in_leaf=5, tpu_row_chunk=256, n_iters=8)
+    p = 1.0 / (1.0 + np.exp(-fro._raw_predict(X).ravel()))
+    acc = float(np.mean((p > 0.5) == y))
+    assert acc > 0.92, acc
