@@ -106,7 +106,8 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
 
     cfg = Config(objective="binary", num_leaves=NUM_LEAVES, max_bin=MAX_BIN,
                  learning_rate=0.1, min_sum_hessian_in_leaf=100.0,
-                 verbosity=-1)
+                 verbosity=-1,
+                 tpu_tree_impl=os.environ.get("LIGHTGBM_TPU_IMPL", "auto"))
     t0 = time.time()
     ds = TpuDataset.from_numpy(X, y, config=cfg)
     t_bin = time.time() - t0

@@ -31,6 +31,21 @@ from .grower import (GrowerParams, _pack_tree_device, fetch_tree_arrays,
 from .tree import Tree
 
 
+def _auto_frontier_k(cfg, num_columns: int, num_bins: int) -> int:
+    """Frontier batch width: explicit tpu_frontier_width wins; the auto
+    width caps the batch at ~num_leaves/16 (rounded up) so small trees
+    stay near strict best-first (K=16 on a 31-leaf tree is level-wise
+    growth and measurably hurts fit) while 255-leaf benchmark trees get
+    the full 16-leaf / 128-channel MXU tile.  Shared by the serial and
+    data-parallel frontier learners so they always grow the same-width
+    frontier."""
+    if cfg.tpu_frontier_width > 0:
+        return cfg.tpu_frontier_width
+    from ..ops.pallas_histogram import frontier_width
+    return min(frontier_width(num_columns, num_bins),
+               max(1, -(-max(2, cfg.num_leaves) // 16)))
+
+
 def _round_up_pow2(x: int) -> int:
     return 1 << max(0, (x - 1).bit_length())
 
@@ -329,11 +344,18 @@ class GBDT:
                 log_warning(f"tpu_tree_impl={impl} requires the pallas "
                             "histogram backend (and no forced splits / "
                             "CEGB-lazy); using the fused grower")
-        elif impl == "frontier" and parallel:
-            log_warning("tpu_tree_impl=frontier is serial-only for now; "
-                        "the distributed learners use the strict segment "
-                        "grower")
-        if parallel and self._use_segment:
+        if parallel and self._use_segment and impl == "frontier":
+            from ..parallel.learners import (
+                make_data_parallel_frontier_grower)
+            bundle = train_set.bundle
+            k = _auto_frontier_k(cfg, train_set.num_columns, self.num_bins)
+            self._grow_fn = make_data_parallel_frontier_grower(
+                self.num_bins, self.grower_params, mesh, rb,
+                train_set.num_columns,
+                feat_group=(bundle.feat_group if bundle is not None
+                            else None), batch_k=k)
+            self._mesh = mesh
+        elif parallel and self._use_segment:
             from ..parallel.learners import make_data_parallel_segment_grower
             bundle = train_set.bundle
             self._grow_fn = make_data_parallel_segment_grower(
@@ -363,21 +385,11 @@ class GBDT:
             # histogram kernel whose matmul output fills the 128-wide MXU
             # tile (grower_frontier.py); opt-in — trees can differ
             # slightly from strict best-first when K > 1
-            from ..ops.pallas_histogram import frontier_width
             from .grower_frontier import make_grow_tree_frontier
-            if cfg.tpu_frontier_width > 0:
-                k = cfg.tpu_frontier_width
-            else:
-                # auto width: cap the batch at ~L/16 (rounded up) so
-                # small trees stay near strict best-first (K=16 on a
-                # 31-leaf tree is level-wise growth and measurably hurts
-                # fit) while 255-leaf benchmark trees get the full
-                # 16-leaf / 128-channel MXU tile
-                k = min(frontier_width(train_set.num_columns,
-                                       self.num_bins),
-                        max(1, -(-max(2, cfg.num_leaves) // 16)))
             self._grow_fn = make_grow_tree_frontier(
-                self.num_bins, self.grower_params, rb, batch_k=k)
+                self.num_bins, self.grower_params, rb,
+                batch_k=_auto_frontier_k(cfg, train_set.num_columns,
+                                         self.num_bins))
         elif self._use_segment and impl in ("auto", "segment"):
             from .grower_seg import make_grow_tree_segment
             self._grow_fn = make_grow_tree_segment(

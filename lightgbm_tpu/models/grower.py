@@ -313,6 +313,12 @@ class CommHooks(NamedTuple):
     shard_feature_mask: object = None
     no_subtract: bool = False
     column_block: object = None
+    # frontier-batched grower (grower_frontier.py) variants: the same
+    # reductions over a whole K-leaf batch in one collective —
+    # ``reduce_hist_batch([K, G, B, 3])`` and ``merge_split_batch(infos,
+    # gains)`` with a leading batch axis on every SplitInfo field
+    reduce_hist_batch: object = None
+    merge_split_batch: object = None
 
 
 def make_grow_tree(num_bins: int, params: GrowerParams,

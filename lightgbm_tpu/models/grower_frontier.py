@@ -22,9 +22,11 @@ grower.  The reference has no equivalent switch: its GPU learner
 (src/treelearner/gpu_tree_learner.cpp) keeps strict leaf-wise order and
 pays per-leaf kernel launches instead.
 
-Serial learner only for now; the distributed learners keep the strict
-segment grower (a psum_scatter of the [K, G, B, 3] batch is the natural
-extension and is left for the next round).
+Distributed: parallel/learners.make_data_parallel_frontier_grower runs
+this grower under shard_map — rows sharded, the whole [K, G, B, 3] batch
+reduce-scattered in ONE collective per round (K x fewer collective
+launches than the strict grower), and all 2K children's SplitInfos
+merged in one all_gather.
 """
 
 from __future__ import annotations
@@ -49,17 +51,25 @@ from .grower_seg import (COMPACT_WASTE, _SegState, _pack_bins_words,
 
 
 def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
-                            block_rows: int, batch_k: int = 0):
+                            block_rows: int, batch_k: int = 0,
+                            comm=None, wrap=None):
     """Build the jitted frontier-batched grower.
 
     Same call contract as make_grow_tree_segment:
     ``grow(binsT, grad, hess, member, fmeta, feature_mask, key)`` ->
     ``(TreeArrays, leaf_id_original_order)``.
+
+    ``comm`` (CommHooks) makes this the data-parallel learner's core
+    under shard_map: ``reduce_hist_batch`` reduce-scatters the whole
+    [K, G, B, 3] batch in one collective, ``merge_split_batch`` merges
+    all 2K children's SplitInfos by max gain in one all_gather.
     """
+    from .grower import CommHooks
     p = params
     L = p.num_leaves
     B = num_bins
     rb = block_rows
+    comm = comm or CommHooks()
     K = batch_k or frontier_width(
         p.num_columns or 64, B)
     K = max(1, min(K, L - 1))
@@ -67,6 +77,8 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
     def _one_scan(st, hist, g, h, c, depth, fmeta, fmask, key, step,
                   lo, hi):
         fmask_node = _node_feature_mask(fmask, key, step, p)
+        if comm.shard_feature_mask is not None:
+            fmask_node = comm.shard_feature_mask(fmask_node)
         adjust = None
         if p.cegb_penalty_split > 0.0 or p.use_cegb_coupled:
             from .grower import _cegb_split_coupled_adjust
@@ -132,6 +144,9 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         G0 = jnp.sum(grad * member)
         H0 = jnp.sum(hess * member)
         C0 = jnp.sum(member)
+        if comm.reduce_stats is not None:
+            G0, H0, C0 = (comm.reduce_stats(G0), comm.reduce_stats(H0),
+                          comm.reduce_stats(C0))
         all_blocks = jnp.arange(max_blocks, dtype=jnp.int32)
 
         def hist_batch(st: _SegState, targets, block_list, n_blocks):
@@ -139,7 +154,10 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             out = histogram_frontier(st.binsT, st.w8, st.leaf_id,
                                      block_list, n_blocks, targets, B, rb,
                                      packed4=p.packed4)
-            return unpack_hist(out[:, :G_cols])
+            h = unpack_hist(out[:, :G_cols])
+            if comm.reduce_hist_batch is not None:
+                h = comm.reduce_hist_batch(h)
+            return h
 
         def apply_split(st: _SegState, leaf, new_leaf, node):
             """Routing + tree-array bookkeeping for ONE split (the cheap
@@ -314,6 +332,8 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                     blo, bhi)
             )(hists2, g2, h2, c2, depth2, steps2,
               st.leaf_mono_lo[safe], st.leaf_mono_hi[safe])
+            if comm.merge_split_batch is not None:
+                infos, gains = comm.merge_split_batch(infos, gains)
             st = _write_scans(st, leaves2, infos, gains)
 
             # 5) adaptive compaction, same rule as the strict grower
@@ -381,9 +401,11 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         info0, gain0 = _one_scan(st, root_hist, G0, H0, C0, jnp.int32(0),
                                  fmeta, feature_mask, key, 2 * L,
                                  st.leaf_mono_lo[0], st.leaf_mono_hi[0])
-        st = _write_scans(st, jnp.asarray([0], jnp.int32),
-                          jax.tree_util.tree_map(lambda x: x[None], info0),
-                          gain0[None])
+        infos0 = jax.tree_util.tree_map(lambda x: x[None], info0)
+        gains0 = gain0[None]
+        if comm.merge_split_batch is not None:
+            infos0, gains0 = comm.merge_split_batch(infos0, gains0)
+        st = _write_scans(st, jnp.asarray([0], jnp.int32), infos0, gains0)
 
         def cond(st):
             return (st.num_leaves < L) & (jnp.max(st.best_f32[:, 0]) > 0.0)
@@ -398,4 +420,6 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         leaf_id_orig = jnp.zeros(n, jnp.int32).at[st.order].set(st.leaf_id)
         return st.tree, leaf_id_orig
 
+    if wrap is not None:
+        return wrap(grow)
     return jax.jit(grow)

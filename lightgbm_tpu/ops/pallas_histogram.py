@@ -272,12 +272,18 @@ def _segment_buckets(max_blocks: int) -> list:
     tree splits (intervals of a few blocks under a 300+-step grid burned
     >1s/iter at 10.5M rows).  Instead the caller lax.switches between a
     few size variants and runs the smallest one that covers the interval.
+
+    Every variant is a separate Mosaic compile on the backend, so the
+    ladder step trades per-iter skipped-step waste against remote-compile
+    warmup; LIGHTGBM_TPU_BUCKET_STEP (default 8) tunes it on-chip.
     """
+    import os
+    step = max(2, int(os.environ.get("LIGHTGBM_TPU_BUCKET_STEP", "8")))
     buckets = []
     b = max_blocks
     while b > 1:
         buckets.append(b)
-        b = max(1, b // 8)
+        b = max(1, b // step)
     buckets.append(1)
     return sorted(set(buckets))
 

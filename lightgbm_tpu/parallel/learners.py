@@ -138,6 +138,9 @@ def _log_collective_estimate(mode: str, D: int, num_columns: int,
         "data": hist_bytes,                # psum_scatter (reduce-scatter)
         "data_allreduce": 2 * hist_bytes,  # full-hist psum fallback
         "data_segment": hist_bytes,        # psum_scatter (reduce-scatter)
+        # same total bytes as data_segment, but one K-batched launch per
+        # round instead of one per split — K x fewer collectives
+        "data_frontier": hist_bytes,
         "voting": 2 * hist_bytes * min(1.0, 2 * top_k / max(num_columns, 1))
         + num_columns * 4,                 # elected slices + vote psum
         "feature": 0,                      # scan-only; no hist crosses
@@ -303,6 +306,26 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
     return make_grow_tree(num_bins, params, comm=comm, wrap=wrap)
 
 
+def _stripe_setup(mesh: Mesh, num_columns: int, feat_group):
+    """Shared data-parallel stripe scaffolding: (axis, D, Gpad, per,
+    shard_mask, wrap-in/out specs).  Both the strict segment learner and
+    the frontier learner shard rows on the mesh axis and own one
+    contiguous reduced column stripe each."""
+    axis = mesh.axis_names[0]
+    D = int(mesh.devices.size)
+    Gpad = -(-num_columns // D) * D
+    per = Gpad // D
+
+    def shard_mask(fmask):
+        return _stripe_feature_mask(fmask, axis,
+                                    lax.axis_index(axis) * per, per,
+                                    feat_group)
+
+    in_specs = (P(None, axis), P(axis), P(axis), P(axis), P(), P(), P())
+    out_specs = (P(), P(axis))
+    return axis, D, Gpad, per, shard_mask, in_specs, out_specs
+
+
 def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
                                       mesh: Mesh, block_rows: int,
                                       num_columns: int, feat_group=None):
@@ -324,11 +347,9 @@ def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
     """
     from ..models.grower_seg import make_grow_tree_segment
 
-    axis = mesh.axis_names[0]
-    D = int(mesh.devices.size)
     G = num_columns
-    Gpad = -(-G // D) * D
-    per = Gpad // D
+    axis, D, Gpad, per, shard_mask, in_specs, out_specs = _stripe_setup(
+        mesh, G, feat_group)
 
     def reduce_hist(h, *_):
         # [G, B, 3] per-shard partials -> reduced COLUMN stripe per shard,
@@ -341,21 +362,12 @@ def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
         out = lax.dynamic_update_slice(out, mine, (me * per, 0, 0))
         return out[:G]
 
-    def shard_mask(fmask):
-        # a shard scans the features whose COLUMN lies in its stripe
-        return _stripe_feature_mask(fmask, axis,
-                                    lax.axis_index(axis) * per, per,
-                                    feat_group)
-
     comm = CommHooks(
         reduce_hist=reduce_hist,
         reduce_stats=lambda x: lax.psum(x, axis),
         merge_split=lambda info, gain: _merge_split_by_gain(info, gain,
                                                             axis),
         shard_feature_mask=shard_mask)
-
-    in_specs = (P(None, axis), P(axis), P(axis), P(axis), P(), P(), P())
-    out_specs = (P(), P(axis))
 
     def wrap(grow):
         return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
@@ -364,3 +376,60 @@ def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
                              params.num_leaves)
     return make_grow_tree_segment(num_bins, params, block_rows, comm=comm,
                                   wrap=wrap)
+
+
+def make_data_parallel_frontier_grower(num_bins: int, params: GrowerParams,
+                                       mesh: Mesh, block_rows: int,
+                                       num_columns: int, feat_group=None,
+                                       batch_k: int = 0):
+    """Data-parallel frontier-batched learner: the K-splits-per-round
+    grower (models/grower_frontier.py) under shard_map.
+
+    Same wire pattern as the strict data-parallel segment learner —
+    psum_scatter column stripes, stripe-masked scans, max-gain SplitInfo
+    merge — but one collective carries the WHOLE [K, G, B, 3] round batch
+    and one all_gather merges all 2K children's SplitInfos: K x fewer
+    collective launches per tree, which matters on a latency-bound
+    interconnect exactly the way the batched matmul matters on the MXU.
+    """
+    from ..models.grower import CommHooks
+    from ..models.grower_frontier import make_grow_tree_frontier
+
+    G = num_columns
+    axis, D, Gpad, per, shard_mask, in_specs, out_specs = _stripe_setup(
+        mesh, G, feat_group)
+
+    def reduce_hist_batch(h):
+        # [K, G, B, 3] per-shard partials -> each shard owns the reduced
+        # [K, stripe, B, 3] of one contiguous column stripe, placed back
+        # at its offset (zeros elsewhere; stripe masks hide them)
+        hp = jnp.pad(h, ((0, 0), (0, Gpad - G), (0, 0), (0, 0)))
+        mine = lax.psum_scatter(hp, axis, scatter_dimension=1, tiled=True)
+        me = lax.axis_index(axis)
+        out = jnp.zeros_like(hp)
+        out = lax.dynamic_update_slice(out, mine, (0, me * per, 0, 0))
+        return out[:, :G]
+
+    def merge_split_batch(infos, gains):
+        # [2K] per-child SplitInfos -> per-child global best by gain
+        # (SyncUpGlobalBestSplit batched over the round)
+        gall = lax.all_gather(gains, axis)              # [D, 2K]
+        winner = jnp.argmax(gall, axis=0)               # [2K]
+        pick = jnp.arange(gains.shape[0])
+        merged = SplitInfo(*[lax.all_gather(f, axis)[winner, pick]
+                             for f in infos])
+        return merged, gall[winner, pick]
+
+    comm = CommHooks(
+        reduce_stats=lambda x: lax.psum(x, axis),
+        shard_feature_mask=shard_mask,
+        reduce_hist_batch=reduce_hist_batch,
+        merge_split_batch=merge_split_batch)
+
+    def wrap(grow):
+        return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
+
+    _log_collective_estimate("data_frontier", D, G, num_bins,
+                             params.num_leaves)
+    return make_grow_tree_frontier(num_bins, params, block_rows,
+                                   batch_k=batch_k, comm=comm, wrap=wrap)

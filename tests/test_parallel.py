@@ -215,3 +215,19 @@ def test_feature_parallel_skewed_bundles(rng):
         "EFB must bundle the sparse block or this test covers nothing"
     np.testing.assert_allclose(serial.predict(X), feat.predict(X),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_data_parallel_frontier_matches_serial_frontier(rng):
+    """Frontier grower under shard_map (rows sharded, one reduce-scatter
+    per K-leaf round) == serial frontier grower, same batch width."""
+    X, y = make_data(rng, n=2600, f=7)
+    serial = _train(X, y, "serial", tpu_histogram_backend="pallas",
+                    tpu_tree_impl="frontier", tpu_row_chunk=128,
+                    tpu_frontier_width=4)
+    data = _train(X, y, "data", tpu_histogram_backend="pallas",
+                  tpu_tree_impl="frontier", tpu_row_chunk=128,
+                  tpu_frontier_width=4)
+    np.testing.assert_allclose(serial.predict(X), data.predict(X),
+                               rtol=1e-3, atol=1e-4)
+    for ts, td in zip(serial.gbdt.models, data.gbdt.models):
+        assert ts.num_leaves == td.num_leaves

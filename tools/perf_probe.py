@@ -27,9 +27,12 @@ def run(n_rows: int, num_leaves: int, warmup: int, measure: int) -> None:
     X = rng.normal(size=(n_rows, 28)).astype(np.float32)
     logit = 2.0 * X[:, 0] + X[:, 1] - X[:, 2] * X[:, 3]
     y = (logit + rng.normal(size=n_rows) * 0.5 > 0).astype(np.float64)
+    # LIGHTGBM_TPU_IMPL=segment|frontier|fused switches the grower for
+    # on-chip A/B runs (frontier is the batched-MXU candidate)
+    impl = os.environ.get("LIGHTGBM_TPU_IMPL", "auto")
     cfg = Config(objective="binary", num_leaves=num_leaves, max_bin=63,
                  learning_rate=0.1, min_sum_hessian_in_leaf=100.0,
-                 verbosity=-1)
+                 verbosity=-1, tpu_tree_impl=impl)
     ds = TpuDataset.from_numpy(X, y, config=cfg)
     obj = create_objective(cfg)
     obj.init(ds.metadata, ds.num_data)
