@@ -100,3 +100,25 @@ def test_frontier_binary_accuracy_default_width(rng):
     p = 1.0 / (1.0 + np.exp(-fro._raw_predict(X).ravel()))
     acc = float(np.mean((p > 0.5) == y))
     assert acc > 0.92, acc
+
+
+def test_frontier_with_efb_bundles(rng):
+    """Frontier grower over an EFB-bundled dataset: group-space batched
+    histograms expand to feature space in the scan, and split application
+    maps features back to physical columns."""
+    n, width, blocks = 2000, 8, 5
+    X = np.zeros((n, width * blocks))
+    picks = rng.randint(0, width, size=(n, blocks))
+    for b in range(blocks):
+        X[np.arange(n), b * width + picks[:, b]] = rng.normal(2, 1, n)
+    y = (X[:, :width].sum(1) - X[:, width:2 * width].sum(1)
+         + rng.normal(size=n) * 0.1)
+    seg = _train(X, y, "segment", objective="regression", num_leaves=15,
+                 min_data_in_leaf=5, tpu_row_chunk=256, n_iters=4)
+    fro = _train(X, y, "frontier", objective="regression", num_leaves=15,
+                 min_data_in_leaf=5, tpu_row_chunk=256,
+                 tpu_frontier_width=1, n_iters=4)
+    assert fro.train_set.bundle is not None
+    # K=1 frontier == strict segment even through bundling
+    np.testing.assert_allclose(seg._raw_predict(X), fro._raw_predict(X),
+                               rtol=1e-5, atol=1e-6)

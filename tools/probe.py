@@ -120,6 +120,27 @@ def cmd_micro(argv):
     # sum of smaller-child intervals/tree ~ 10N with default compaction
     timed(mk_hist, "hist_full_N", args, scale=10.0)
 
+    # K=16 frontier kernel over the same full-N pass: the one-hot build
+    # is shared across the 16 output-channel groups, so per-row cost
+    # should approach the strict kernel's (NOT 16x) while producing 16
+    # leaves' histograms — the MXU-utilization fix being measured
+    from lightgbm_tpu.ops.pallas_histogram import histogram_frontier
+    Kf = 16
+    all_blocks = jnp.arange(nblk, dtype=jnp.int32)
+    targets16 = jnp.arange(Kf, dtype=jnp.int32) % 2
+
+    def mk_frontier(reps):
+        def fn(bT, w, lid):
+            def body(i, acc):
+                h = histogram_frontier(bT, w, lid, all_blocks,
+                                       jnp.int32(nblk),
+                                       targets16 + (i % 2), B, rb)
+                return acc + h[0]
+            return lax.fori_loop(0, reps, body,
+                                 jnp.zeros((F4, B, 8), jnp.float32))
+        return fn
+    timed(mk_frontier, f"hist_frontier_K{Kf}_full_N", args, scale=1.0)
+
     def mk_sort(reps):
         def fn(bT, w, lid):
             def body(i, lid_c):
