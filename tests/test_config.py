@@ -78,3 +78,17 @@ def test_default_params_covers_reference_set():
                  "tweedie_variance_power", "label_gain", "eval_at",
                  "num_machines", "gpu_use_dp", "refit_decay_rate"]:
         assert name in p, name
+
+
+def test_parameters_doc_is_current():
+    """docs/PARAMETERS.md is generated from the _PARAMS registry and must
+    be regenerated when the registry changes (the reference keeps
+    docs/Parameters.rst in sync the same way via its generator)."""
+    import subprocess
+    import sys
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "gen_params_doc.py"),
+         "--check"], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -245,3 +245,55 @@ def test_reference_lambdarank_model_matches(ref_cli, tmp_path):
                                 n_feat)
     ours = bst.predict(X)
     np.testing.assert_allclose(ours, ref_preds, rtol=1e-5, atol=1e-6)
+
+
+def test_our_multiclass_model_loads_in_reference(ref_cli, tmp_path):
+    """Our multiclass softmax model file -> reference CLI predict."""
+    import lightgbm_tpu as lgb
+
+    ex = _example("multiclass_classification")
+    data = np.loadtxt(os.path.join(ex, "multiclass.train"), delimiter="\t")
+    X, y = data[:, 1:], data[:, 0]
+    params = {"objective": "multiclass", "num_class": 5, "num_leaves": 31,
+              "verbose": -1, "min_data_in_leaf": 20}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=5,
+                    verbose_eval=False)
+    model = tmp_path / "tpu_mc.txt"
+    bst.save_model(str(model))
+
+    test = np.loadtxt(os.path.join(ex, "multiclass.test"), delimiter="\t")
+    ours = bst.predict(test[:, 1:])
+
+    pred_file = tmp_path / "ref_preds.txt"
+    _run_ref(ref_cli, ex, task="predict", data="multiclass.test",
+             input_model=str(model), output_result=str(pred_file),
+             verbosity=-1)
+    ref_preds = np.loadtxt(pred_file)
+    np.testing.assert_allclose(ref_preds, ours, rtol=1e-5, atol=1e-6)
+
+
+def test_our_lambdarank_model_loads_in_reference(ref_cli, tmp_path):
+    """Our lambdarank model file -> reference CLI predict (raw scores)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.core.parser import parse_file_to_matrix
+
+    ex = _example("lambdarank")
+    X, y = parse_file_to_matrix(os.path.join(ex, "rank.train"), False, 301)
+    groups = np.loadtxt(os.path.join(ex, "rank.train.query"),
+                        dtype=np.int64)
+    params = {"objective": "lambdarank", "num_leaves": 31, "verbose": -1,
+              "min_data_in_leaf": 20}
+    ds = lgb.Dataset(X, y, group=groups)
+    bst = lgb.train(params, ds, num_boost_round=5, verbose_eval=False)
+    model = tmp_path / "tpu_rank.txt"
+    bst.save_model(str(model))
+
+    Xt, _ = parse_file_to_matrix(os.path.join(ex, "rank.test"), False, 301)
+    ours = bst.predict(Xt)
+
+    pred_file = tmp_path / "ref_preds.txt"
+    _run_ref(ref_cli, ex, task="predict", data="rank.test",
+             input_model=str(model), output_result=str(pred_file),
+             verbosity=-1)
+    ref_preds = np.loadtxt(pred_file)
+    np.testing.assert_allclose(ref_preds, ours, rtol=1e-5, atol=1e-6)

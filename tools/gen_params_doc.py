@@ -1,0 +1,101 @@
+"""Generate docs/PARAMETERS.md from the config schema.
+
+The reference generates docs/Parameters.rst + the alias table from
+config.h doc comments via helpers/parameter_generator.py (SURVEY §5);
+here config.py's ``_PARAMS`` registry is the single source of truth and
+this script derives the user-facing parameter reference from it.
+
+Usage: python tools/gen_params_doc.py [--check]
+  --check  exit 1 if docs/PARAMETERS.md is stale (for tests)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SECTIONS = [
+    ("Core", ["config", "task", "objective", "boosting", "data", "valid",
+              "num_iterations", "learning_rate", "num_leaves",
+              "tree_learner", "num_threads", "device_type", "seed"]),
+]
+
+
+def generate() -> str:
+    from lightgbm_tpu.config import _PARAMS
+
+    lines = [
+        "# Parameters",
+        "",
+        "Generated from `lightgbm_tpu/config.py` (`_PARAMS`) by",
+        "`tools/gen_params_doc.py` — do not edit by hand.  The registry is",
+        "the single source of truth for names, aliases, types and",
+        "defaults (the reference generates its docs/Parameters.rst the",
+        "same way from config.h via helpers/parameter_generator.py).",
+        "",
+        "Aliases resolve wherever parameters are accepted: Python dicts,",
+        "`key=value` CLI arguments, and conf files.",
+        "",
+        "| Parameter | Default | Type | Aliases |",
+        "|---|---|---|---|",
+    ]
+    for name, spec in _PARAMS.items():
+        default = spec.default
+        if default == "":
+            default = '`""`'
+        elif isinstance(default, list):
+            default = "`[]`" if not default else f"`{default}`"
+        else:
+            default = f"`{default}`"
+        ptype = spec.ptype.__name__
+        aliases = ", ".join(spec.aliases) if spec.aliases else "—"
+        lines.append(f"| `{name}` | {default} | {ptype} | {aliases} |")
+    lines += [
+        "",
+        f"Total: {len(_PARAMS)} parameters, "
+        f"{sum(len(s.aliases) for s in _PARAMS.values())} aliases.",
+        "",
+        "## TPU-specific parameters",
+        "",
+        "These have no reference equivalent (the `gpu_*` parameters are",
+        "accepted for compatibility but ignored):",
+        "",
+        "- `tpu_histogram_backend` — `auto | onehot | pallas`: histogram",
+        "  implementation; `pallas` is the TPU kernel path, `onehot` the",
+        "  portable XLA fallback.",
+        "- `tpu_tree_impl` — `auto | fused | segment | frontier`: tree",
+        "  grower.  `segment` keeps per-split cost O(leaf) via epoch",
+        "  compaction; `frontier` batches K splits per round into one",
+        "  128-channel MXU kernel pass (batched best-first; K=1 is exactly",
+        "  strict best-first).",
+        "- `tpu_frontier_width` — leaves per frontier round (0 = auto:",
+        "  min(16, ceil(num_leaves/16))).",
+        "- `tpu_row_chunk` — histogram kernel row-block size (0 = auto).",
+        "- `tpu_double_precision` — accumulate histograms in",
+        "  f64-equivalent precision.",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def main():
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "PARAMETERS.md")
+    text = generate()
+    if "--check" in sys.argv:
+        current = (open(out_path).read()
+                   if os.path.exists(out_path) else "")
+        if current != text:
+            print("docs/PARAMETERS.md is stale; regenerate with "
+                  "python tools/gen_params_doc.py")
+            sys.exit(1)
+        print("docs/PARAMETERS.md is current")
+        return
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as fh:
+        fh.write(text)
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()
