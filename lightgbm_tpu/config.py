@@ -174,6 +174,10 @@ _PARAMS: Dict[str, _P] = {
     # frontier impl: leaves batched per growth round (0 = auto: fill the
     # 128-wide MXU tile, 8 channels x 16 leaves); 1 = strict best-first
     "tpu_frontier_width": _P(0),
+    # frontier impl: only batch leaves whose gain >= ratio * round-best
+    # gain — rounds adapt between strict (one dominant leaf) and fully
+    # batched (many comparable leaves); 0.0 = pure top-K
+    "tpu_frontier_gain_ratio": _P(0.2),
     "tpu_double_precision": _P(False),     # accumulate histograms in f64-equivalent
 }
 
@@ -338,6 +342,10 @@ class Config:
             raise ValueError("bagging_fraction must be in (0, 1]")
         if not (0.0 < self.feature_fraction <= 1.0):
             raise ValueError("feature_fraction must be in (0, 1]")
+        if not (0.0 <= self.tpu_frontier_gain_ratio <= 1.0):
+            # > 1.0 would reject every leaf including the round best and
+            # spin the growth loop forever
+            raise ValueError("tpu_frontier_gain_ratio must be in [0, 1]")
         if self.objective in ("multiclass", "multiclassova") and self.num_class <= 1:
             raise ValueError("num_class must be > 1 for multiclass objectives")
         if (self.objective not in ("multiclass", "multiclassova", "none")

@@ -353,7 +353,8 @@ class GBDT:
                 self.num_bins, self.grower_params, mesh, rb,
                 train_set.num_columns,
                 feat_group=(bundle.feat_group if bundle is not None
-                            else None), batch_k=k)
+                            else None), batch_k=k,
+                gain_ratio=float(cfg.tpu_frontier_gain_ratio))
             self._mesh = mesh
         elif parallel and self._use_segment:
             from ..parallel.learners import make_data_parallel_segment_grower
@@ -389,7 +390,8 @@ class GBDT:
             self._grow_fn = make_grow_tree_frontier(
                 self.num_bins, self.grower_params, rb,
                 batch_k=_auto_frontier_k(cfg, train_set.num_columns,
-                                         self.num_bins))
+                                         self.num_bins),
+                gain_ratio=float(cfg.tpu_frontier_gain_ratio))
         elif self._use_segment and impl in ("auto", "segment"):
             from .grower_seg import make_grow_tree_segment
             self._grow_fn = make_grow_tree_segment(

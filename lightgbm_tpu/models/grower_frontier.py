@@ -52,6 +52,7 @@ from .grower_seg import (COMPACT_WASTE, _SegState, _pack_bins_words,
 
 def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                             block_rows: int, batch_k: int = 0,
+                            gain_ratio: float = 0.0,
                             comm=None, wrap=None):
     """Build the jitted frontier-batched grower.
 
@@ -73,6 +74,9 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
     K = batch_k or frontier_width(
         p.num_columns or 64, B)
     K = max(1, min(K, L - 1))
+    # a ratio above 1 would gate out even the round-best leaf and hang
+    # the growth loop; config validates, this clamp guards direct callers
+    gain_ratio = min(max(float(gain_ratio), 0.0), 1.0)
 
     def _one_scan(st, hist, g, h, c, depth, fmeta, fmask, key, step,
                   lo, hi):
@@ -263,8 +267,12 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             gains_top, leaves_top = lax.top_k(st.best_f32[:, 0], K)
             # positive-gain prefix, clipped to the leaf budget; top_k
             # sorts descending so validity is a prefix and new leaf ids
-            # are base + j
+            # are base + j.  The gain-ratio gate only batches leaves
+            # comparable to the round's best: a dominant leaf grows
+            # strictly best-first, a flat pool batches fully.
             valid = (gains_top > 0.0) & (jnp.arange(K) < budget)
+            if gain_ratio > 0.0:
+                valid &= gains_top >= gain_ratio * gains_top[0]
             leaves_top = leaves_top.astype(jnp.int32)
             new_leaves = base + jnp.arange(K, dtype=jnp.int32)
             nodes = base - 1 + jnp.arange(K, dtype=jnp.int32)

@@ -381,7 +381,8 @@ def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
 def make_data_parallel_frontier_grower(num_bins: int, params: GrowerParams,
                                        mesh: Mesh, block_rows: int,
                                        num_columns: int, feat_group=None,
-                                       batch_k: int = 0):
+                                       batch_k: int = 0,
+                                       gain_ratio: float = 0.0):
     """Data-parallel frontier-batched learner: the K-splits-per-round
     grower (models/grower_frontier.py) under shard_map.
 
@@ -432,4 +433,5 @@ def make_data_parallel_frontier_grower(num_bins: int, params: GrowerParams,
     _log_collective_estimate("data_frontier", D, G, num_bins,
                              params.num_leaves)
     return make_grow_tree_frontier(num_bins, params, block_rows,
-                                   batch_k=batch_k, comm=comm, wrap=wrap)
+                                   batch_k=batch_k, gain_ratio=gain_ratio,
+                                   comm=comm, wrap=wrap)

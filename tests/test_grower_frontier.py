@@ -122,3 +122,58 @@ def test_frontier_with_efb_bundles(rng):
     # K=1 frontier == strict segment even through bundling
     np.testing.assert_allclose(seg._raw_predict(X), fro._raw_predict(X),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_frontier_gain_ratio_gate(rng):
+    """With a dominant-gain target and a high gain ratio, rounds batch
+    only comparable leaves — quality approaches strict best-first even at
+    large K; ratio=0 batches everything with positive gain."""
+    n = 3000
+    X = rng.normal(size=(n, 8))
+    y = (X[:, 0] * 3                      # one dominant direction
+         + 0.1 * np.sin(X[:, 1]) + rng.normal(size=n) * 0.05)
+    strict = _train(X, y, "segment", objective="regression", num_leaves=31,
+                    min_data_in_leaf=5, tpu_row_chunk=256, n_iters=3)
+    gated = _train(X, y, "frontier", objective="regression", num_leaves=31,
+                   min_data_in_leaf=5, tpu_row_chunk=256,
+                   tpu_frontier_width=16, tpu_frontier_gain_ratio=0.5,
+                   n_iters=3)
+    wide = _train(X, y, "frontier", objective="regression", num_leaves=31,
+                  min_data_in_leaf=5, tpu_row_chunk=256,
+                  tpu_frontier_width=16, tpu_frontier_gain_ratio=0.0,
+                  n_iters=3)
+    mse = lambda b: float(np.mean((b._raw_predict(X).ravel() - y) ** 2))
+    m_strict, m_gated, m_wide = mse(strict), mse(gated), mse(wide)
+    # the gate must not be WORSE than ungated batching, and must stay
+    # close to strict
+    assert m_gated <= m_wide * 1.02, (m_gated, m_wide)
+    assert m_gated < m_strict * 1.10, (m_gated, m_strict)
+
+
+def test_frontier_with_bagging_and_goss(rng):
+    """Frontier grower under row subsampling: bagging masks rows via the
+    member channel; GOSS amplifies small-gradient rows — both flow
+    through the batched kernel's weight channels unchanged."""
+    n = 3000
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    bag = _train(X, y, "frontier", objective="binary", num_leaves=15,
+                 min_data_in_leaf=5, tpu_row_chunk=256, n_iters=6,
+                 bagging_fraction=0.6, bagging_freq=1)
+    p = 1.0 / (1.0 + np.exp(-bag._raw_predict(X).ravel()))
+    assert float(np.mean((p > 0.5) == y)) > 0.9
+
+    from lightgbm_tpu.models.boosting_factory import create_boosting
+    from lightgbm_tpu.objective import create_objective
+    cfg = Config(verbosity=-1, tpu_histogram_backend="pallas",
+                 tpu_tree_impl="frontier", objective="binary",
+                 boosting="goss", num_leaves=15, min_data_in_leaf=5,
+                 tpu_row_chunk=256, top_rate=0.3, other_rate=0.2)
+    ds = TpuDataset.from_numpy(X, y, config=cfg)
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    goss = create_boosting(cfg, ds, obj)
+    for _ in range(6):
+        goss.train_one_iter()
+    p = 1.0 / (1.0 + np.exp(-goss._raw_predict(X).ravel()))
+    assert float(np.mean((p > 0.5) == y)) > 0.9

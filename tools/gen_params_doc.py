@@ -14,13 +14,6 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SECTIONS = [
-    ("Core", ["config", "task", "objective", "boosting", "data", "valid",
-              "num_iterations", "learning_rate", "num_leaves",
-              "tree_learner", "num_threads", "device_type", "seed"]),
-]
-
-
 def generate() -> str:
     from lightgbm_tpu.config import _PARAMS
 
@@ -70,6 +63,11 @@ def generate() -> str:
         "  strict best-first).",
         "- `tpu_frontier_width` — leaves per frontier round (0 = auto:",
         "  min(16, ceil(num_leaves/16))).",
+        "- `tpu_frontier_gain_ratio` — within a frontier round, only",
+        "  batch leaves whose cached gain is at least this fraction of",
+        "  the round's best gain (range [0, 1]; 0.0 = batch every",
+        "  positive-gain leaf).  Lets rounds adapt between strict",
+        "  best-first (one dominant leaf) and fully batched growth.",
         "- `tpu_row_chunk` — histogram kernel row-block size (0 = auto).",
         "- `tpu_double_precision` — accumulate histograms in",
         "  f64-equivalent precision.",
