@@ -134,7 +134,9 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                            num_sorts=st.num_sorts + 1)
 
     def grow(binsT, grad, hess, member, fmeta: FeatureMeta, feature_mask,
-             key):
+             key, root_hist=None):
+        # ``root_hist`` [G, B, 3]: externally-computed root histogram
+        # (multiclass batched roots); serial only, like grower_seg
         n_phys, n = binsT.shape
         G_cols = p.num_columns or (2 * n_phys if p.packed4 else n_phys)
         F = fmeta.num_bin.shape[0]
@@ -400,9 +402,10 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             best_cat_bitset=jnp.zeros((L, 8), dtype=jnp.uint32),
             tree=tree0,
         )
-        root_targets = jnp.full(K, -1, jnp.int32).at[0].set(0)
-        root_hist = hist_batch(st, root_targets, all_blocks,
-                               jnp.int32(max_blocks))[0]
+        if root_hist is None:
+            root_targets = jnp.full(K, -1, jnp.int32).at[0].set(0)
+            root_hist = hist_batch(st, root_targets, all_blocks,
+                                   jnp.int32(max_blocks))[0]
         st = st._replace(leaf_hist=st.leaf_hist.at[0].set(root_hist),
                          scanned_since=jnp.int32(max_blocks),
                          scanned_total=jnp.int32(max_blocks))

@@ -233,10 +233,14 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                            num_sorts=st.num_sorts + 1)
 
     def grow(binsT, grad, hess, member, fmeta: FeatureMeta, feature_mask,
-             key):
+             key, root_hist=None):
         # G_cols = logical bin-matrix columns (EFB groups); F = logical
         # features (fmeta/feature_mask space); binsT rows are PHYSICAL
         # (half of G_cols under 4-bit packing).
+        # ``root_hist`` [G, B, 3], when given, replaces the root's own
+        # full-data scan (multiclass batched roots: GBDT computes every
+        # class-tree's root histogram in ONE kernel pass).  Serial only —
+        # the distributed wrappers never pass it.
         n_phys, n = binsT.shape
         G_cols = p.num_columns or (2 * n_phys if p.packed4 else n_phys)
         F = fmeta.num_bin.shape[0]
@@ -445,7 +449,12 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             best_cat_bitset=jnp.zeros((L, 8), dtype=jnp.uint32),
             tree=tree0,
         )
-        root_hist, root_blk = hist_leaf(st, jnp.int32(0), G_cols)
+        if root_hist is None:
+            root_hist, root_blk = hist_leaf(st, jnp.int32(0), G_cols)
+        else:
+            # external batched pass: charge the same scan cost so the
+            # adaptive-compaction accounting is unchanged
+            root_blk = jnp.int32(max_blocks)
         st = st._replace(leaf_hist=st.leaf_hist.at[0].set(root_hist),
                          scanned_since=root_blk, scanned_total=root_blk)
         st = scan_leaf(st, 0, root_hist, G0, H0, C0, jnp.int32(0), fmeta,

@@ -181,6 +181,9 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
 
 
 def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref, *, num_bins, packed4):
+    # w_ref may carry MULTIPLE 8-channel sets ([8*C, rb]): the matmul
+    # output widens to 8*C and each set accumulates independently — used
+    # to histogram all C class-trees' roots in one pass (multiclass)
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -231,8 +234,12 @@ def histogram_all(binsT: jax.Array, w8: jax.Array, num_bins: int,
                   block_rows: int = 0,
                   interpret: bool | None = None,
                   packed4: bool = False) -> jax.Array:
-    """Full-data histogram: [F, Npad] bins x [8, Npad] channels -> [F, B, 8].
+    """Full-data histogram: [F, Npad] bins x [8*C, Npad] channels ->
+    [C, F, B, 8] (squeezed to [F, B, 8] for the common C == 1).
 
+    ``w8`` may stack C independent 8-channel sets (multiclass batched
+    roots: every class-tree's root histogram in ONE pass — C x fewer
+    full-data scans, and 8*C output columns fill more of the MXU tile).
     Npad must be a multiple of ``block_rows``; pad rows must carry zero
     weight channels (the bin values there may be anything).  With
     ``packed4`` the bins hold two <=16-bin features per byte and F here
@@ -240,6 +247,9 @@ def histogram_all(binsT: jax.Array, w8: jax.Array, num_bins: int,
     """
     F, n = binsT.shape
     F_log = 2 * F if packed4 else F
+    CH = int(w8.shape[0])
+    assert CH % NUM_CHANNELS == 0, CH
+    C = CH // NUM_CHANNELS
     if block_rows <= 0:
         block_rows = pick_block_rows(F_log, num_bins)
     if interpret is None:
@@ -247,20 +257,23 @@ def histogram_all(binsT: jax.Array, w8: jax.Array, num_bins: int,
     assert n % block_rows == 0, (n, block_rows)
     out = pl.pallas_call(
         functools.partial(_kernel_all, num_bins=num_bins, packed4=packed4),
-        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, NUM_CHANNELS),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, CH),
                                        jnp.float32),
         grid=(n // block_rows,),
         in_specs=[
             pl.BlockSpec((F, block_rows), lambda i: (0, i)),
-            pl.BlockSpec((NUM_CHANNELS, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((CH, block_rows), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((F_log * num_bins, NUM_CHANNELS),
+        out_specs=pl.BlockSpec((F_log * num_bins, CH),
                                lambda i: (0, 0)),
-        scratch_shapes=[pltpu.VMEM((F_log * num_bins, NUM_CHANNELS),
-                                   jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, CH), jnp.float32)],
         interpret=interpret,
     )(binsT, w8)
-    return out.reshape(F_log, num_bins, NUM_CHANNELS)
+    if C == 1:
+        return out.reshape(F_log, num_bins, NUM_CHANNELS)
+    # [F*B, C*8] -> [C, F, B, 8]
+    return out.reshape(F_log, num_bins, C, NUM_CHANNELS).transpose(
+        2, 0, 1, 3)
 
 
 def _segment_buckets(max_blocks: int) -> list:
@@ -389,6 +402,16 @@ def frontier_width(num_features: int, num_bins: int) -> int:
     while k > 1 and F4 * num_bins * NUM_CHANNELS * k * 4 > 6 * 1024 * 1024:
         k //= 2
     return k
+
+
+def channel_set_capacity(num_features: int, num_bins: int) -> int:
+    """Max stacked 8-channel sets histogram_all can take for this shape
+    before the [F*B, 8*C] VMEM scratch blows the budget (same bound the
+    frontier kernel enforces via frontier_width).  Callers batching more
+    sets (e.g. multiclass roots with large num_class) must chunk."""
+    F4 = -(-num_features // 4) * 4
+    per_set = F4 * num_bins * NUM_CHANNELS * 4
+    return max(1, (6 * 1024 * 1024) // max(per_set, 1))
 
 
 def _kernel_frontier(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref, *,

@@ -182,3 +182,70 @@ def test_segment_grower_direct_leaf_id(rng):
     np.testing.assert_array_equal(np.asarray(lid_f), np.asarray(lid_s))
     assert np.abs(np.asarray(tree_f.leaf_value)
                   - np.asarray(tree_s.leaf_value)).max() < 1e-3
+
+
+def test_multiclass_batched_roots_parity(rng):
+    """Multiclass: all C class-trees' root histograms computed in ONE
+    kernel pass (histogram_all with stacked channel sets) must grow the
+    same trees as per-class root scans (the non-fused eager path)."""
+    n, C = 1500, 3
+    X = rng.normal(size=(n, 5))
+    y = np.argmax(X[:, :C] + rng.normal(size=(n, C)) * 0.3, axis=1)
+
+    def train(force_eager):
+        cfg = Config(verbosity=-1, objective="multiclass", num_class=C,
+                     tpu_histogram_backend="pallas",
+                     tpu_tree_impl="segment", num_leaves=7,
+                     min_data_in_leaf=5, tpu_row_chunk=256)
+        ds = TpuDataset.from_numpy(X, y.astype(np.float64), config=cfg)
+        obj = create_objective(cfg)
+        obj.init(ds.metadata, ds.num_data)
+        bst = GBDT(cfg, ds, obj)
+        if force_eager:
+            bst._fused_ok = False      # per-class root scans, no batching
+        for _ in range(3):
+            bst.train_one_iter()
+        return bst
+
+    fused = train(False)
+    eager = train(True)
+    assert fused._fused_fns is not None and fused._fused_fns[2] is not None, \
+        "batched roots should be active for serial multiclass segment"
+    assert len(fused.models) == len(eager.models) == 9
+    for i, (tf, te) in enumerate(zip(fused.models, eager.models)):
+        assert tf.num_leaves == te.num_leaves, f"tree {i}"
+        nsp = tf.num_leaves - 1
+        assert np.array_equal(tf.split_feature[:nsp],
+                              te.split_feature[:nsp]), f"tree {i}"
+    np.testing.assert_allclose(fused._raw_predict(X), eager._raw_predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multiclass_batched_roots_parity_packed4(rng):
+    """Batched roots through the 4-bit packed layout (max_bin<=15)."""
+    n, C = 1200, 3
+    X = rng.normal(size=(n, 6))
+    y = np.argmax(X[:, :C] + rng.normal(size=(n, C)) * 0.3, axis=1)
+
+    def train(force_eager):
+        cfg = Config(verbosity=-1, objective="multiclass", num_class=C,
+                     tpu_histogram_backend="pallas", max_bin=15,
+                     tpu_tree_impl="segment", num_leaves=7,
+                     min_data_in_leaf=5, tpu_row_chunk=256)
+        ds = TpuDataset.from_numpy(X, y.astype(np.float64), config=cfg)
+        obj = create_objective(cfg)
+        obj.init(ds.metadata, ds.num_data)
+        bst = GBDT(cfg, ds, obj)
+        assert bst.grower_params.packed4
+        if force_eager:
+            bst._fused_ok = False
+        for _ in range(2):
+            bst.train_one_iter()
+        return bst
+
+    fused = train(False)
+    eager = train(True)
+    assert fused._fused_fns[2] is not None
+    np.testing.assert_allclose(fused._raw_predict(X),
+                               eager._raw_predict(X),
+                               rtol=1e-4, atol=1e-5)

@@ -186,3 +186,32 @@ def test_histogram_frontier_packed4(rng):
     exp = _ref_hist(bins[sel], g[sel], g[sel], m[sel], b)
     got = np.asarray(unpack_hist(out[0]), np.float64)[:f]
     assert np.abs(got - exp).max() < max(1e-6, np.abs(exp).max() * 3e-4)
+
+
+@pytest.mark.parametrize("packed4", [False, True])
+def test_histogram_all_multi_channel_sets(rng, packed4):
+    """histogram_all with C stacked 8-channel sets == C separate calls
+    (multiclass batched roots), in both byte and 4-bit packed layouts."""
+    n, f, b, rb, C = 1024, 4, 16, 256, 3
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    gs = [rng.normal(size=n).astype(np.float32) for _ in range(C)]
+    hs = [rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+          for _ in range(C)]
+    m = (rng.uniform(size=n) < 0.7).astype(np.float32)
+    from lightgbm_tpu.ops.pallas_histogram import pack_bins_4bit
+    binsT = (jnp.asarray(pack_bins_4bit(bins.T)) if packed4
+             else jnp.asarray(bins.T.copy()))
+    w8m = jnp.concatenate([pack_channels(jnp.asarray(gs[c]),
+                                         jnp.asarray(hs[c]),
+                                         jnp.asarray(m)) for c in range(C)])
+    multi = histogram_all(binsT, w8m, b, block_rows=rb, interpret=True,
+                          packed4=packed4)
+    assert multi.shape == (C, f, b, 8)
+    for c in range(C):
+        single = histogram_all(
+            binsT, pack_channels(jnp.asarray(gs[c]), jnp.asarray(hs[c]),
+                                 jnp.asarray(m)), b, block_rows=rb,
+            interpret=True, packed4=packed4)
+        np.testing.assert_allclose(np.asarray(multi[c]),
+                                   np.asarray(single), rtol=1e-6,
+                                   atol=1e-6)

@@ -316,6 +316,13 @@ def cmd_compile(argv):
         stage_time("segment grower", lambda: grow.lower(
             binsT, g, g, member, fmeta, fmask, key))
 
+    if "frontier" in variants:
+        from lightgbm_tpu.models.grower_frontier import (
+            make_grow_tree_frontier)
+        grow = make_grow_tree_frontier(B, params, RB, batch_k=16)
+        stage_time("frontier grower (K=16)", lambda: grow.lower(
+            binsT, g, g, member, fmeta, fmask, key))
+
     if "seg_nocompact" in variants:
         import unittest.mock as _mock
 
