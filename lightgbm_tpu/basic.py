@@ -22,7 +22,46 @@ from .models.gbdt import GBDT
 from .utils.log import LightGBMError, check, log_info, log_warning
 
 
-def _as_2d_float(data, num_features: Optional[int] = None) -> np.ndarray:
+def _pandas_categories(data) -> Optional[List[list]]:
+    """Per-category-column category lists, in column order (None when the
+    frame has no category columns / is not a frame)."""
+    if not (hasattr(data, "dtypes") and hasattr(data, "columns")):
+        return None
+    out = [list(data[c].cat.categories) for c in data.columns
+           if str(data[c].dtype) == "category"]
+    return out or None
+
+
+def _as_2d_float(data, num_features: Optional[int] = None,
+                 pandas_categorical: Optional[List[list]] = None
+                 ) -> np.ndarray:
+    if hasattr(data, "dtypes") and hasattr(data, "columns") and any(
+            str(dt) == "category" for dt in data.dtypes):
+        # pandas DataFrame with category columns -> category CODES
+        # (missing/unseen -> NaN), the reference's pandas handling.
+        # ``pandas_categorical`` (recorded at train time and persisted in
+        # the model file) pins the value->code mapping so predict frames
+        # whose inferred category ORDER differs still encode correctly.
+        cols = []
+        cat_i = 0
+        for c in data.columns:
+            s = data[c]
+            if str(s.dtype) == "category":
+                if (pandas_categorical is not None
+                        and cat_i < len(pandas_categorical)):
+                    train_cats = pandas_categorical[cat_i]
+                    code_of = {v: i for i, v in enumerate(train_cats)}
+                    codes = np.asarray(
+                        [code_of.get(v, np.nan) for v in s],
+                        dtype=np.float64)
+                else:
+                    codes = s.cat.codes.to_numpy().astype(np.float64)
+                    codes[codes < 0] = np.nan
+                cols.append(codes)
+                cat_i += 1
+            else:
+                cols.append(s.to_numpy(dtype=np.float64))
+        data = np.stack(cols, axis=1)
     if hasattr(data, "values"):       # pandas
         data = data.values
     if hasattr(data, "toarray"):      # scipy sparse
@@ -36,6 +75,26 @@ def _as_2d_float(data, num_features: Optional[int] = None) -> np.ndarray:
         else:
             arr = arr[:, None]
     return arr
+
+
+_PANDAS_CAT_KEY = "pandas_categorical:"
+
+
+def _split_pandas_categorical(model_str: str):
+    """Strip the trailing ``pandas_categorical:<json>`` line the Python
+    layer appends to saved models (same file contract as the reference's
+    python package, so either package reads the other's files).
+    Returns (model_str_without_line, categories_or_None)."""
+    import json
+    idx = model_str.rfind("\n" + _PANDAS_CAT_KEY)
+    if idx < 0:
+        return model_str, None
+    line = model_str[idx + 1 + len(_PANDAS_CAT_KEY):].strip()
+    try:
+        cats = json.loads(line)
+    except json.JSONDecodeError:
+        return model_str, None
+    return model_str[:idx + 1], cats
 
 
 class Dataset:
@@ -60,6 +119,10 @@ class Dataset:
         self._handle: Optional[TpuDataset] = None
         self.used_indices: Optional[np.ndarray] = None
         self._predictor = None
+        # train-time category lists for pandas category columns (the
+        # reference's pandas_categorical); set at construct, persisted in
+        # saved models so predict frames encode consistently
+        self.pandas_categorical: Optional[List[list]] = None
 
     # --------------------------------------------------------- construction
     def construct(self) -> "Dataset":
@@ -79,7 +142,16 @@ class Dataset:
         # built directly)
         is_sparse = (hasattr(self.data, "tocsr")
                      and not hasattr(self.data, "values"))
-        data = self.data if is_sparse else _as_2d_float(self.data)
+        if not is_sparse:
+            # valid sets encode with the TRAINING frame's category lists
+            self.pandas_categorical = (
+                self.reference.pandas_categorical
+                if self.reference is not None
+                and self.reference.pandas_categorical is not None
+                else _pandas_categories(self.data))
+        data = (self.data if is_sparse
+                else _as_2d_float(self.data,
+                                  pandas_categorical=self.pandas_categorical))
         feature_names = None
         if isinstance(self.feature_name, (list, tuple)):
             feature_names = list(self.feature_name)
@@ -262,12 +334,15 @@ class Booster:
             self.gbdt = create_boosting(self.config, train_set._handle,
                                         self.objective)
             self.train_set = train_set
+            self.pandas_categorical = train_set.pandas_categorical
             self._setup_metrics()
         elif model_file is not None or model_str is not None:
             from .models.serialization import load_model
             if model_file is not None:
                 with open(model_file) as fh:
                     model_str = fh.read()
+            model_str, self.pandas_categorical = \
+                _split_pandas_categorical(model_str)
             self.gbdt, self.config, self.objective = load_model(model_str)
             self.train_set = None
         else:
@@ -358,7 +433,9 @@ class Booster:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else -1)
         n_feat = self.gbdt.max_feature_idx + 1
-        X = _as_2d_float(data, n_feat)
+        X = _as_2d_float(data, n_feat,
+                         pandas_categorical=getattr(
+                             self, "pandas_categorical", None))
         if X.shape[1] != n_feat:
             raise LightGBMError(
                 f"The number of features in data ({X.shape[1]}) is not the "
@@ -372,18 +449,22 @@ class Booster:
     # ---------------------------------------------------------------- model
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        from .models.serialization import save_model_to_string
         with open(filename, "w") as fh:
-            fh.write(save_model_to_string(self.gbdt, self.config,
-                                          num_iteration or -1,
-                                          start_iteration))
+            fh.write(self.model_to_string(num_iteration, start_iteration))
         return self
 
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0) -> str:
+        import json
+
         from .models.serialization import save_model_to_string
-        return save_model_to_string(self.gbdt, self.config,
-                                    num_iteration or -1, start_iteration)
+        s = save_model_to_string(self.gbdt, self.config,
+                                 num_iteration or -1, start_iteration)
+        if getattr(self, "pandas_categorical", None):
+            # same trailing-line contract as the reference python package
+            s += "\n" + _PANDAS_CAT_KEY \
+                + json.dumps(self.pandas_categorical) + "\n"
+        return s
 
     def dump_model(self, num_iteration: Optional[int] = None) -> Dict:
         from .models.serialization import dump_model_dict
@@ -395,6 +476,61 @@ class Booster:
 
     def feature_name(self) -> List[str]:
         return list(self.gbdt.feature_names)
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of the split threshold values the model uses for one
+        feature (reference basic.py Booster.get_split_value_histogram).
+
+        ``feature`` is a name or index; ``bins`` follows numpy.histogram
+        (None = one bin per unique threshold).  Returns (counts, edges)
+        like numpy, or a [k, 2] (SplitValue, Count) array of non-empty
+        bins with ``xgboost_style=True``.
+        """
+        if isinstance(feature, str):
+            names = self.feature_name()
+            if feature not in names:
+                raise LightGBMError(f"Unknown feature name {feature!r}")
+            feature = names.index(feature)
+        values = []
+        for t in self.gbdt.models:
+            n = t.num_leaves - 1
+            for i in range(n):
+                if (t.split_feature[i] == feature
+                        and not (t.decision_type[i] & 1)):  # numerical only
+                    values.append(float(t.threshold[i]))
+        values = np.asarray(values, dtype=np.float64)
+        if bins is None:
+            bins = max(len(np.unique(values)), 1)
+        counts, edges = np.histogram(values, bins=bins)
+        if not xgboost_style:
+            return counts, edges
+        centers = (edges[:-1] + edges[1:]) / 2.0
+        nz = counts > 0
+        return np.stack([centers[nz], counts[nz].astype(np.float64)],
+                        axis=1)
+
+    # ------------------------------------------------------------- pickling
+    def __getstate__(self):
+        """Pickle via the text model (the reference Booster does the
+        same): training state (dataset, device buffers, objective) does
+        not survive — the restored Booster predicts and continues from
+        the serialized trees only."""
+        return {"model_str": self.model_to_string(),
+                "params": self.params,
+                "best_iteration": self.best_iteration,
+                "best_score": self.best_score}
+
+    def __setstate__(self, state):
+        from .models.serialization import load_model
+        self.params = state.get("params", {})
+        self.best_iteration = state.get("best_iteration", -1)
+        self.best_score = state.get("best_score", {})
+        self._valid_names = []
+        model_str, self.pandas_categorical = \
+            _split_pandas_categorical(state["model_str"])
+        self.gbdt, self.config, self.objective = load_model(model_str)
+        self.train_set = None
 
     def set_network(self, machines, local_listen_port=12400,
                     listen_time_out=120, num_machines=1) -> "Booster":

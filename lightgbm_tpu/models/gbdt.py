@@ -611,9 +611,9 @@ class GBDT:
             G_cols = self.train_set.num_columns
             rb_ = self.grower_params.row_chunk
             packed4 = self.grower_params.packed4
-            # the kernel's VMEM scratch is [F*B, 8*chunkC]; chunk the
-            # classes when num_class exceeds what the budget allows
-            cap = channel_set_capacity(G_cols, self.num_bins)
+            # the kernel's VMEM working set grows with the channel stack;
+            # chunk the classes when num_class exceeds the budget
+            cap = channel_set_capacity(G_cols, self.num_bins, rb_)
 
             @jax.jit
             def fused_roots(grads, hesss, member, bins):

@@ -404,13 +404,19 @@ def frontier_width(num_features: int, num_bins: int) -> int:
     return k
 
 
-def channel_set_capacity(num_features: int, num_bins: int) -> int:
+def channel_set_capacity(num_features: int, num_bins: int,
+                         block_rows: int = 0) -> int:
     """Max stacked 8-channel sets histogram_all can take for this shape
-    before the [F*B, 8*C] VMEM scratch blows the budget (same bound the
-    frontier kernel enforces via frontier_width).  Callers batching more
-    sets (e.g. multiclass roots with large num_class) must chunk."""
+    before VMEM blows: bounds BOTH the [F*B, 8*C] f32 scratch and the
+    double-buffered [8*C, block_rows] bf16 weight stream (pick_block_rows
+    sized the block for 8 channels, so a wide stack would otherwise
+    overrun on narrow-bin datasets with many classes).  Callers batching
+    more sets (multiclass roots with large num_class) must chunk."""
     F4 = -(-num_features // 4) * 4
-    per_set = F4 * num_bins * NUM_CHANNELS * 4
+    if block_rows <= 0:
+        block_rows = pick_block_rows(num_features, num_bins)
+    per_set = (F4 * num_bins * NUM_CHANNELS * 4          # scratch
+               + 2 * block_rows * NUM_CHANNELS * 2)      # streamed w8
     return max(1, (6 * 1024 * 1024) // max(per_set, 1))
 
 

@@ -320,3 +320,121 @@ def test_update_with_new_train_set(rng):
     assert bst.gbdt.num_data == 1200
     bst.update()
     assert bst.num_trees() == 5
+
+
+def test_booster_pickle_round_trip(rng):
+    """Pickled Booster predicts identically after restore (reference
+    pickles via the text model; training state does not survive)."""
+    import pickle
+
+    X = rng.normal(size=(800, 5))
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, y),
+                    num_boost_round=8, verbose_eval=False)
+    blob = pickle.dumps(bst)
+    bst2 = pickle.loads(blob)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-7, atol=1e-9)
+
+
+def test_sklearn_estimator_pickle(rng):
+    import pickle
+
+    X = rng.normal(size=(600, 4))
+    y = X[:, 0] * 2 + rng.normal(size=600) * 0.1
+    model = lgb.LGBMRegressor(n_estimators=10, num_leaves=15,
+                              min_child_samples=5).fit(X, y)
+    m2 = pickle.loads(pickle.dumps(model))
+    np.testing.assert_allclose(model.predict(X), m2.predict(X),
+                               rtol=1e-7, atol=1e-9)
+
+
+def test_get_split_value_histogram(rng):
+    """Threshold histogram per feature (reference test_engine
+    split-value-histogram pattern)."""
+    X = rng.normal(size=(1000, 4))
+    y = X[:, 0] * 3 + np.sin(X[:, 1]) + rng.normal(size=1000) * 0.1
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, y),
+                    num_boost_round=10, verbose_eval=False)
+    counts, edges = bst.get_split_value_histogram(0)
+    assert counts.sum() > 0 and len(edges) == len(counts) + 1
+    # by feature name too
+    c2, _ = bst.get_split_value_histogram("Column_0")
+    assert c2.sum() == counts.sum()
+    # the dominant feature must carry more splits than a noise feature
+    c3, _ = bst.get_split_value_histogram(3)
+    assert counts.sum() >= c3.sum()
+    # xgboost-style [k, 2] non-empty bins
+    tab = bst.get_split_value_histogram(0, xgboost_style=True)
+    assert tab.ndim == 2 and tab.shape[1] == 2
+    assert tab[:, 1].sum() == counts.sum()
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        bst.get_split_value_histogram("nope")
+
+
+def test_pandas_categorical_round_trip(rng):
+    """DataFrame with category dtype columns: auto-detected as
+    categorical features, codes used for binning, predict on the same
+    dtype frame works (reference test_engine pandas-categorical)."""
+    import pandas as pd
+
+    n = 1200
+    cat = rng.choice(["a", "b", "c", "d"], size=n)
+    x1 = rng.normal(size=n)
+    effect = {"a": 2.0, "b": -1.0, "c": 0.5, "d": -2.5}
+    y = np.asarray([effect[c] for c in cat]) + 0.3 * x1 \
+        + rng.normal(size=n) * 0.1
+    df = pd.DataFrame({"c0": pd.Categorical(cat), "x1": x1})
+    ds = lgb.Dataset(df, y)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 5}, ds, num_boost_round=20,
+                    verbose_eval=False)
+    # the category column was auto-detected as a CATEGORICAL feature
+    from lightgbm_tpu.core.binning import BIN_TYPE_CATEGORICAL
+    assert ds._handle.bin_mappers[0].bin_type == BIN_TYPE_CATEGORICAL
+    pred = bst.predict(df)
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < 0.1 * y.var(), mse
+
+
+def test_pandas_categorical_reordered_predict_frame(rng):
+    """A predict frame whose inferred category ORDER differs from the
+    training frame still encodes through the persisted
+    pandas_categorical mapping (reference model-file contract: trailing
+    pandas_categorical: JSON line)."""
+    import pandas as pd
+
+    n = 1000
+    cat = rng.choice(["a", "b", "c", "d"], size=n)
+    effect = {"a": 2.0, "b": -1.0, "c": 0.5, "d": -2.5}
+    y = np.asarray([effect[c] for c in cat]) + rng.normal(size=n) * 0.05
+    df = pd.DataFrame({"c0": pd.Categorical(cat)})
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(df, y),
+                    num_boost_round=20, verbose_eval=False)
+    base = bst.predict(df)
+
+    # same values, shuffled category ORDER (what pandas infers from a
+    # freshly-read subset); codes differ from training codes
+    df2 = pd.DataFrame({"c0": pd.Categorical(
+        cat, categories=["d", "c", "b", "a"])})
+    np.testing.assert_allclose(bst.predict(df2), base, rtol=1e-7)
+
+    # survives the model file (trailing pandas_categorical line)
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "m.txt")
+        bst.save_model(path)
+        text = open(path).read()
+        assert "pandas_categorical:" in text
+        bst2 = lgb.Booster(model_file=path)
+        assert bst2.pandas_categorical == [["a", "b", "c", "d"]]
+        np.testing.assert_allclose(bst2.predict(df2), base, rtol=1e-7)
+
+    # and pickling
+    import pickle
+    bst3 = pickle.loads(pickle.dumps(bst))
+    np.testing.assert_allclose(bst3.predict(df2), base, rtol=1e-7)

@@ -177,3 +177,34 @@ def test_frontier_with_bagging_and_goss(rng):
         goss.train_one_iter()
     p = 1.0 / (1.0 + np.exp(-goss._raw_predict(X).ravel()))
     assert float(np.mean((p > 0.5) == y)) > 0.9
+
+
+def test_frontier_multiclass_batched_roots_parity(rng):
+    """Batched roots feed the FRONTIER grower's external-root branch
+    (gbdt gates on _use_segment, which covers frontier too)."""
+    n, C = 1200, 3
+    X = rng.normal(size=(n, 5))
+    y = np.argmax(X[:, :C] + rng.normal(size=(n, C)) * 0.3, axis=1)
+
+    def train(force_eager):
+        cfg = Config(verbosity=-1, objective="multiclass", num_class=C,
+                     tpu_histogram_backend="pallas",
+                     tpu_tree_impl="frontier", num_leaves=7,
+                     min_data_in_leaf=5, tpu_row_chunk=256,
+                     tpu_frontier_width=2)
+        ds = TpuDataset.from_numpy(X, y.astype(np.float64), config=cfg)
+        obj = create_objective(cfg)
+        obj.init(ds.metadata, ds.num_data)
+        bst = GBDT(cfg, ds, obj)
+        if force_eager:
+            bst._fused_ok = False
+        for _ in range(2):
+            bst.train_one_iter()
+        return bst
+
+    fused = train(False)
+    eager = train(True)
+    assert fused._fused_fns[2] is not None
+    np.testing.assert_allclose(fused._raw_predict(X),
+                               eager._raw_predict(X),
+                               rtol=1e-4, atol=1e-5)
