@@ -30,7 +30,7 @@ def __getattr__(name):
             from . import sklearn as _sk
             return getattr(_sk, name)
         if name in ("plot_importance", "plot_metric", "plot_tree",
-                    "create_tree_digraph"):
+                    "plot_split_value_histogram", "create_tree_digraph"):
             from . import plotting as _pl
             return getattr(_pl, name)
     except ImportError as e:

@@ -42,21 +42,24 @@ def _as_2d_float(data, num_features: Optional[int] = None,
         # ``pandas_categorical`` (recorded at train time and persisted in
         # the model file) pins the value->code mapping so predict frames
         # whose inferred category ORDER differs still encode correctly.
+        n_cat = sum(1 for dt in data.dtypes if str(dt) == "category")
+        if (pandas_categorical is not None
+                and n_cat != len(pandas_categorical)):
+            # positional matching would silently mis-align the mappings
+            raise LightGBMError(
+                f"train and predict/valid DataFrames have different "
+                f"category-column counts ({len(pandas_categorical)} at "
+                f"train, {n_cat} now)")
         cols = []
         cat_i = 0
         for c in data.columns:
             s = data[c]
             if str(s.dtype) == "category":
-                if (pandas_categorical is not None
-                        and cat_i < len(pandas_categorical)):
-                    train_cats = pandas_categorical[cat_i]
-                    code_of = {v: i for i, v in enumerate(train_cats)}
-                    codes = np.asarray(
-                        [code_of.get(v, np.nan) for v in s],
-                        dtype=np.float64)
-                else:
-                    codes = s.cat.codes.to_numpy().astype(np.float64)
-                    codes[codes < 0] = np.nan
+                if pandas_categorical is not None:
+                    # vectorized re-code into the TRAIN category order
+                    s = s.cat.set_categories(pandas_categorical[cat_i])
+                codes = s.cat.codes.to_numpy().astype(np.float64)
+                codes[codes < 0] = np.nan
                 cols.append(codes)
                 cat_i += 1
             else:
@@ -143,7 +146,11 @@ class Dataset:
         is_sparse = (hasattr(self.data, "tocsr")
                      and not hasattr(self.data, "values"))
         if not is_sparse:
-            # valid sets encode with the TRAINING frame's category lists
+            # valid sets encode with the TRAINING frame's category lists;
+            # the reference must be constructed first or its lists are
+            # still unset (valid .construct() can legally run first)
+            if self.reference is not None:
+                self.reference.construct()
             self.pandas_categorical = (
                 self.reference.pandas_categorical
                 if self.reference is not None

@@ -756,6 +756,18 @@ class GBDT:
             return True
         self._boost_from_average()
         C = self.num_tree_per_iteration
+        if self.train_set.num_used_features == 0:
+            # every feature is trivial (e.g. min_data_in_leaf >= num_data
+            # prunes all split points): the reference trains a constant
+            # model and stops (gbdt.cpp:543-551) — growing is pointless
+            # and the growers assume F >= 1
+            self._flush_pending()
+            self._models.extend(Tree(1) for _ in range(C))
+            self.iter_ += 1
+            self._stop_flag = True
+            log_warning("Stopped training because there are no more "
+                        "leaves that meet the split requirements")
+            return True
         use_async = (self._async_trees and not self.valid_sets
                      and (self.objective is None
                           or not self.objective.is_renew_tree_output))

@@ -110,6 +110,45 @@ def plot_metric(booster, metric=None, dataset_names=None, ax=None,
     return ax
 
 
+def plot_split_value_histogram(booster, feature, bins=None, ax=None,
+                               width_coef: float = 0.8, xlim=None,
+                               ylim=None, title="auto",
+                               xlabel="Feature split value",
+                               ylabel="Count", figsize=None, dpi=None,
+                               grid=True):
+    """Bar plot of the model's split threshold values for one feature
+    (reference plotting.plot_split_value_histogram over
+    Booster.get_split_value_histogram)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot the "
+                          "split value histogram.")
+    b = booster.booster_ if hasattr(booster, "booster_") else booster
+    counts, edges = b.get_split_value_histogram(feature, bins=bins)
+    if counts.sum() == 0:
+        raise ValueError(
+            f"Cannot plot split value histogram: the model never splits "
+            f"on feature {feature!r}")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    centers = (edges[:-1] + edges[1:]) / 2.0
+    widths = np.diff(edges) * width_coef
+    ax.bar(centers, counts, width=widths)
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    if title == "auto":
+        title = f"Split value histogram for feature {feature}"
+    if title:
+        ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
 def create_tree_digraph(booster, tree_index=0, show_info=None, precision=3,
                         name=None, comment=None, **kwargs):
     try:

@@ -73,3 +73,23 @@ def test_plot_tree_runs(binary_booster):
     assert ax is not None
     import matplotlib.pyplot as plt
     plt.close("all")
+
+
+def test_plot_split_value_histogram(rng):
+    matplotlib = pytest.importorskip("matplotlib")
+    matplotlib.use("Agg")
+    X = rng.normal(size=(600, 4))
+    y = X[:, 0] * 2 + rng.normal(size=600) * 0.1
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, y),
+                    num_boost_round=10, verbose_eval=False)
+    ax = lgb.plot_split_value_histogram(bst, 0)
+    assert len(ax.patches) > 0
+    with pytest.raises(ValueError, match="never splits"):
+        # train only ever splits features with signal; an all-noise
+        # feature may split occasionally, so probe one that cannot exist
+        bst2 = lgb.train({"objective": "regression", "verbose": -1,
+                          "min_data_in_leaf": 600},
+                         lgb.Dataset(X, y), num_boost_round=1,
+                         verbose_eval=False)
+        lgb.plot_split_value_histogram(bst2, 1)
