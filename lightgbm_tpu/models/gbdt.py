@@ -344,26 +344,22 @@ class GBDT:
                 log_warning(f"tpu_tree_impl={impl} requires the pallas "
                             "histogram backend (and no forced splits / "
                             "CEGB-lazy); using the fused grower")
+        bundle_fg = (train_set.bundle.feat_group
+                     if train_set.bundle is not None else None)
         if parallel and self._use_segment and impl == "frontier":
             from ..parallel.learners import (
                 make_data_parallel_frontier_grower)
-            bundle = train_set.bundle
             k = _auto_frontier_k(cfg, train_set.num_columns, self.num_bins)
             self._grow_fn = make_data_parallel_frontier_grower(
                 self.num_bins, self.grower_params, mesh, rb,
-                train_set.num_columns,
-                feat_group=(bundle.feat_group if bundle is not None
-                            else None), batch_k=k,
+                train_set.num_columns, feat_group=bundle_fg, batch_k=k,
                 gain_ratio=float(cfg.tpu_frontier_gain_ratio))
             self._mesh = mesh
         elif parallel and self._use_segment:
             from ..parallel.learners import make_data_parallel_segment_grower
-            bundle = train_set.bundle
             self._grow_fn = make_data_parallel_segment_grower(
                 self.num_bins, self.grower_params, mesh, rb,
-                train_set.num_columns,
-                feat_group=(bundle.feat_group if bundle is not None
-                            else None))
+                train_set.num_columns, feat_group=bundle_fg)
             self._mesh = mesh
         elif parallel:
             from ..parallel.learners import make_parallel_grower
@@ -373,12 +369,10 @@ class GBDT:
             if pad:
                 self.bins = jnp.pad(self.bins, ((0, pad), (0, 0)))
                 self._row_pad = pad
-            bundle = train_set.bundle
             self._grow_fn = make_parallel_grower(
                 self.num_bins, self.grower_params, mesh, tl,
                 top_k=cfg.top_k, num_columns=train_set.num_columns,
-                feat_group=(bundle.feat_group if bundle is not None
-                            else None),
+                feat_group=bundle_fg,
                 column_bins=train_set.column_bins)
             self._mesh = mesh
         elif self._use_segment and impl == "frontier":

@@ -43,11 +43,10 @@ from ..ops.pallas_histogram import (frontier_width, histogram_frontier,
                                     unpack_hist)
 from ..ops.split import (NEG_INF, FeatureMeta, best_split,
                          expand_group_hist, reconstruct_feature_column)
-from .grower import (GrowerParams, TreeArrays, _node_feature_mask,
-                     mono_handoff, routed_left)
-from .grower_seg import (COMPACT_WASTE, _SegState, _pack_bins_words,
-                         _pack_w8_words, _unpack_bins_words,
-                         _unpack_w8_words)
+from .grower import (GrowerParams, _node_feature_mask, mono_handoff,
+                     routed_left)
+from .grower_seg import (COMPACT_WASTE, _SegState, compact_state,
+                         fresh_state)
 
 
 def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
@@ -112,26 +111,7 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         )
 
     def compact(st: _SegState) -> _SegState:
-        operands = ((st.leaf_id,)
-                    + tuple(_pack_bins_words(st.binsT))
-                    + tuple(_pack_w8_words(st.w8))
-                    + (st.order,))
-        sorted_ops = lax.sort(operands, num_keys=1, is_stable=True)
-        lid = sorted_ops[0]
-        W = st.binsT.shape[0] // 4
-        binsT = _unpack_bins_words(jnp.stack(sorted_ops[1:1 + W]),
-                                   st.binsT.dtype)
-        w8 = _unpack_w8_words(jnp.stack(sorted_ops[1 + W:1 + W + 4]))
-        order = sorted_ops[1 + W + 4]
-        leaves = jnp.arange(L, dtype=jnp.int32)
-        starts = jnp.searchsorted(lid, leaves, side="left").astype(jnp.int32)
-        ends = jnp.searchsorted(lid, leaves, side="right").astype(jnp.int32)
-        leaf_lo = jnp.where(ends > starts, starts // rb, 0)
-        leaf_hi = jnp.where(ends > starts, -(-ends // rb), 0)
-        return st._replace(binsT=binsT, w8=w8, order=order, leaf_id=lid,
-                           leaf_lo=leaf_lo, leaf_hi=leaf_hi,
-                           scanned_since=jnp.int32(0),
-                           num_sorts=st.num_sorts + 1)
+        return compact_state(st, L, rb)
 
     def grow(binsT, grad, hess, member, fmeta: FeatureMeta, feature_mask,
              key, root_hist=None):
@@ -354,54 +334,8 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         limit_blocks = min(max(1, int(COMPACT_WASTE * max_blocks)),
                            2**31 - 1)
 
-        neg = jnp.full(L, NEG_INF, dtype=jnp.float32)
-        zeros_l = jnp.zeros(L, dtype=jnp.float32)
-        tree0 = TreeArrays(
-            num_leaves=jnp.int32(1),
-            split_feature=jnp.zeros(L - 1, dtype=jnp.int32),
-            threshold_bin=jnp.zeros(L - 1, dtype=jnp.int32),
-            default_left=jnp.zeros(L - 1, dtype=bool),
-            is_cat=jnp.zeros(L - 1, dtype=bool),
-            cat_bitset=jnp.zeros((L - 1, 8), dtype=jnp.uint32),
-            left_child=jnp.full(L - 1, -1, dtype=jnp.int32),
-            right_child=jnp.full(L - 1, -1, dtype=jnp.int32),
-            split_gain=jnp.zeros(L - 1, dtype=jnp.float32),
-            internal_value=jnp.zeros(L - 1, dtype=jnp.float32),
-            internal_weight=jnp.zeros(L - 1, dtype=jnp.float32),
-            internal_count=jnp.zeros(L - 1, dtype=jnp.float32),
-            leaf_value=zeros_l,
-            leaf_weight=zeros_l.at[0].set(H0),
-            leaf_count=zeros_l.at[0].set(C0),
-            leaf_parent=jnp.full(L, -1, dtype=jnp.int32),
-            leaf_depth=jnp.zeros(L, dtype=jnp.int32),
-        )
-        st = _SegState(
-            binsT=binsT, w8=w8,
-            order=jnp.arange(n, dtype=jnp.int32),
-            leaf_id=jnp.zeros(n, dtype=jnp.int32),
-            leaf_lo=jnp.zeros(L, dtype=jnp.int32),
-            leaf_hi=jnp.zeros(L, dtype=jnp.int32).at[0].set(max_blocks),
-            scanned_since=jnp.int32(0),
-            scanned_total=jnp.int32(0),
-            num_sorts=jnp.int32(0),
-            num_leaves=jnp.int32(1),
-            leaf_hist=jnp.zeros((L, G_cols, B, 3), dtype=jnp.float32),
-            leaf_g=zeros_l.at[0].set(G0),
-            leaf_h=zeros_l.at[0].set(H0),
-            leaf_c=zeros_l.at[0].set(C0),
-            leaf_mono_lo=jnp.full(L, -jnp.inf, dtype=jnp.float32),
-            leaf_mono_hi=jnp.full(L, jnp.inf, dtype=jnp.float32),
-            feat_used=(fmeta.cegb_used0
-                       if (p.use_cegb_coupled
-                           and fmeta.cegb_used0 is not None)
-                       else jnp.zeros(F, dtype=jnp.float32)),
-            best_f32=jnp.zeros((L, 6), dtype=jnp.float32)
-                        .at[:, 0].set(neg),
-            best_i32=jnp.zeros((L, 4), dtype=jnp.int32)
-                        .at[:, 0].set(-1),
-            best_cat_bitset=jnp.zeros((L, 8), dtype=jnp.uint32),
-            tree=tree0,
-        )
+        st = fresh_state(binsT, w8, n, L, G_cols, B, F, max_blocks,
+                         G0, H0, C0, fmeta, p)
         if root_hist is None:
             root_targets = jnp.full(K, -1, jnp.int32).at[0].set(0)
             root_hist = hist_batch(st, root_targets, all_blocks,

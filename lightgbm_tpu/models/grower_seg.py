@@ -110,6 +110,84 @@ def _pack_w8_words(w8):
     return (u[0::2] | (u[1::2] << 16)).astype(jnp.int32)
 
 
+def compact_state(st: _SegState, L: int, rb: int) -> _SegState:
+    """Stable-sort the whole layout by leaf_id; leaves become contiguous
+    segments and confinement intervals reset to them.  Shared by the
+    strict and frontier growers (identical _SegState layout)."""
+    operands = ((st.leaf_id,)
+                + tuple(_pack_bins_words(st.binsT))
+                + tuple(_pack_w8_words(st.w8))
+                + (st.order,))
+    sorted_ops = lax.sort(operands, num_keys=1, is_stable=True)
+    lid = sorted_ops[0]
+    W = st.binsT.shape[0] // 4
+    binsT = _unpack_bins_words(jnp.stack(sorted_ops[1:1 + W]),
+                               st.binsT.dtype)
+    w8 = _unpack_w8_words(jnp.stack(sorted_ops[1 + W:1 + W + 4]))
+    order = sorted_ops[1 + W + 4]
+    leaves = jnp.arange(L, dtype=jnp.int32)
+    starts = jnp.searchsorted(lid, leaves, side="left").astype(jnp.int32)
+    ends = jnp.searchsorted(lid, leaves, side="right").astype(jnp.int32)
+    # block-granular bounds; empty/unused leaves get an empty interval
+    leaf_lo = jnp.where(ends > starts, starts // rb, 0)
+    leaf_hi = jnp.where(ends > starts, -(-ends // rb), 0)
+    return st._replace(binsT=binsT, w8=w8, order=order, leaf_id=lid,
+                       leaf_lo=leaf_lo, leaf_hi=leaf_hi,
+                       scanned_since=jnp.int32(0),
+                       num_sorts=st.num_sorts + 1)
+
+
+def fresh_state(binsT, w8, n, L, G_cols, B, F, max_blocks, G0, H0, C0,
+                fmeta, p) -> _SegState:
+    """Initial _SegState + TreeArrays for a new tree (root covers
+    everything).  Shared by the strict and frontier growers."""
+    neg = jnp.full(L, NEG_INF, dtype=jnp.float32)
+    zeros_l = jnp.zeros(L, dtype=jnp.float32)
+    tree0 = TreeArrays(
+        num_leaves=jnp.int32(1),
+        split_feature=jnp.zeros(L - 1, dtype=jnp.int32),
+        threshold_bin=jnp.zeros(L - 1, dtype=jnp.int32),
+        default_left=jnp.zeros(L - 1, dtype=bool),
+        is_cat=jnp.zeros(L - 1, dtype=bool),
+        cat_bitset=jnp.zeros((L - 1, 8), dtype=jnp.uint32),
+        left_child=jnp.full(L - 1, -1, dtype=jnp.int32),
+        right_child=jnp.full(L - 1, -1, dtype=jnp.int32),
+        split_gain=jnp.zeros(L - 1, dtype=jnp.float32),
+        internal_value=jnp.zeros(L - 1, dtype=jnp.float32),
+        internal_weight=jnp.zeros(L - 1, dtype=jnp.float32),
+        internal_count=jnp.zeros(L - 1, dtype=jnp.float32),
+        leaf_value=zeros_l,
+        leaf_weight=zeros_l.at[0].set(H0),
+        leaf_count=zeros_l.at[0].set(C0),
+        leaf_parent=jnp.full(L, -1, dtype=jnp.int32),
+        leaf_depth=jnp.zeros(L, dtype=jnp.int32),
+    )
+    return _SegState(
+        binsT=binsT, w8=w8,
+        order=jnp.arange(n, dtype=jnp.int32),
+        leaf_id=jnp.zeros(n, dtype=jnp.int32),
+        leaf_lo=jnp.zeros(L, dtype=jnp.int32),
+        leaf_hi=jnp.zeros(L, dtype=jnp.int32).at[0].set(max_blocks),
+        scanned_since=jnp.int32(0),
+        scanned_total=jnp.int32(0),
+        num_sorts=jnp.int32(0),
+        num_leaves=jnp.int32(1),
+        leaf_hist=jnp.zeros((L, G_cols, B, 3), dtype=jnp.float32),
+        leaf_g=zeros_l.at[0].set(G0),
+        leaf_h=zeros_l.at[0].set(H0),
+        leaf_c=zeros_l.at[0].set(C0),
+        leaf_mono_lo=jnp.full(L, -jnp.inf, dtype=jnp.float32),
+        leaf_mono_hi=jnp.full(L, jnp.inf, dtype=jnp.float32),
+        feat_used=(fmeta.cegb_used0
+                   if (p.use_cegb_coupled and fmeta.cegb_used0 is not None)
+                   else jnp.zeros(F, dtype=jnp.float32)),
+        best_f32=jnp.zeros((L, 6), dtype=jnp.float32).at[:, 0].set(neg),
+        best_i32=jnp.zeros((L, 4), dtype=jnp.int32).at[:, 0].set(-1),
+        best_cat_bitset=jnp.zeros((L, 8), dtype=jnp.uint32),
+        tree=tree0,
+    )
+
+
 def _unpack_w8_words(words):
     u = words.astype(jnp.uint32)
     lo = (u & 0xFFFF).astype(jnp.uint16)
@@ -208,29 +286,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         return _write_scans(st, leaves2, infos, gains)
 
     def compact(st: _SegState) -> _SegState:
-        """Stable-sort the whole layout by leaf_id; leaves become
-        contiguous segments and confinement intervals reset to them."""
-        operands = ((st.leaf_id,)
-                    + tuple(_pack_bins_words(st.binsT))
-                    + tuple(_pack_w8_words(st.w8))
-                    + (st.order,))
-        sorted_ops = lax.sort(operands, num_keys=1, is_stable=True)
-        lid = sorted_ops[0]
-        W = st.binsT.shape[0] // 4
-        binsT = _unpack_bins_words(jnp.stack(sorted_ops[1:1 + W]),
-                                   st.binsT.dtype)
-        w8 = _unpack_w8_words(jnp.stack(sorted_ops[1 + W:1 + W + 4]))
-        order = sorted_ops[1 + W + 4]
-        leaves = jnp.arange(L, dtype=jnp.int32)
-        starts = jnp.searchsorted(lid, leaves, side="left").astype(jnp.int32)
-        ends = jnp.searchsorted(lid, leaves, side="right").astype(jnp.int32)
-        # block-granular bounds; empty/unused leaves get an empty interval
-        leaf_lo = jnp.where(ends > starts, starts // rb, 0)
-        leaf_hi = jnp.where(ends > starts, -(-ends // rb), 0)
-        return st._replace(binsT=binsT, w8=w8, order=order, leaf_id=lid,
-                           leaf_lo=leaf_lo, leaf_hi=leaf_hi,
-                           scanned_since=jnp.int32(0),
-                           num_sorts=st.num_sorts + 1)
+        return compact_state(st, L, rb)
 
     def grow(binsT, grad, hess, member, fmeta: FeatureMeta, feature_mask,
              key, root_hist=None):
@@ -399,56 +455,8 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                           compact, lambda s: s, st)
             return st
 
-        neg = jnp.full(L, NEG_INF, dtype=jnp.float32)
-        zeros_l = jnp.zeros(L, dtype=jnp.float32)
-        tree0 = TreeArrays(
-            num_leaves=jnp.int32(1),
-            split_feature=jnp.zeros(L - 1, dtype=jnp.int32),
-            threshold_bin=jnp.zeros(L - 1, dtype=jnp.int32),
-            default_left=jnp.zeros(L - 1, dtype=bool),
-            is_cat=jnp.zeros(L - 1, dtype=bool),
-            cat_bitset=jnp.zeros((L - 1, 8), dtype=jnp.uint32),
-            left_child=jnp.full(L - 1, -1, dtype=jnp.int32),
-            right_child=jnp.full(L - 1, -1, dtype=jnp.int32),
-            split_gain=jnp.zeros(L - 1, dtype=jnp.float32),
-            internal_value=jnp.zeros(L - 1, dtype=jnp.float32),
-            internal_weight=jnp.zeros(L - 1, dtype=jnp.float32),
-            internal_count=jnp.zeros(L - 1, dtype=jnp.float32),
-            leaf_value=zeros_l,
-            leaf_weight=zeros_l.at[0].set(H0),
-            leaf_count=zeros_l.at[0].set(C0),
-            leaf_parent=jnp.full(L, -1, dtype=jnp.int32),
-            leaf_depth=jnp.zeros(L, dtype=jnp.int32),
-        )
-        st = _SegState(
-            binsT=binsT, w8=w8,
-            order=jnp.arange(n, dtype=jnp.int32),
-            leaf_id=jnp.zeros(n, dtype=jnp.int32),
-            leaf_lo=jnp.zeros(L, dtype=jnp.int32)
-                       .at[0].set(0),
-            leaf_hi=jnp.zeros(L, dtype=jnp.int32)
-                       .at[0].set(max_blocks),
-            scanned_since=jnp.int32(0),
-            scanned_total=jnp.int32(0),
-            num_sorts=jnp.int32(0),
-            num_leaves=jnp.int32(1),
-            leaf_hist=jnp.zeros((L, G_cols, B, 3), dtype=jnp.float32),
-            leaf_g=zeros_l.at[0].set(G0),
-            leaf_h=zeros_l.at[0].set(H0),
-            leaf_c=zeros_l.at[0].set(C0),
-            leaf_mono_lo=jnp.full(L, -jnp.inf, dtype=jnp.float32),
-            leaf_mono_hi=jnp.full(L, jnp.inf, dtype=jnp.float32),
-            feat_used=(fmeta.cegb_used0
-                       if (p.use_cegb_coupled
-                           and fmeta.cegb_used0 is not None)
-                       else jnp.zeros(F, dtype=jnp.float32)),
-            best_f32=jnp.zeros((L, 6), dtype=jnp.float32)
-                        .at[:, 0].set(neg),
-            best_i32=jnp.zeros((L, 4), dtype=jnp.int32)
-                        .at[:, 0].set(-1),
-            best_cat_bitset=jnp.zeros((L, 8), dtype=jnp.uint32),
-            tree=tree0,
-        )
+        st = fresh_state(binsT, w8, n, L, G_cols, B, F, max_blocks,
+                         G0, H0, C0, fmeta, p)
         if root_hist is None:
             root_hist, root_blk = hist_leaf(st, jnp.int32(0), G_cols)
         else:

@@ -7,7 +7,7 @@ supervision and appends everything to ONCHIP_LOG.md:
   0. device probe (cheap; exits 3 when the backend is still down)
   1. strict-grower seg-stats probe at 10.5M rows (scan-waste model)
   2. frontier-grower A/B of the same probe
-  3. COMPACT_WASTE sweep on the faster impl
+  3. COMPACT_WASTE sweep (strict grower — the driver default)
   4. kernel microbenches (probe.py micro)
   5. bench.py (the scoreboard number; internally A/Bs impls)
 
@@ -34,6 +34,18 @@ def log(text: str) -> None:
     print(f"[{stamp}] {text}", flush=True)
 
 
+def _tails(stdout, stderr) -> str:
+    """Separate stdout/stderr tails: stdout carries the measurements
+    (PROBE lines, BENCH JSON) and must never be crowded out by noisy
+    stderr."""
+    def _s(x):
+        if isinstance(x, bytes):
+            x = x.decode(errors="replace")
+        return x or ""
+    return (f"stdout tail:\n```\n{_s(stdout)[-3000:]}\n```\n"
+            f"stderr tail:\n```\n{_s(stderr)[-3000:]}\n```")
+
+
 def run_step(name: str, cmd, timeout_s: int, env_extra=None) -> bool:
     env = dict(os.environ)
     env.update(env_extra or {})
@@ -42,12 +54,14 @@ def run_step(name: str, cmd, timeout_s: int, env_extra=None) -> bool:
     try:
         proc = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout_s,
                               capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        log(f"{name}: TIMEOUT after {timeout_s}s")
+    except subprocess.TimeoutExpired as e:
+        # keep whatever the child already printed — one-shot chip data
+        log(f"{name}: TIMEOUT after {timeout_s}s\n"
+            + _tails(e.stdout, e.stderr))
         return False
     dt = time.time() - t0
-    tail = (proc.stdout + "\n" + proc.stderr)[-4000:]
-    log(f"{name}: rc={proc.returncode} in {dt:.0f}s\n```\n{tail}\n```")
+    log(f"{name}: rc={proc.returncode} in {dt:.0f}s\n"
+        + _tails(proc.stdout, proc.stderr))
     return proc.returncode == 0
 
 
@@ -96,10 +110,17 @@ def main():
     run_step("micro 10.5M", [PY, probe_cli, "micro", "10500000"], 1800)
 
     # 5. the scoreboard bench (probes + tiers + internal impl A/B)
-    run_step("bench", [PY, os.path.join(REPO, "bench.py")], 9000)
+    run_step("bench run 1 (cold cache)",
+             [PY, os.path.join(REPO, "bench.py")], 9000)
 
-    log("plan complete — see sections above; BENCH JSON is the last "
-        "bench step's stdout tail")
+    # 6. second bench run: the round-3 open question — does the
+    # persistent compilation cache cut warmup below 60 s?
+    run_step("bench run 2 (warm cache)",
+             [PY, os.path.join(REPO, "bench.py")], 9000)
+
+    log("plan complete — BENCH JSON lines are in the bench steps' "
+        "stdout tails; compare warmup between the two runs for the "
+        "compile-cache question")
 
 
 if __name__ == "__main__":
