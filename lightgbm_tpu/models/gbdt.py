@@ -539,8 +539,8 @@ class GBDT:
     # trees and opt out
     _async_trees = True
     # whole-iteration fusion (gradients + grow + score update in a single
-    # jitted dispatch per tree) — subclasses whose _bagging inspects or
-    # rewrites gradients on the host (GOSS) opt out
+    # jitted dispatch per tree) — subclasses whose bagging cannot run as
+    # a device-side transform of the gradients opt out
     _fused_ok = True
 
     def _build_fused_step(self):
@@ -885,10 +885,11 @@ class GBDT:
             self._build_fused_step()
         fused_grad, fused_step, fused_roots = self._fused_fns
         with _PHASES.phase("boost") as box:
-            # plain bagging only updates the membership mask; gradient-
-            # rewriting baggings (GOSS) disable the fused path
-            self._bagging(self.iter_, None, None)
             grads, hesss = fused_grad(self.train_score, self._obj_arrs)
+            # bagging runs AFTER the gradient dispatch (GOSS's device-side
+            # select transforms the gradients; membership-mask baggings
+            # ignore them) — same call the eager path makes
+            grads, hesss = self._bagging(self.iter_, grads, hesss)
             box[0] = grads
         roots = None
         if fused_roots is not None:

@@ -23,10 +23,13 @@ from .gbdt import GBDT
 
 @jax.jit
 def _goss_select(grads, hesss, key, top_k, other_k):
-    """Exact top-k + uniform other_k sampling, all on device.
+    """Exact top-k + uniform other_k sampling, all on device, in ONE
+    dispatch: the amplified gradients come back alongside the mask so
+    the eager multiplies never leave the jit.
 
-    Returns (mask [n] f32, amp [n] f32): mask is the bagging weight, amp
-    amplifies sampled small-gradient rows by (n - top_k) / other_k.
+    Returns (grads' [C, n], hesss' [C, n], mask [n] f32): mask is the
+    bagging weight; sampled small-gradient rows are amplified by
+    (n - top_k) / other_k in both grad and hess (goss.hpp:91-140).
     """
     n = grads.shape[1]
     score = jnp.sum(jnp.abs(grads * hesss), axis=0)
@@ -43,14 +46,13 @@ def _goss_select(grads, hesss, key, top_k, other_k):
         jnp.maximum(other_k, 1).astype(jnp.float32)
     mask = (top_mask | rest_sel).astype(jnp.float32)
     amp = jnp.where(rest_sel, multiply, 1.0)
-    return mask, amp
+    return grads * amp[None, :], hesss * amp[None, :], mask
 
 
 class GOSS(GBDT):
-    # the fused iteration folds gradient computation into one jit; GOSS's
-    # sampling is its own device dispatch between boosting and growing, so
-    # it keeps the eager pipeline (still transfer-free)
-    _fused_ok = False
+    # GOSS's sampling is a pure device-side transform of the gradients,
+    # so it rides the fused pipeline: gradient dispatch, one sampling
+    # dispatch (skipped in warm-up), then the per-class fused grow+score
 
     def __init__(self, config, train_set, objective=None):
         super().__init__(config, train_set, objective)
@@ -69,7 +71,8 @@ class GOSS(GBDT):
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
         key = jax.random.fold_in(self._key, 0x60550000 + iter_idx)
-        mask, amp = _goss_select(grads, hesss, key, jnp.int32(top_k),
-                                 jnp.int32(other_k))
+        grads, hesss, mask = _goss_select(grads, hesss, key,
+                                          jnp.int32(top_k),
+                                          jnp.int32(other_k))
         self.bag_weight = mask
-        return grads * amp[None, :], hesss * amp[None, :]
+        return grads, hesss
