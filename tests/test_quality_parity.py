@@ -138,3 +138,34 @@ def test_lambdarank_training_quality_parity(ref_cli, tmp_path):
     ndcg_ref = mean_ndcg(ref_scores)
     ndcg_ours = mean_ndcg(bst.predict(Xt))
     assert ndcg_ours > ndcg_ref - 0.03, (ndcg_ours, ndcg_ref)
+
+
+def test_regression_training_quality_parity(ref_cli, tmp_path):
+    import lightgbm_tpu as lgb
+
+    ex = _example("regression")
+    n_rounds = 30
+    params = dict(objective="regression", num_leaves=31, max_bin=255,
+                  learning_rate=0.1, min_data_in_leaf=20)
+    model = tmp_path / "ref.txt"
+    _run_ref(ref_cli, ex, task="train", data="regression.train",
+             num_trees=n_rounds, output_model=str(model), verbosity=-1,
+             **params)
+    pred_file = tmp_path / "ref_pred.txt"
+    _run_ref(ref_cli, ex, task="predict", data="regression.test",
+             input_model=str(model), output_result=str(pred_file),
+             verbosity=-1)
+    test = np.loadtxt(os.path.join(ex, "regression.test"), delimiter="\t")
+    yt = test[:, 0]
+    mse_ref = float(np.mean((np.loadtxt(pred_file) - yt) ** 2))
+
+    # train OURS from the same FILE path: the example ships a
+    # regression.train.init sidecar the reference CLI auto-applies (init
+    # scores replace boost-from-average and do not carry into predict),
+    # and our file loader honors the same sidecar contract
+    bst = lgb.train({**params, "verbose": -1},
+                    lgb.Dataset(os.path.join(ex, "regression.train")),
+                    num_boost_round=n_rounds, verbose_eval=False)
+    mse_ours = float(np.mean((bst.predict(test[:, 1:]) - yt) ** 2))
+    assert mse_ours < mse_ref * 1.05, (mse_ours, mse_ref)
+    assert mse_ref < mse_ours * 1.05, (mse_ours, mse_ref)
