@@ -11,8 +11,14 @@
 #' @param valids named list of lgb.Dataset objects to evaluate.
 #' @param verbose print evaluation results every `eval_freq` rounds.
 #' @param eval_freq evaluation print frequency.
+#' @param early_stopping_rounds stop when the first valid's first metric
+#'   has not improved for this many rounds (NULL = never); the kept
+#'   model is rolled back to the best iteration, mirroring the
+#'   reference's early-stopping callback semantics.
+#' @param record keep per-round eval values in `$record_evals`.
 lgb.train <- function(params = list(), data, nrounds = 100L,
-                      valids = list(), verbose = 1L, eval_freq = 1L) {
+                      valids = list(), verbose = 1L, eval_freq = 1L,
+                      early_stopping_rounds = NULL, record = TRUE) {
   stopifnot(inherits(data, "lgb.Dataset.tpu"))
   pstr <- .params_to_string(params)
   ptr <- .Call(LGBMTPU_BoosterCreate_R, data$ptr, pstr)
@@ -22,25 +28,68 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
     stopifnot(inherits(vd, "lgb.Dataset.tpu"))
     .Call(LGBMTPU_BoosterAddValidData_R, ptr, vd$ptr)
   }
+  vnames <- names(valids)
+  if (is.null(vnames)) vnames <- rep("", length(valids))
+  blank <- !nzchar(vnames)
+  vnames[blank] <- paste0("valid_", seq_along(valids))[blank]
   eval_names <- NULL
+  record_evals <- list()
+  es <- .es_new()
+  watch_early <- !is.null(early_stopping_rounds) && length(valids) > 0L
   for (i in seq_len(nrounds)) {
     finished <- .Call(LGBMTPU_BoosterUpdateOneIter_R, ptr)
-    if (verbose > 0L && length(valids) > 0L &&
-        (i %% eval_freq == 0L)) {
+    if (length(valids) > 0L &&
+        (watch_early || isTRUE(record) ||
+         (verbose > 0L && i %% eval_freq == 0L))) {
       if (is.null(eval_names)) {
         eval_names <- .Call(LGBMTPU_BoosterGetEvalNames_R, ptr)
+        if (watch_early && length(eval_names) == 0L) {
+          stop("early_stopping_rounds requires at least one eval ",
+               "metric (the booster was configured with no metric)")
+        }
       }
       for (j in seq_along(valids)) {
         ev <- .Call(LGBMTPU_BoosterGetEval_R, ptr, j)  # 1-based: valid_j
-        message(sprintf("[%d] %s: %s", i, names(valids)[j],
-                        paste(eval_names, signif(ev, 6),
-                              sep = "=", collapse = " ")))
+        vname <- vnames[j]
+        if (isTRUE(record)) {
+          if (is.null(record_evals[[vname]])) {
+            record_evals[[vname]] <-
+              matrix(NA_real_, nrounds, length(eval_names),
+                     dimnames = list(NULL, eval_names))
+          }
+          record_evals[[vname]][i, ] <- ev
+        }
+        if (verbose > 0L && (i %% eval_freq == 0L)) {
+          message(sprintf("[%d] %s: %s", i, vname,
+                          paste(eval_names, signif(ev, 6),
+                                sep = "=", collapse = " ")))
+        }
+        if (watch_early && j == 1L) {
+          es <- .es_step(es, ev[1L],
+                         .metric_higher_better(eval_names[1L]), i)
+        }
+      }
+      if (watch_early && es$stale >= early_stopping_rounds) {
+        if (verbose > 0L) {
+          message(sprintf("early stop at round %d (best %d: %s=%g)",
+                          i, es$best_iter, eval_names[1L], es$best))
+        }
+        # discard the trailing non-improving trees, the reference
+        # callback's best_iteration contract
+        for (k in seq_len(i - es$best_iter)) {
+          .Call(LGBMTPU_BoosterRollbackOneIter_R, ptr)
+        }
+        break
       }
     }
     if (isTRUE(finished)) {
       break
     }
   }
+  bst$best_iter <-
+    if (watch_early && es$best_iter > 0L) es$best_iter else
+      .Call(LGBMTPU_BoosterGetCurrentIteration_R, ptr)
+  bst$record_evals <- record_evals
   bst
 }
 

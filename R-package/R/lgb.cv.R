@@ -1,0 +1,137 @@
+# K-fold cross-validation (the role of the reference R-package's
+# lgb.cv.R, re-designed: folds are materialized as per-fold matrices on
+# the R side instead of Dataset subset handles, because the TPU dataset
+# re-bins per shard anyway; reference surface:
+# /root/reference/R-package/R/lgb.cv.R).
+
+#' Cross-validated training
+#'
+#' @param params named list of training parameters.
+#' @param data numeric matrix (rows = observations).
+#' @param label response vector.
+#' @param nrounds boosting iterations per fold.
+#' @param nfold number of folds.
+#' @param stratified stratify fold assignment by label (classification).
+#' @param folds optional explicit list of test-index vectors; overrides
+#'   nfold/stratified.
+#' @param early_stopping_rounds stop when the first eval metric has not
+#'   improved for this many rounds (NULL = never).
+#' @param verbose print per-round aggregated eval.
+#' @param eval_freq print frequency.
+#' @return list with `best_iter`, `record_evals` (metric -> matrix of
+#'   [round, fold] values), and `boosters` (the per-fold models).
+lgb.cv <- function(params = list(), data, label, nrounds = 100L,
+                   nfold = 5L, stratified = TRUE, folds = NULL,
+                   early_stopping_rounds = NULL, verbose = 1L,
+                   eval_freq = 1L) {
+  data <- as.matrix(data)
+  storage.mode(data) <- "double"
+  n <- nrow(data)
+  if (is.null(folds)) {
+    if (isTRUE(stratified) && length(unique(label)) <= 32L) {
+      # per-class round-robin keeps label balance inside each fold
+      assign <- integer(n)
+      for (cls in unique(label)) {
+        idx <- which(label == cls)
+        assign[idx] <- rep_len(seq_len(nfold), length(idx))
+      }
+    } else {
+      assign <- rep_len(seq_len(nfold), n)
+    }
+    folds <- lapply(seq_len(nfold), function(k) which(assign == k))
+  }
+  nfold <- length(folds)
+  boosters <- vector("list", nfold)
+  valid_sets <- vector("list", nfold)
+  for (k in seq_len(nfold)) {
+    test_idx <- folds[[k]]
+    dtrain <- lgb.Dataset(data[-test_idx, , drop = FALSE],
+                          label = label[-test_idx], params = params)
+    dvalid <- lgb.Dataset(data[test_idx, , drop = FALSE],
+                          label = label[test_idx], params = params,
+                          reference = dtrain)
+    ptr <- .Call(LGBMTPU_BoosterCreate_R, dtrain$ptr,
+                 .params_to_string(params))
+    .Call(LGBMTPU_BoosterAddValidData_R, ptr, dvalid$ptr)
+    boosters[[k]] <- list(ptr = ptr, train_set = dtrain)
+    class(boosters[[k]]) <- "lgb.Booster.tpu"
+    valid_sets[[k]] <- dvalid
+  }
+  eval_names <- NULL
+  record <- NULL
+  es <- .es_new()
+  for (i in seq_len(nrounds)) {
+    for (k in seq_len(nfold)) {
+      .Call(LGBMTPU_BoosterUpdateOneIter_R, boosters[[k]]$ptr)
+      ev <- .Call(LGBMTPU_BoosterGetEval_R, boosters[[k]]$ptr, 1L)
+      if (is.null(eval_names)) {
+        eval_names <- .Call(LGBMTPU_BoosterGetEvalNames_R,
+                            boosters[[k]]$ptr)
+        record <- lapply(eval_names,
+                         function(.) matrix(NA_real_, nrounds, nfold))
+        names(record) <- eval_names
+      }
+      for (j in seq_along(eval_names)) {
+        record[[j]][i, k] <- ev[j]
+      }
+    }
+    means <- vapply(record, function(m) mean(m[i, ]), numeric(1L))
+    if (verbose > 0L && (i %% eval_freq == 0L)) {
+      message(sprintf("[%d] cv %s", i,
+                      paste(eval_names,
+                            signif(means, 6), sep = "=",
+                            collapse = " ")))
+    }
+    if (!is.null(early_stopping_rounds)) {
+      if (length(eval_names) == 0L) {
+        stop("early_stopping_rounds requires at least one eval metric ",
+             "(the booster was configured with no metric)")
+      }
+      es <- .es_step(es, means[1L],
+                     .metric_higher_better(eval_names[1L]), i)
+      if (es$stale >= early_stopping_rounds) {
+        if (verbose > 0L) {
+          message(sprintf(
+            "early stop at round %d (best %d: %s=%g)", i,
+            es$best_iter, eval_names[1L], es$best))
+        }
+        break
+      }
+    } else {
+      es$best_iter <- i
+    }
+  }
+  list(best_iter = es$best_iter, record_evals = record,
+       boosters = boosters)
+}
+
+# metric direction table (mirrors the reference's maximize sets in
+# callback.R / basic.R); anchored so "mape" (lower-better) is not caught
+# by the "map" (ranking, higher-better) prefix
+.metric_higher_better <- function(name) {
+  grepl("^(auc|ndcg|map)($|@)", name)
+}
+
+# direction-aware improvement tracker shared by lgb.train and lgb.cv
+.es_new <- function() {
+  list(best = NA_real_, best_iter = 0L, stale = 0L)
+}
+
+.es_step <- function(st, value, higher, iter) {
+  if (is.na(value)) {
+    # no usable metric value: count as non-improving so a booster with
+    # metric="none" cannot silently run forever under early stopping
+    st$stale <- st$stale + 1L
+    return(st)
+  }
+  improved <- is.na(st$best) ||
+    (if (higher) value > st$best else value < st$best)
+  if (improved) {
+    st$best <- value
+    st$best_iter <- iter
+    st$stale <- 0L
+  } else {
+    st$stale <- st$stale + 1L
+  }
+  st
+}

@@ -1,0 +1,47 @@
+# Feature importance and model introspection (the role of the reference
+# R-package's lgb.importance.R / lgb.dump.R over
+# LGBM_BoosterFeatureImportance; reference R surface:
+# /root/reference/R-package/R/lgb.importance.R).
+
+#' Feature importance of a trained model
+#'
+#' @param booster lgb.Booster.tpu.
+#' @param percentage normalize each column to sum to 1.
+#' @param num_iteration iterations to credit (-1 = all).
+#' @return data.frame with Feature / Gain / Split columns, sorted by
+#'   Gain descending (the reference returns the same three columns).
+lgb.importance <- function(booster, percentage = TRUE,
+                           num_iteration = -1L) {
+  stopifnot(inherits(booster, "lgb.Booster.tpu"))
+  niter <- as.integer(num_iteration)
+  splits <- .Call(LGBMTPU_BoosterFeatureImportance_R, booster$ptr,
+                  niter, 0L)   # C_API_FEATURE_IMPORTANCE_SPLIT
+  gains <- .Call(LGBMTPU_BoosterFeatureImportance_R, booster$ptr,
+                 niter, 1L)    # C_API_FEATURE_IMPORTANCE_GAIN
+  feats <- NULL
+  if (!is.null(booster$train_set)) {
+    feats <- tryCatch(
+      .Call(LGBMTPU_DatasetGetFeatureNames_R, booster$train_set$ptr),
+      error = function(e) NULL)
+  }
+  if (is.null(feats) || length(feats) != length(splits)) {
+    feats <- paste0("Column_", seq_along(splits) - 1L)
+  }
+  if (isTRUE(percentage)) {
+    if (sum(gains) > 0) gains <- gains / sum(gains)
+    if (sum(splits) > 0) splits <- splits / sum(splits)
+  }
+  out <- data.frame(Feature = feats, Gain = gains, Split = splits,
+                    stringsAsFactors = FALSE)
+  out[order(-out$Gain), , drop = FALSE]
+}
+
+#' Dump a model to a JSON string
+#'
+#' @param booster lgb.Booster.tpu.
+#' @param num_iteration iterations to dump (-1 = all).
+lgb.dump <- function(booster, num_iteration = -1L) {
+  stopifnot(inherits(booster, "lgb.Booster.tpu"))
+  .Call(LGBMTPU_BoosterDumpModel_R, booster$ptr,
+        as.integer(num_iteration))
+}

@@ -1,0 +1,48 @@
+# Convenience entry point + RDS persistence (the role of the reference
+# R-package's lightgbm.R / saveRDS.lgb.Booster.R / readRDS.lgb.Booster.R:
+# external-pointer handles do not survive serialize(), so RDS round-trips
+# go through the reference text model format).
+
+#' One-call training from a matrix
+#'
+#' @param data numeric matrix, or lgb.Dataset.
+#' @param label response vector (ignored when data is an lgb.Dataset).
+#' @param params named list of training parameters.
+#' @param nrounds boosting iterations.
+#' @param ... forwarded to lgb.train.
+lightgbm <- function(data, label = NULL, params = list(),
+                     nrounds = 100L, ...) {
+  if (!inherits(data, "lgb.Dataset.tpu")) {
+    data <- lgb.Dataset(data, label = label, params = params)
+  }
+  lgb.train(params = params, data = data, nrounds = nrounds, ...)
+}
+
+#' Load a model from a model-string (inverse of lgb.model.to.string)
+lgb.load.from.string <- function(model_str) {
+  ptr <- .Call(LGBMTPU_BoosterLoadModelFromString_R, model_str)
+  bst <- list(ptr = ptr)
+  class(bst) <- "lgb.Booster.tpu"
+  bst
+}
+
+#' Save a Booster to an RDS file (handle-safe)
+#'
+#' The reference ships saveRDS.lgb.Booster for the same reason: the
+#' booster's external pointer dies with the session, so the RDS payload
+#' carries the text model instead.
+saveRDS.lgb.Booster <- function(object, file, ...) {
+  stopifnot(inherits(object, "lgb.Booster.tpu"))
+  payload <- list(class = "lgb.Booster.tpu",
+                  model_str = lgb.model.to.string(object))
+  saveRDS(payload, file = file, ...)
+}
+
+#' Restore a Booster from an RDS file written by saveRDS.lgb.Booster
+readRDS.lgb.Booster <- function(file, ...) {
+  payload <- readRDS(file, ...)
+  if (!is.list(payload) || is.null(payload$model_str)) {
+    stop("file does not contain a saved lightgbm_tpu booster")
+  }
+  lgb.load.from.string(payload$model_str)
+}

@@ -314,6 +314,38 @@ SEXP LGBMTPU_BoosterSaveModelToString_R(SEXP handle, SEXP num_iteration) {
   return Rf_mkString(buf);
 }
 
+SEXP LGBMTPU_BoosterGetNumFeature_R(SEXP handle) {
+  int n = 0;
+  CHECK_CALL(LGBM_BoosterGetNumFeature(get_handle(handle), &n));
+  return Rf_ScalarInteger(n);
+}
+
+SEXP LGBMTPU_BoosterFeatureImportance_R(SEXP handle, SEXP num_iteration,
+                                        SEXP importance_type) {
+  int n = 0;
+  CHECK_CALL(LGBM_BoosterGetNumFeature(get_handle(handle), &n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  CHECK_CALL(LGBM_BoosterFeatureImportance(get_handle(handle),
+                                           Rf_asInteger(num_iteration),
+                                           Rf_asInteger(importance_type),
+                                           REAL(out)));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMTPU_BoosterDumpModel_R(SEXP handle, SEXP num_iteration) {
+  int niter = Rf_asInteger(num_iteration);
+  int64_t len = 0;
+  /* first call sizes the JSON, second fills it */
+  CHECK_CALL(LGBM_BoosterDumpModel(get_handle(handle), 0, niter, 0, &len,
+                                   NULL));
+  char* buf = (char*)R_alloc((size_t)len + 1, 1);
+  int64_t got = 0;
+  CHECK_CALL(LGBM_BoosterDumpModel(get_handle(handle), 0, niter, len + 1,
+                                   &got, buf));
+  return Rf_mkString(buf);
+}
+
 SEXP LGBMTPU_BoosterFree_R(SEXP handle) {
   booster_finalizer(handle);
   return R_NilValue;
@@ -348,6 +380,9 @@ static const R_CallMethodDef CallEntries[] = {
     CALLDEF(LGBMTPU_BoosterPredictForMat_R, 5),
     CALLDEF(LGBMTPU_BoosterSaveModel_R, 3),
     CALLDEF(LGBMTPU_BoosterSaveModelToString_R, 2),
+    CALLDEF(LGBMTPU_BoosterGetNumFeature_R, 1),
+    CALLDEF(LGBMTPU_BoosterFeatureImportance_R, 3),
+    CALLDEF(LGBMTPU_BoosterDumpModel_R, 2),
     CALLDEF(LGBMTPU_BoosterFree_R, 1),
     {NULL, NULL, 0}};
 

@@ -68,7 +68,7 @@ def stack_trees(trees: List, num_features: int = -1) -> TreeStack:
             continue
         if num_features >= 0 and n > 0 and \
                 int(np.max(t.split_feature_inner[:n])) >= num_features:
-            raise ValueError(
+            raise LightGBMError(
                 f"tree {i} splits on feature "
                 f"{int(np.max(t.split_feature_inner[:n]))} but the bin "
                 f"matrix has only {num_features} features")

@@ -28,7 +28,15 @@ from ..utils.log import check, log_fatal, log_info, log_warning
 from ..utils.phase import GLOBAL_TIMER as _PHASES
 from .grower import (GrowerParams, _pack_tree_device, fetch_tree_arrays,
                      make_grow_tree, unpack_tree_buffers)
+from .grower_seg import print_seg_stats, seg_stats_enabled
 from .tree import Tree
+
+
+def _maybe_print_seg_stats(stats) -> None:
+    """Render a grower's counter output when LIGHTGBM_TPU_SEG_STATS asks
+    for it (stats is () for growers that emit none, e.g. the fused one)."""
+    if stats and seg_stats_enabled():
+        print_seg_stats(stats[0])
 
 
 def _auto_frontier_k(cfg, num_columns: int, num_bins: int) -> int:
@@ -640,14 +648,14 @@ class GBDT:
                 h_k = jnp.pad(h_k, (0, pad))
                 member = jnp.pad(member, (0, pad))
             kw = {} if roots is None else {"root_hist": roots[k]}
-            arrays, leaf_id = grow_fn(bins, g_k, h_k, member, fmeta,
-                                      fmask, sub, **kw)
+            arrays, leaf_id, *stats = grow_fn(bins, g_k, h_k, member,
+                                              fmeta, fmask, sub, **kw)
             if pad:
                 leaf_id = leaf_id[:N]
             new_row = score[k] + shrinkage * arrays.leaf_value[leaf_id]
             score = score.at[k].set(new_row)
             ints_d, floats_d = _pack_tree_device(arrays)
-            return score, ints_d, floats_d
+            return score, ints_d, floats_d, tuple(stats)
 
         self._fused_fns = (fused_grad, fused_step, fused_roots)
 
@@ -793,9 +801,10 @@ class GBDT:
                     h_k = jnp.pad(h_k, (0, self._row_pad))
                     member = jnp.pad(member, (0, self._row_pad))
                 with _PHASES.phase("grow") as box:
-                    arrays, leaf_id = self._grow_fn(
+                    arrays, leaf_id, *stats = self._grow_fn(
                         self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
                     box[0] = leaf_id
+                _maybe_print_seg_stats(stats)
                 if self._row_pad:
                     leaf_id = leaf_id[: self.num_data]
                 with _PHASES.phase("score") as box:
@@ -833,8 +842,9 @@ class GBDT:
                 g_k = jnp.pad(g_k, (0, self._row_pad))
                 h_k = jnp.pad(h_k, (0, self._row_pad))
                 member = jnp.pad(member, (0, self._row_pad))
-            arrays, leaf_id = self._grow_fn(
+            arrays, leaf_id, *stats = self._grow_fn(
                 self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
+            _maybe_print_seg_stats(stats)
             if self._row_pad:
                 leaf_id = leaf_id[: self.num_data]
             arrays = fetch_tree_arrays(arrays)
@@ -904,11 +914,12 @@ class GBDT:
             self._key, sub = jax.random.split(self._key)
             with _PHASES.phase("grow") as box:
                 extra = () if roots is None else (roots,)
-                self.train_score, ints_d, floats_d = fused_step(
+                self.train_score, ints_d, floats_d, stats_t = fused_step(
                     self.train_score, grads, hesss, self.bag_weight,
                     self.bins, self.fmeta, fmask, sub,
                     jnp.float32(self.shrinkage_rate), jnp.int32(k), *extra)
                 box[0] = self.train_score
+            _maybe_print_seg_stats(stats_t)
             for buf in (ints_d, floats_d):
                 copy_async = getattr(buf, "copy_to_host_async", None)
                 if copy_async is not None:

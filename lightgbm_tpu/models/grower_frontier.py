@@ -46,7 +46,7 @@ from ..ops.split import (NEG_INF, FeatureMeta, best_split,
 from .grower import (GrowerParams, _node_feature_mask, mono_handoff,
                      routed_left)
 from .grower_seg import (COMPACT_WASTE, _SegState, compact_state,
-                         fresh_state)
+                         fresh_state, seg_stats_enabled)
 
 
 def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
@@ -356,14 +356,12 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             return (st.num_leaves < L) & (jnp.max(st.best_f32[:, 0]) > 0.0)
 
         st = lax.while_loop(cond, round_body, st)
-        if _os.environ.get("LIGHTGBM_TPU_SEG_STATS"):
-            jax.debug.print(
-                "frontier stats: scanned {s} blocks ({x:.1f} N-eq), "
-                "{c} compactions, K={k}",
-                s=st.scanned_total, x=st.scanned_total / max_blocks,
-                c=st.num_sorts, k=K)
         leaf_id_orig = jnp.zeros(n, jnp.int32).at[st.order].set(st.leaf_id)
-        return st.tree, leaf_id_orig
+        # counters as a third jit output with stable arity (axon rejects
+        # in-jit host callbacks); printing is env-gated at call sites
+        stats = jnp.stack([st.scanned_total, st.num_sorts,
+                           jnp.int32(max_blocks), jnp.int32(K)])
+        return st.tree, leaf_id_orig, stats
 
     if wrap is not None:
         return wrap(grow)

@@ -58,6 +58,31 @@ import os as _os
 COMPACT_WASTE = float(_os.environ.get("LIGHTGBM_TPU_COMPACT_WASTE", "2.0"))
 
 
+def seg_stats_enabled() -> bool:
+    """When LIGHTGBM_TPU_SEG_STATS is set, growers return a third output
+    of i32 counters [scanned_blocks, compactions, max_blocks, K] (one row
+    per device under the data-parallel wrappers)."""
+    return bool(_os.environ.get("LIGHTGBM_TPU_SEG_STATS"))
+
+
+def print_seg_stats(stats) -> None:
+    """Host-side rendering of the counters a grower returned (the axon
+    backend rejects in-jit host callbacks, so this replaces the old
+    jax.debug.print).  Accepts [4] or a per-device concatenation [D*4]."""
+    import sys
+
+    import numpy as np
+
+    rows = np.asarray(stats).reshape(-1, 4)
+    for d, (scanned, sorts, max_blocks, k) in enumerate(rows):
+        dev = f" dev{d}" if len(rows) > 1 else ""
+        sys.stderr.write(
+            f"seg stats{dev}: scanned {int(scanned)} blocks "
+            f"({scanned / max(int(max_blocks), 1):.1f} N-equivalents), "
+            f"{int(sorts)} compactions, K={int(k)}\n")
+    sys.stderr.flush()
+
+
 class _SegState(NamedTuple):
     binsT: jax.Array           # [F4, Npad] u8/i8, permuted
     w8: jax.Array              # [8, Npad] bf16 channels, permuted
@@ -468,15 +493,15 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         st = scan_leaf(st, 0, root_hist, G0, H0, C0, jnp.int32(0), fmeta,
                        feature_mask, key, 2 * L)
         st = lax.fori_loop(0, L - 1, body, st)
-        if _os.environ.get("LIGHTGBM_TPU_SEG_STATS"):
-            jax.debug.print(
-                "seg stats: scanned {s} blocks ({x:.1f} N-equivalents), "
-                "{c} compactions",
-                s=st.scanned_total,
-                x=st.scanned_total / max_blocks, c=st.num_sorts)
         # leaf ids back in original row order
         leaf_id_orig = jnp.zeros(n, jnp.int32).at[st.order].set(st.leaf_id)
-        return st.tree, leaf_id_orig
+        # scan/compaction counters always leave the jit as a third output
+        # (stable arity; the axon PJRT backend rejects host callbacks, so
+        # no jax.debug.print in compiled code) — printing them is gated
+        # on LIGHTGBM_TPU_SEG_STATS at the call sites
+        stats = jnp.stack([st.scanned_total, st.num_sorts,
+                           jnp.int32(max_blocks), jnp.int32(1)])
+        return st.tree, leaf_id_orig, stats
 
     if wrap is not None:
         return wrap(grow)

@@ -444,11 +444,15 @@ def _kernel_frontier(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref, *,
         def wfn(c, chunk):
             wc = w_ref[:, pl.ds(c * chunk, chunk)]          # [8, chunk]
             lc = lid_ref[:, pl.ds(c * chunk, chunk)]        # [1, chunk]
-            # [K, chunk] leaf masks -> [K, 8, chunk] -> [8K, chunk]
-            targets = sref[2:2 + K]
-            masks = (lc == targets[:, None]).astype(jnp.bfloat16)
-            wk = masks[:, None, :] * wc[None, :, :]
-            return wk.reshape(K * NUM_CHANNELS, chunk)
+            # K is static, so the target loads unroll into K SCALAR reads
+            # (Mosaic rejects vector loads from SMEM — sref[2:2+K] lowers
+            # on the CPU interpreter but not on the chip) and the [8K,
+            # chunk] weight block is a K-way concat of masked channels
+            rows = []
+            for k in range(K):
+                mask = (lc == sref[2 + k]).astype(jnp.bfloat16)
+                rows.append(mask * wc)                      # [8, chunk]
+            return jnp.concatenate(rows, axis=0)            # [8K, chunk]
 
         _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
 

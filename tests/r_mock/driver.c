@@ -31,6 +31,11 @@ extern SEXP LGBMTPU_BoosterGetEvalNames_R(SEXP);
 extern SEXP LGBMTPU_BoosterGetEval_R(SEXP, SEXP);
 extern SEXP LGBMTPU_BoosterPredictForMat_R(SEXP, SEXP, SEXP, SEXP, SEXP);
 extern SEXP LGBMTPU_BoosterSaveModel_R(SEXP, SEXP, SEXP);
+extern SEXP LGBMTPU_BoosterSaveModelToString_R(SEXP, SEXP);
+extern SEXP LGBMTPU_BoosterLoadModelFromString_R(SEXP);
+extern SEXP LGBMTPU_BoosterGetNumFeature_R(SEXP);
+extern SEXP LGBMTPU_BoosterFeatureImportance_R(SEXP, SEXP, SEXP);
+extern SEXP LGBMTPU_BoosterDumpModel_R(SEXP, SEXP);
 extern SEXP LGBMTPU_BoosterFree_R(SEXP);
 
 #define N 400
@@ -142,6 +147,49 @@ int main(int argc, char** argv) {
       return 1;
     }
   }
+
+  /* importance: the label is a threshold on feature 0, so the split
+   * counts must concentrate there */
+  if (Rf_asInteger(LGBMTPU_BoosterGetNumFeature_R(bst)) != F) {
+    fprintf(stderr, "booster num_feature mismatch\n");
+    return 1;
+  }
+  SEXP imp_split = LGBMTPU_BoosterFeatureImportance_R(bst, all_iters,
+                                                      Rf_ScalarInteger(0));
+  SEXP imp_gain = LGBMTPU_BoosterFeatureImportance_R(bst, all_iters,
+                                                     Rf_ScalarInteger(1));
+  if (Rf_length(imp_split) != F || Rf_length(imp_gain) != F) {
+    fprintf(stderr, "importance length mismatch\n");
+    return 1;
+  }
+  for (int j = 1; j < F; ++j) {
+    if (imp_split->reals[0] < imp_split->reals[j] ||
+        imp_gain->reals[0] < imp_gain->reals[j]) {
+      fprintf(stderr, "importance did not favor feature 0\n");
+      return 1;
+    }
+  }
+
+  /* JSON dump sanity */
+  SEXP dump = LGBMTPU_BoosterDumpModel_R(bst, all_iters);
+  const char* js = CHAR(STRING_ELT(dump, 0));
+  if (js[0] != '{' || strstr(js, "tree_info") == NULL) {
+    fprintf(stderr, "dump is not a model JSON\n");
+    return 1;
+  }
+
+  /* model-string round trip (the RDS persistence path) */
+  SEXP mstr = LGBMTPU_BoosterSaveModelToString_R(bst, all_iters);
+  SEXP bst3 = LGBMTPU_BoosterLoadModelFromString_R(mstr);
+  SEXP pred3 = LGBMTPU_BoosterPredictForMat_R(bst3, mat, zero, all_iters,
+                                              empty);
+  for (int i = 0; i < N; ++i) {
+    if (fabs(pred->reals[i] - pred3->reals[i]) > 1e-6) {
+      fprintf(stderr, "string-loaded prediction mismatch at %d\n", i);
+      return 1;
+    }
+  }
+  LGBMTPU_BoosterFree_R(bst3);
 
   LGBMTPU_BoosterFree_R(bst);
   LGBMTPU_BoosterFree_R(bst2);

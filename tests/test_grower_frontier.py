@@ -208,3 +208,25 @@ def test_frontier_multiclass_batched_roots_parity(rng):
     np.testing.assert_allclose(fused._raw_predict(X),
                                eager._raw_predict(X),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_seg_stats_counters_via_outputs(rng, monkeypatch, capfd):
+    """LIGHTGBM_TPU_SEG_STATS threads the scan/compaction counters out of
+    the jit as a third output (the axon PJRT backend rejects in-jit host
+    callbacks, so they must NOT be debug.print'ed) and prints them
+    host-side."""
+    monkeypatch.setenv("LIGHTGBM_TPU_SEG_STATS", "1")
+    n = 2500
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    for impl, k_expect in (("segment", 1), ("frontier", None)):
+        bst = _train(X, y, impl, n_iters=2, objective="binary",
+                     num_leaves=15, min_data_in_leaf=5, tpu_row_chunk=256)
+        assert bst._raw_predict(X).size == n
+        err = capfd.readouterr().err
+        lines = [ln for ln in err.splitlines() if "seg stats" in ln]
+        assert len(lines) >= 2, (impl, err)
+        # counters are sane: scanned >= 1 N-equivalent, K as configured
+        assert "N-equivalents" in lines[-1]
+        if k_expect is not None:
+            assert f"K={k_expect}" in lines[-1]

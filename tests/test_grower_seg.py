@@ -167,7 +167,7 @@ def test_segment_grower_direct_leaf_id(rng):
         jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
         jnp.asarray(member), fmeta, fmask, key)
     params_s = params._replace(hist_backend="pallas")
-    tree_s, lid_s = make_grow_tree_segment(B, params_s, rb)(
+    tree_s, lid_s, _ = make_grow_tree_segment(B, params_s, rb)(
         jnp.asarray(bins.T.copy()), jnp.asarray(g), jnp.asarray(h),
         jnp.asarray(member), fmeta, fmask, key)
 

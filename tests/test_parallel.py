@@ -231,3 +231,19 @@ def test_data_parallel_frontier_matches_serial_frontier(rng):
                                rtol=1e-3, atol=1e-4)
     for ts, td in zip(serial.gbdt.models, data.gbdt.models):
         assert ts.num_leaves == td.num_leaves
+
+
+def test_seg_stats_under_data_parallel(rng, monkeypatch, capfd):
+    """Under the data-parallel wrappers the per-device counters come back
+    stacked (out_specs P(axis)); one printed row per device."""
+    monkeypatch.setenv("LIGHTGBM_TPU_SEG_STATS", "1")
+    n = 4000
+    X = rng.normal(size=(n, 6))
+    y = X[:, 0] + 0.5 * X[:, 1] + rng.normal(size=n) * 0.1
+    bst = _train(X, y, "data", tpu_histogram_backend="pallas",
+                 tpu_tree_impl="segment", tpu_row_chunk=256)
+    assert bst.gbdt._use_segment
+    err = capfd.readouterr().err
+    rows = [ln for ln in err.splitlines() if "seg stats" in ln]
+    assert len(rows) >= 8, err[:2000]
+    assert any("dev7" in ln for ln in rows), rows[:9]
