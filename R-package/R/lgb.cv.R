@@ -45,6 +45,12 @@ lgb.cv <- function(params = list(), data, label, nrounds = 100L,
   valid_sets <- vector("list", nfold)
   for (k in seq_len(nfold)) {
     test_idx <- folds[[k]]
+    # R pitfall: data[-integer(0), ] selects ZERO rows, so an empty fold
+    # would silently train on an empty dataset instead of all rows
+    if (length(test_idx) == 0L) {
+      stop(sprintf("lgb.cv: fold %d is empty (too many folds for the data?)",
+                   k))
+    }
     dtrain <- lgb.Dataset(data[-test_idx, , drop = FALSE],
                           label = label[-test_idx], params = params)
     dvalid <- lgb.Dataset(data[test_idx, , drop = FALSE],

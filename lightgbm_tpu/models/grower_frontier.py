@@ -31,15 +31,13 @@ merged in one all_gather.
 
 from __future__ import annotations
 
-import os as _os
-from typing import NamedTuple
-
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.pallas_histogram import (frontier_width, histogram_frontier,
-                                    pack_channels, slice_packed_column,
+from ..ops.pallas_histogram import (_segment_buckets, frontier_width,
+                                    histogram_frontier, pack_channels,
+                                    segment_grid_size, slice_packed_column,
                                     unpack_hist)
 from ..ops.split import (NEG_INF, FeatureMeta, best_split,
                          expand_group_hist, reconstruct_feature_column)
@@ -134,6 +132,11 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             G0, H0, C0 = (comm.reduce_stats(G0), comm.reduce_stats(H0),
                           comm.reduce_stats(C0))
         all_blocks = jnp.arange(max_blocks, dtype=jnp.int32)
+        # grid-step accounting (same rule as histogram_frontier's dispatch)
+        bucket_arr = jnp.asarray(_segment_buckets(max_blocks), jnp.int32)
+
+        def grid_of(nb):
+            return segment_grid_size(bucket_arr, nb)
 
         def hist_batch(st: _SegState, targets, block_list, n_blocks):
             """[K] targets (-1 = skip) -> [K, G, B, 3] over the union."""
@@ -305,6 +308,7 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                 .at[idx_r].set(hist_right, mode="drop"),
                 scanned_since=st.scanned_since + n_un,
                 scanned_total=st.scanned_total + n_un,
+                grid_total=st.grid_total + grid_of(n_un),
             )
 
             # 4) scan all 2K children in one vmapped pass
@@ -342,7 +346,8 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                                    jnp.int32(max_blocks))[0]
         st = st._replace(leaf_hist=st.leaf_hist.at[0].set(root_hist),
                          scanned_since=jnp.int32(max_blocks),
-                         scanned_total=jnp.int32(max_blocks))
+                         scanned_total=jnp.int32(max_blocks),
+                         grid_total=jnp.int32(max_blocks))
         info0, gain0 = _one_scan(st, root_hist, G0, H0, C0, jnp.int32(0),
                                  fmeta, feature_mask, key, 2 * L,
                                  st.leaf_mono_lo[0], st.leaf_mono_hi[0])
@@ -359,8 +364,9 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         leaf_id_orig = jnp.zeros(n, jnp.int32).at[st.order].set(st.leaf_id)
         # counters as a third jit output with stable arity (axon rejects
         # in-jit host callbacks); printing is env-gated at call sites
-        stats = jnp.stack([st.scanned_total, st.num_sorts,
-                           jnp.int32(max_blocks), jnp.int32(K)])
+        stats = jnp.stack([st.scanned_total, st.num_sorts, st.grid_total,
+                           jnp.int32(max_blocks), jnp.int32(K),
+                           jnp.int32(0)])
         return st.tree, leaf_id_orig, stats
 
     if wrap is not None:

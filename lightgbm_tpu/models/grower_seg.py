@@ -37,8 +37,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.pallas_histogram import (NUM_CHANNELS, histogram_segment,
-                                    pack_channels, slice_packed_column,
+from ..ops.pallas_histogram import (NUM_CHANNELS, _segment_buckets,
+                                    histogram_segment, pack_channels,
+                                    segment_grid_size, slice_packed_column,
                                     unpack_hist)
 from ..ops.split import (NEG_INF, FeatureMeta, best_split, expand_group_hist,
                          reconstruct_feature_column)
@@ -53,32 +54,43 @@ from .grower import (CommHooks, GrowerParams, TreeArrays,
 # tree; the amortized rule bounds scan waste at ~(1 + COMPACT_WASTE/2) x
 # ideal while the number of sorts stays <= total_scanned / (COMPACT_WASTE
 # x N).  Overridable via LIGHTGBM_TPU_COMPACT_WASTE (in N multiples).
+# Default from the round-4 on-chip sweep at 10.5M rows (ONCHIP_LOG.md):
+# the full-payload sort measures ~190 ms in context — ~5x the in-jit
+# micro's estimate — so trading scan waste for fewer sorts wins:
+# per-iter 3.13 s (waste=1.0) / 2.30 s (2.0) / 1.91 s (3.0).
 import os as _os
 
-COMPACT_WASTE = float(_os.environ.get("LIGHTGBM_TPU_COMPACT_WASTE", "2.0"))
+COMPACT_WASTE = float(_os.environ.get("LIGHTGBM_TPU_COMPACT_WASTE", "3.0"))
 
 
 def seg_stats_enabled() -> bool:
     """When LIGHTGBM_TPU_SEG_STATS is set, growers return a third output
-    of i32 counters [scanned_blocks, compactions, max_blocks, K] (one row
-    per device under the data-parallel wrappers)."""
+    of i32 counters [scanned_blocks, compactions, grid_steps, max_blocks,
+    K, 0] (one row per device under the data-parallel wrappers)."""
     return bool(_os.environ.get("LIGHTGBM_TPU_SEG_STATS"))
 
 
 def print_seg_stats(stats) -> None:
     """Host-side rendering of the counters a grower returned (the axon
     backend rejects in-jit host callbacks, so this replaces the old
-    jax.debug.print).  Accepts [4] or a per-device concatenation [D*4]."""
+    jax.debug.print).  Accepts [6] or a per-device concatenation [D*6].
+
+    ``grid`` counts the kernel grid steps actually dispatched (the bucket
+    the interval landed in, summed over calls); grid − scanned is the
+    skipped-step waste the static bucket ladder pays
+    (ops/pallas_histogram._segment_buckets)."""
     import sys
 
     import numpy as np
 
-    rows = np.asarray(stats).reshape(-1, 4)
-    for d, (scanned, sorts, max_blocks, k) in enumerate(rows):
+    rows = np.asarray(stats).reshape(-1, 6)
+    for d, (scanned, sorts, grid, max_blocks, k, _r) in enumerate(rows):
         dev = f" dev{d}" if len(rows) > 1 else ""
+        nb = max(int(max_blocks), 1)
         sys.stderr.write(
             f"seg stats{dev}: scanned {int(scanned)} blocks "
-            f"({scanned / max(int(max_blocks), 1):.1f} N-equivalents), "
+            f"({scanned / nb:.1f} N-equivalents), "
+            f"grid {int(grid)} steps ({grid / nb:.1f} N-equivalents), "
             f"{int(sorts)} compactions, K={int(k)}\n")
     sys.stderr.flush()
 
@@ -94,6 +106,7 @@ class _SegState(NamedTuple):
     # in total (adaptive-compaction accounting + perf introspection)
     scanned_since: jax.Array   # i32 scalar
     scanned_total: jax.Array   # i32 scalar
+    grid_total: jax.Array      # i32 scalar: kernel grid steps dispatched
     num_sorts: jax.Array       # i32 scalar
     num_leaves: jax.Array
     leaf_hist: jax.Array       # [L, F, B, 3]
@@ -130,9 +143,14 @@ def _unpack_bins_words(words, dtype):
 
 
 def _pack_w8_words(w8):
-    """[8, N] bf16 -> [4, N] i32 for sort payload."""
+    """[8, N] bf16 -> [3, N] i32 for sort payload.
+
+    Channels 5-7 are structurally zero (pack_channels pads g_hi/g_lo/
+    h_hi/h_lo/member to 8 for the kernel's channel tile), so only 3 of
+    the 4 halfword-pair words carry information — carrying the zero word
+    through the multi-operand compaction sort was pure payload waste."""
     u = lax.bitcast_convert_type(w8, jnp.uint16).astype(jnp.uint32)  # [8,N]
-    return (u[0::2] | (u[1::2] << 16)).astype(jnp.int32)
+    return (u[0:6:2] | (u[1:6:2] << 16)).astype(jnp.int32)
 
 
 def compact_state(st: _SegState, L: int, rb: int) -> _SegState:
@@ -148,8 +166,8 @@ def compact_state(st: _SegState, L: int, rb: int) -> _SegState:
     W = st.binsT.shape[0] // 4
     binsT = _unpack_bins_words(jnp.stack(sorted_ops[1:1 + W]),
                                st.binsT.dtype)
-    w8 = _unpack_w8_words(jnp.stack(sorted_ops[1 + W:1 + W + 4]))
-    order = sorted_ops[1 + W + 4]
+    w8 = _unpack_w8_words(jnp.stack(sorted_ops[1 + W:1 + W + 3]))
+    order = sorted_ops[1 + W + 3]
     leaves = jnp.arange(L, dtype=jnp.int32)
     starts = jnp.searchsorted(lid, leaves, side="left").astype(jnp.int32)
     ends = jnp.searchsorted(lid, leaves, side="right").astype(jnp.int32)
@@ -195,6 +213,7 @@ def fresh_state(binsT, w8, n, L, G_cols, B, F, max_blocks, G0, H0, C0,
         leaf_hi=jnp.zeros(L, dtype=jnp.int32).at[0].set(max_blocks),
         scanned_since=jnp.int32(0),
         scanned_total=jnp.int32(0),
+        grid_total=jnp.int32(0),
         num_sorts=jnp.int32(0),
         num_leaves=jnp.int32(1),
         leaf_hist=jnp.zeros((L, G_cols, B, 3), dtype=jnp.float32),
@@ -214,11 +233,14 @@ def fresh_state(binsT, w8, n, L, G_cols, B, F, max_blocks, G0, H0, C0,
 
 
 def _unpack_w8_words(words):
+    """[3, N] i32 -> [8, N] bf16 (channels 5-7 restored as zeros)."""
     u = words.astype(jnp.uint32)
     lo = (u & 0xFFFF).astype(jnp.uint16)
     hi = (u >> 16).astype(jnp.uint16)
-    inter = jnp.stack([lo, hi], axis=1).reshape(NUM_CHANNELS, -1)
-    return lax.bitcast_convert_type(inter, jnp.bfloat16)
+    inter = jnp.stack([lo, hi], axis=1).reshape(6, -1)
+    ch6 = lax.bitcast_convert_type(inter, jnp.bfloat16)
+    return jnp.concatenate(
+        [ch6, jnp.zeros((NUM_CHANNELS - 6, ch6.shape[1]), jnp.bfloat16)])
 
 
 def make_grow_tree_segment(num_bins: int, params: GrowerParams,
@@ -332,6 +354,13 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         if fpad:
             binsT = jnp.pad(binsT, ((0, fpad), (0, 0)))
 
+        # grid-step accounting: the bucket ladder is static, so the grid
+        # size a call dispatched is recomputable from its interval length
+        bucket_arr = jnp.asarray(_segment_buckets(max_blocks), jnp.int32)
+
+        def grid_of(nb):
+            return segment_grid_size(bucket_arr, nb)
+
         w8 = pack_channels(grad, hess, member)
         G0 = jnp.sum(grad * member)
         H0 = jnp.sum(hess * member)
@@ -398,7 +427,8 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             smaller = jnp.where(smaller_is_left, leaf, new_leaf)
             hist_small, blk = hist_leaf(st, smaller, G_cols)
             st = st._replace(scanned_since=st.scanned_since + blk,
-                             scanned_total=st.scanned_total + blk)
+                             scanned_total=st.scanned_total + blk,
+                             grid_total=st.grid_total + grid_of(blk))
             hist_parent = st.leaf_hist[leaf]
             hist_large = hist_parent - hist_small
             hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
@@ -489,7 +519,8 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             # adaptive-compaction accounting is unchanged
             root_blk = jnp.int32(max_blocks)
         st = st._replace(leaf_hist=st.leaf_hist.at[0].set(root_hist),
-                         scanned_since=root_blk, scanned_total=root_blk)
+                         scanned_since=root_blk, scanned_total=root_blk,
+                         grid_total=jnp.int32(max_blocks))
         st = scan_leaf(st, 0, root_hist, G0, H0, C0, jnp.int32(0), fmeta,
                        feature_mask, key, 2 * L)
         st = lax.fori_loop(0, L - 1, body, st)
@@ -499,8 +530,9 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         # (stable arity; the axon PJRT backend rejects host callbacks, so
         # no jax.debug.print in compiled code) — printing them is gated
         # on LIGHTGBM_TPU_SEG_STATS at the call sites
-        stats = jnp.stack([st.scanned_total, st.num_sorts,
-                           jnp.int32(max_blocks), jnp.int32(1)])
+        stats = jnp.stack([st.scanned_total, st.num_sorts, st.grid_total,
+                           jnp.int32(max_blocks), jnp.int32(1),
+                           jnp.int32(0)])
         return st.tree, leaf_id_orig, stats
 
     if wrap is not None:

@@ -301,6 +301,18 @@ def _segment_buckets(max_blocks: int) -> list:
     return sorted(set(buckets))
 
 
+def segment_grid_size(bucket_arr: jax.Array, n_blocks) -> jax.Array:
+    """Grid steps the bucketed dispatch runs for an ``n_blocks``-long
+    interval — the same smallest-covering-bucket rule histogram_segment
+    and histogram_frontier apply (``bucket_arr`` is
+    ``jnp.asarray(_segment_buckets(max_blocks))``).  Lives here so the
+    growers' seg-stats grid accounting can never drift from the actual
+    dispatch."""
+    idx = jnp.minimum(jnp.sum(bucket_arr < n_blocks),
+                      bucket_arr.shape[0] - 1)
+    return bucket_arr[idx]
+
+
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "block_rows", "grid_blocks",
                                     "interpret", "packed4"))

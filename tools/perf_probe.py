@@ -30,9 +30,13 @@ def run(n_rows: int, num_leaves: int, warmup: int, measure: int) -> None:
     # LIGHTGBM_TPU_IMPL=segment|frontier|fused switches the grower for
     # on-chip A/B runs (frontier is the batched-MXU candidate)
     impl = os.environ.get("LIGHTGBM_TPU_IMPL", "auto")
+    # LIGHTGBM_TPU_ROW_CHUNK overrides the auto row-block size for
+    # block-granularity A/Bs (finer blocks = tighter confinement
+    # intervals but more grid steps)
+    row_chunk = int(os.environ.get("LIGHTGBM_TPU_ROW_CHUNK", "0"))
     cfg = Config(objective="binary", num_leaves=num_leaves, max_bin=63,
                  learning_rate=0.1, min_sum_hessian_in_leaf=100.0,
-                 verbosity=-1, tpu_tree_impl=impl)
+                 verbosity=-1, tpu_tree_impl=impl, tpu_row_chunk=row_chunk)
     ds = TpuDataset.from_numpy(X, y, config=cfg)
     obj = create_objective(cfg)
     obj.init(ds.metadata, ds.num_data)
