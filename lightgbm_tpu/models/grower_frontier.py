@@ -43,8 +43,8 @@ from ..ops.split import (NEG_INF, FeatureMeta, best_split,
                          expand_group_hist, reconstruct_feature_column)
 from .grower import (GrowerParams, _node_feature_mask, mono_handoff,
                      routed_left)
-from .grower_seg import (COMPACT_WASTE, _SegState, compact_state,
-                         fresh_state, seg_stats_enabled)
+from .grower_seg import (COMPACT_WASTE, _SegState, _unpermute,
+                         compact_state, fresh_state, seg_stats_enabled)
 
 
 def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
@@ -361,7 +361,7 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             return (st.num_leaves < L) & (jnp.max(st.best_f32[:, 0]) > 0.0)
 
         st = lax.while_loop(cond, round_body, st)
-        leaf_id_orig = jnp.zeros(n, jnp.int32).at[st.order].set(st.leaf_id)
+        leaf_id_orig = _unpermute(st.order, st.leaf_id)
         # counters as a third jit output with stable arity (axon rejects
         # in-jit host callbacks); printing is env-gated at call sites
         stats = jnp.stack([st.scanned_total, st.num_sorts, st.grid_total,

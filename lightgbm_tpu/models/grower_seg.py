@@ -153,6 +153,19 @@ def _pack_w8_words(w8):
     return (u[0:6:2] | (u[1:6:2] << 16)).astype(jnp.int32)
 
 
+def _unpermute(order, leaf_id):
+    """leaf_id (permuted space) -> original row order.
+
+    ``order[pos] -> original row`` is a permutation, so sorting
+    (order, leaf_id) by order is an exact inverse permute.  The obvious
+    ``zeros.at[order].set(leaf_id)`` is a full-N random SCATTER — the op
+    class the round-3 sort-vs-gather micro measured ~10x slower than
+    multi-operand sorts on this backend — and it runs once per tree, so
+    the sort formulation keeps the unpermute off the per-iteration
+    critical path."""
+    return lax.sort((order, leaf_id), num_keys=1)[1]
+
+
 def compact_state(st: _SegState, L: int, rb: int) -> _SegState:
     """Stable-sort the whole layout by leaf_id; leaves become contiguous
     segments and confinement intervals reset to them.  Shared by the
@@ -524,8 +537,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         st = scan_leaf(st, 0, root_hist, G0, H0, C0, jnp.int32(0), fmeta,
                        feature_mask, key, 2 * L)
         st = lax.fori_loop(0, L - 1, body, st)
-        # leaf ids back in original row order
-        leaf_id_orig = jnp.zeros(n, jnp.int32).at[st.order].set(st.leaf_id)
+        leaf_id_orig = _unpermute(st.order, st.leaf_id)
         # scan/compaction counters always leave the jit as a third output
         # (stable arity; the axon PJRT backend rejects host callbacks, so
         # no jax.debug.print in compiled code) — printing them is gated

@@ -76,51 +76,67 @@ def chip_up(timeout_s: int = 420) -> bool:
         return False
 
 
+def wait_for_chip(max_wait_s: int = 10800) -> bool:
+    """Poll until the backend answers (it flaps: up 03:16-04:04, down
+    04:04+ on 2026-07-31).  Returns False after ``max_wait_s``."""
+    t0 = time.time()
+    while time.time() - t0 < max_wait_s:
+        if chip_up():
+            return True
+        log(f"probe: backend still down after {time.time() - t0:.0f}s; "
+            "retrying in 300s")
+        time.sleep(300)
+    return False
+
+
 def main():
-    if not chip_up():
+    if "--wait" in sys.argv:
+        if not wait_for_chip():
+            log("probe: backend never came up; giving up")
+            sys.exit(3)
+        log("probe: backend UP — running plan 4b")
+    elif not chip_up():
         if "--if-up" in sys.argv:
             print("backend down; skipping (--if-up)")
             sys.exit(3)
         log("probe: backend DOWN; proceeding anyway (no --if-up)")
     else:
-        log("probe: backend UP — running the measurement plan")
+        log("probe: backend UP — running plan 4b")
 
     probe = os.path.join(REPO, "tools", "perf_probe.py")
     probe_cli = os.path.join(REPO, "tools", "probe.py")
 
-    # 1. strict grower, scan-waste counters
-    run_step("seg-stats strict 10.5M",
-             [PY, probe, "10500000,255,1,4"], 2700,
-             {"LIGHTGBM_TPU_SEG_STATS": "1"})
+    # Plan 4b: chase the ~0.8 s/iter residual both growers share.
+    # 1. microbenches incl. the new op-class probes (unpermute scatter vs
+    # sort2, score-table gather, per-skipped-grid-step cost)
+    run_step("micro 10.5M (4b)", [PY, probe_cli, "micro", "10500000"],
+             2400)
 
-    # 2. frontier A/B
-    run_step("seg-stats frontier 10.5M",
-             [PY, probe, "10500000,255,1,4"], 2700,
-             {"LIGHTGBM_TPU_SEG_STATS": "1",
-              "LIGHTGBM_TPU_IMPL": "frontier"})
+    # 2. profiler trace of 2 strict iterations — the op-level breakdown
+    # that settles where the residual actually goes
+    run_step("trace strict 10.5M", [PY, probe_cli, "trace", "10500000"],
+             2700)
 
-    # 3. COMPACT_WASTE sweep (short runs)
-    for waste in ("1.0", "3.0"):
-        run_step(f"COMPACT_WASTE={waste} strict 10.5M",
-                 [PY, probe, "10500000,255,1,2"], 2100,
-                 {"LIGHTGBM_TPU_SEG_STATS": "1",
-                  "LIGHTGBM_TPU_COMPACT_WASTE": waste})
+    # 3. fewer sorts now that the sort measures ~190ms in context
+    run_step("strict WASTE=6 10.5M", [PY, probe, "10500000,255,1,2"],
+             2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_COMPACT_WASTE": "6.0"})
 
-    # 4. kernel microbenches
-    run_step("micro 10.5M", [PY, probe_cli, "micro", "10500000"], 1800)
+    # 4. frontier with the sort-unpermute fix + grid counters
+    run_step("frontier stats 10.5M", [PY, probe, "10500000,255,1,4"],
+             2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_IMPL": "frontier"})
 
-    # 5. the scoreboard bench (probes + tiers + internal impl A/B)
-    run_step("bench run 1 (cold cache)",
-             [PY, os.path.join(REPO, "bench.py")], 9000)
+    # 5. frontier, fewer compactions (it scans less per split)
+    run_step("frontier WASTE=6 10.5M", [PY, probe, "10500000,255,1,2"],
+             2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_IMPL": "frontier",
+                    "LIGHTGBM_TPU_COMPACT_WASTE": "6.0"})
 
-    # 6. second bench run: the round-3 open question — does the
-    # persistent compilation cache cut warmup below 60 s?
-    run_step("bench run 2 (warm cache)",
-             [PY, os.path.join(REPO, "bench.py")], 9000)
+    # 6. scoreboard with the unpermute fix (internally A/Bs impls)
+    run_step("bench (4b)", [PY, os.path.join(REPO, "bench.py")], 9000)
 
-    log("plan complete — BENCH JSON lines are in the bench steps' "
-        "stdout tails; compare warmup between the two runs for the "
-        "compile-cache question")
+    log("plan 4b complete")
 
 
 if __name__ == "__main__":

@@ -151,6 +151,59 @@ def cmd_micro(argv):
         return fn
     timed(mk_sort, "compact_sort", args, scale=4.0)
 
+    # once-per-tree unpermute: random scatter vs 2-operand sort (the
+    # growers use the sort form; this pair quantifies the difference)
+    perm = jnp.asarray(rng.permutation(npad).astype(np.int32))
+
+    def mk_unperm_scatter(reps):
+        def fn(bT, w, lid):
+            def body(i, lid_c):
+                return jnp.zeros(npad, jnp.int32).at[perm].set(lid_c + i)
+            return lax.fori_loop(0, reps, body, lid)
+        return fn
+    timed(mk_unperm_scatter, "unpermute_scatter", args, scale=1.0)
+
+    def mk_unperm_sort2(reps):
+        def fn(bT, w, lid):
+            def body(i, lid_c):
+                return lax.sort((perm, lid_c + i), num_keys=1)[1]
+            return lax.fori_loop(0, reps, body, lid)
+        return fn
+    timed(mk_unperm_sort2, "unpermute_sort2", args, scale=1.0)
+
+    # score update's [L]-table gather by a full-N index vector
+    lv = jnp.asarray(rng.normal(size=256).astype(np.float32))
+
+    def mk_table_gather(reps):
+        def fn(bT, w, lid):
+            def body(i, acc):
+                return acc + lv[jnp.minimum(lid + i, 255)]
+            return lax.fori_loop(0, reps, body,
+                                 jnp.zeros(npad, jnp.float32))
+        return fn
+    timed(mk_table_gather, "score_table_gather", args, scale=1.0)
+
+    # per-skipped-grid-step cost: a 1-block interval dispatched on the
+    # full-size grid pays (blocks-1) skipped steps; against the 1-block
+    # grid the delta isolates the per-step overhead the bucket ladder
+    # trades against compile variants
+    from lightgbm_tpu.ops.pallas_histogram import _histogram_segment_fixed
+
+    def mk_skip(grid):
+        def mk(reps):
+            def fn(bT, w, lid):
+                def body(i, acc):
+                    h = _histogram_segment_fixed(
+                        bT, w, lid, jnp.int32(0), jnp.int32(1), i % 2, B,
+                        rb, grid)
+                    return acc + h
+                return lax.fori_loop(0, reps, body,
+                                     jnp.zeros((F4, B, 8), jnp.float32))
+            return fn
+        return mk
+    timed(mk_skip(nblk), f"hist_1blk_on_{nblk}grid", args, scale=1.0)
+    timed(mk_skip(1), "hist_1blk_on_1grid", args, scale=1.0)
+
     def mk_route(reps):
         def fn(bT, w, lid):
             def body(i, lid_c):
