@@ -308,6 +308,9 @@ def segment_grid_size(bucket_arr: jax.Array, n_blocks) -> jax.Array:
     ``jnp.asarray(_segment_buckets(max_blocks))``).  Lives here so the
     growers' seg-stats grid accounting can never drift from the actual
     dispatch."""
+    if dyn_grid_enabled():
+        # dynamic grids are sized exactly to the interval (min 1 step)
+        return jnp.maximum(jnp.asarray(n_blocks, jnp.int32), 1)
     idx = jnp.minimum(jnp.sum(bucket_arr < n_blocks),
                       bucket_arr.shape[0] - 1)
     return bucket_arr[idx]
@@ -361,6 +364,66 @@ def _histogram_segment_fixed(binsT: jax.Array, w8: jax.Array,
     return out.reshape(F_log, num_bins, NUM_CHANNELS)
 
 
+def dyn_grid_enabled() -> bool:
+    """LIGHTGBM_TPU_DYN_GRID=1 dispatches segment/frontier histograms on
+    a DYNAMIC pallas grid sized exactly to the interval: one Mosaic
+    compile instead of a bucket-ladder of variants (less remote-compile
+    warmup) and zero skipped grid steps.  Gated until the axon backend's
+    Mosaic lowering of dynamic grids is validated on-chip (interpret-mode
+    green is not lowering-green — ONCHIP_LOG.md)."""
+    import os
+    return os.environ.get("LIGHTGBM_TPU_DYN_GRID", "") == "1"
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block_rows", "interpret",
+                                    "packed4"))
+def _histogram_segment_dyn(binsT: jax.Array, w8: jax.Array,
+                           leaf_id: jax.Array, start_block: jax.Array,
+                           n_blocks: jax.Array, target_leaf: jax.Array,
+                           num_bins: int, block_rows: int,
+                           interpret: bool | None = None,
+                           packed4: bool = False) -> jax.Array:
+    """Dynamic-grid variant: the grid is the traced interval length, so
+    every step is in-range (no remapping, no skipped steps)."""
+    F, n = binsT.shape
+    F_log = 2 * F if packed4 else F
+    if interpret is None:
+        interpret = _interpret_default()
+    max_blocks = n // block_rows
+    # grid 0 would leave the output unwritten; a 1-step grid with
+    # n_blocks == 0 masks all compute and writes zeros (sref[1] == 0)
+    grid_n = jnp.clip(n_blocks, 1, max_blocks).astype(jnp.int32)
+    scalars = jnp.stack([start_block, n_blocks, target_leaf]).astype(
+        jnp.int32)
+
+    def im_data(i, s):
+        return (0, jnp.minimum(s[0] + i, max_blocks - 1))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(grid_n,),
+        in_specs=[
+            pl.BlockSpec((F, block_rows), im_data),
+            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((1, block_rows), im_data),
+        ],
+        out_specs=pl.BlockSpec((F_log * num_bins, NUM_CHANNELS),
+                               lambda i, s: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, NUM_CHANNELS),
+                                   jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel_segment, num_bins=num_bins,
+                          packed4=packed4),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, NUM_CHANNELS),
+                                       jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(scalars, binsT, w8, leaf_id.reshape(1, -1))
+    return out.reshape(F_log, num_bins, NUM_CHANNELS)
+
+
 def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
                       start_block: jax.Array, n_blocks: jax.Array,
                       target_leaf: jax.Array, num_bins: int,
@@ -381,6 +444,12 @@ def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
         block_rows = pick_block_rows(2 * F if packed4 else F, num_bins)
     assert n % block_rows == 0, (n, block_rows)
     max_blocks = n // block_rows
+    if dyn_grid_enabled():
+        return _histogram_segment_dyn(binsT, w8, leaf_id,
+                                      jnp.asarray(start_block, jnp.int32),
+                                      jnp.asarray(n_blocks, jnp.int32),
+                                      target_leaf, num_bins, block_rows,
+                                      interpret, packed4)
     buckets = _segment_buckets(max_blocks)
     if len(buckets) == 1:
         return _histogram_segment_fixed(binsT, w8, leaf_id, start_block,
@@ -526,6 +595,56 @@ def _histogram_frontier_fixed(binsT: jax.Array, w8: jax.Array,
         2, 0, 1, 3)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block_rows", "K",
+                                    "interpret", "packed4"))
+def _histogram_frontier_dyn(binsT: jax.Array, w8: jax.Array,
+                            leaf_id: jax.Array, block_list: jax.Array,
+                            n_blocks: jax.Array, targets: jax.Array,
+                            num_bins: int, block_rows: int, K: int,
+                            interpret: bool | None = None,
+                            packed4: bool = False) -> jax.Array:
+    """Dynamic-grid frontier variant: grid == union size, one compile."""
+    F, n = binsT.shape
+    F_log = 2 * F if packed4 else F
+    if interpret is None:
+        interpret = _interpret_default()
+    max_blocks = n // block_rows
+    grid_n = jnp.clip(n_blocks, 1, max_blocks).astype(jnp.int32)
+    bl = block_list.astype(jnp.int32)[:max_blocks]
+    scalars = jnp.concatenate([
+        jnp.stack([n_blocks.astype(jnp.int32), jnp.int32(0)]),
+        targets.astype(jnp.int32), bl])
+
+    def im_data(i, s):
+        idx = jnp.minimum(i, jnp.maximum(s[0] - 1, 0))
+        return (0, jnp.minimum(s[2 + K + idx], max_blocks - 1))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(grid_n,),
+        in_specs=[
+            pl.BlockSpec((F, block_rows), im_data),
+            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((1, block_rows), im_data),
+        ],
+        out_specs=pl.BlockSpec((F_log * num_bins, K * NUM_CHANNELS),
+                               lambda i, s: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, K * NUM_CHANNELS),
+                                   jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel_frontier, num_bins=num_bins, K=K,
+                          packed4=packed4),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, K * NUM_CHANNELS),
+                                       jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(scalars, binsT, w8, leaf_id.reshape(1, -1))
+    return out.reshape(F_log, num_bins, K, NUM_CHANNELS).transpose(
+        2, 0, 1, 3)
+
+
 def histogram_frontier(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
                        block_list: jax.Array, n_blocks: jax.Array,
                        targets: jax.Array, num_bins: int,
@@ -546,6 +665,11 @@ def histogram_frontier(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
         block_rows = pick_block_rows(2 * F if packed4 else F, num_bins)
     assert n % block_rows == 0, (n, block_rows)
     max_blocks = n // block_rows
+    if dyn_grid_enabled():
+        return _histogram_frontier_dyn(binsT, w8, leaf_id, block_list,
+                                       jnp.asarray(n_blocks, jnp.int32),
+                                       targets, num_bins, block_rows, K,
+                                       interpret, packed4)
     cap = min(int(block_list.shape[0]), max_blocks)
     buckets = _segment_buckets(cap)
     n_blocks = jnp.asarray(n_blocks, jnp.int32)

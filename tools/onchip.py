@@ -133,7 +133,39 @@ def main():
                     "LIGHTGBM_TPU_IMPL": "frontier",
                     "LIGHTGBM_TPU_COMPACT_WASTE": "6.0"})
 
-    # 6. scoreboard with the unpermute fix (internally A/Bs impls)
+    # 6. dynamic-grid lowering check (interpret-green is not
+    # lowering-green): one tiny segment+frontier call on the real chip
+    dyn_check = (
+        "import numpy as np, jax.numpy as jnp\n"
+        "from lightgbm_tpu.ops.pallas_histogram import (histogram_segment,"
+        " histogram_frontier, pack_channels)\n"
+        "rng = np.random.RandomState(0); F, B, rb = 8, 16, 512\n"
+        "n = rb * 4\n"
+        "bT = jnp.asarray(rng.randint(0, B, (F, n)).astype(np.uint8))\n"
+        "w8 = pack_channels(jnp.ones(n), jnp.ones(n), jnp.ones(n))\n"
+        "lid = jnp.zeros(n, jnp.int32)\n"
+        "o = histogram_segment(bT, w8, lid, jnp.int32(0), jnp.int32(2),"
+        " jnp.int32(0), B, rb)\n"
+        "print('seg dyn sum', float(o.sum()))\n"
+        "bl = jnp.arange(4, dtype=jnp.int32)\n"
+        "tg = jnp.zeros(4, jnp.int32)\n"
+        "of = histogram_frontier(bT, w8, lid, bl, jnp.int32(4), tg, B, rb)\n"
+        "print('frontier dyn sum', float(of.sum()))\n")
+    dyn_ok = run_step("dyn-grid lowering check", [PY, "-c", dyn_check],
+                      900, {"LIGHTGBM_TPU_DYN_GRID": "1"})
+
+    if dyn_ok:
+        # 7. dyn-grid A/B: no bucket ladder, exact grids
+        run_step("strict DYN_GRID 10.5M", [PY, probe, "10500000,255,1,2"],
+                 2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                        "LIGHTGBM_TPU_DYN_GRID": "1"})
+        run_step("frontier DYN_GRID 10.5M",
+                 [PY, probe, "10500000,255,1,2"], 2100,
+                 {"LIGHTGBM_TPU_SEG_STATS": "1",
+                  "LIGHTGBM_TPU_IMPL": "frontier",
+                  "LIGHTGBM_TPU_DYN_GRID": "1"})
+
+    # 8. scoreboard with the unpermute fix (internally A/Bs impls)
     run_step("bench (4b)", [PY, os.path.join(REPO, "bench.py")], 9000)
 
     log("plan 4b complete")
