@@ -119,6 +119,12 @@ def _build_reference() -> str:
         "add_subdirectory(easy_profiler)",
         "include_directories(stub_deps)")
     text = text.replace("TARGET_LINK_LIBRARIES(lightgbm PHub)", "")
+    # the profiler submodule is absent from the source drop; the header
+    # stub above replaces its macros, so the link lines must go too
+    text = text.replace("target_link_libraries(_lightgbm easy_profiler)",
+                        "")
+    text = text.replace("target_link_libraries(lightgbm easy_profiler)",
+                        "")
     with open(cml, "w") as fh:
         fh.write(text)
     os.makedirs(bld)
