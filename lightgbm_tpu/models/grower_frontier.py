@@ -43,8 +43,16 @@ from ..ops.split import (NEG_INF, FeatureMeta, best_split,
                          expand_group_hist, reconstruct_feature_column)
 from .grower import (GrowerParams, _node_feature_mask, mono_handoff,
                      routed_left)
-from .grower_seg import (COMPACT_WASTE, _SegState, _unpermute,
-                         compact_state, fresh_state, seg_stats_enabled)
+from .grower_seg import (COMPACT_WASTE, _COMPACT_MUT, _SegState,
+                         _unpermute, compact_state, cond_narrow,
+                         fresh_state, seg_stats_enabled)
+
+# fields apply_split may mutate — its per-split lax.cond carries only
+# these (see grower_seg's cond-narrowing note; binsT/w8/leaf_hist/order
+# stay closure-captured read-only inputs)
+_APPLY_MUT = ("leaf_id", "leaf_lo", "leaf_hi", "leaf_mono_lo",
+              "leaf_mono_hi", "feat_used", "num_leaves", "leaf_g",
+              "leaf_h", "leaf_c", "tree")
 
 
 def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
@@ -269,11 +277,11 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
 
             # 1) apply the K splits sequentially (cheap VPU/scalar work)
             def apply_one(j, s):
-                return lax.cond(
+                return cond_narrow(
                     valid[j],
                     lambda ss: apply_split(ss, leaves_top[j],
                                            new_leaves[j], nodes[j]),
-                    lambda ss: ss, s)
+                    s, _APPLY_MUT)
             parent_hist = st.leaf_hist[leaves_top]          # [K, G, B, 3]
             st = lax.fori_loop(0, K, apply_one, st)
 
@@ -331,8 +339,8 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             st = _write_scans(st, leaves2, infos, gains)
 
             # 5) adaptive compaction, same rule as the strict grower
-            st = lax.cond(st.scanned_since >= limit_blocks,
-                          compact, lambda s: s, st)
+            st = cond_narrow(st.scanned_since >= limit_blocks,
+                             compact, st, _COMPACT_MUT)
             return st
 
         limit_blocks = min(max(1, int(COMPACT_WASTE * max_blocks)),

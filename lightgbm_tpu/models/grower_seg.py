@@ -153,6 +153,50 @@ def _pack_w8_words(w8):
     return (u[0:6:2] | (u[1:6:2] << 16)).astype(jnp.int32)
 
 
+# lax.cond narrowing: a cond whose branches pass large arrays through
+# unchanged still names them as branch OUTPUTS, and the merge can
+# materialize copies of them every iteration (binsT is ~336 MB and w8
+# ~168 MB at 10.5M rows; 254 split conds + 254 compact conds per tree).
+# Each cond therefore carries ONLY the fields its true branch mutates;
+# everything else reaches the branch as a closure capture (a read-only
+# implicit input, never an output).
+_SPLIT_MUT = tuple(f for f in _SegState._fields
+                   if f not in ("binsT", "w8", "order"))
+_COMPACT_MUT = ("binsT", "w8", "order", "leaf_id", "leaf_lo", "leaf_hi",
+                "scanned_since", "num_sorts")
+
+
+def _take(st: _SegState, fields) -> tuple:
+    return tuple(getattr(st, f) for f in fields)
+
+
+def _put(st: _SegState, fields, vals) -> _SegState:
+    return st._replace(**dict(zip(fields, vals)))
+
+
+def cond_narrow(pred, fn, st: _SegState, fields) -> _SegState:
+    """st -> lax.cond(pred, fn, identity, st) with the cond's carried
+    operands narrowed to ``fields``."""
+    rest = tuple(f for f in _SegState._fields if f not in fields)
+
+    def true_branch(m):
+        full_in = _put(st, fields, m)
+        full_out = fn(full_in)
+        # trace-time drift guard: a mutation to a non-carried field would
+        # be silently DISCARDED by the narrowing — an untouched field is
+        # the identical tracer object, so this fails loudly instead
+        for f in rest:
+            leaves_in = jax.tree_util.tree_leaves(getattr(full_in, f))
+            leaves_out = jax.tree_util.tree_leaves(getattr(full_out, f))
+            assert all(a is b for a, b in zip(leaves_in, leaves_out)), (
+                f"cond_narrow: branch mutated non-carried field {f!r}; "
+                f"add it to the mut list")
+        return _take(full_out, fields)
+
+    out = lax.cond(pred, true_branch, lambda m: m, _take(st, fields))
+    return _put(st, fields, out)
+
+
 def _unpermute(order, leaf_id):
     """leaf_id (permuted space) -> original row order.
 
@@ -517,10 +561,10 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
 
         def body(step, st: _SegState):
             can_split = jnp.max(st.best_f32[:, 0]) > 0.0
-            st = lax.cond(can_split, lambda s: do_split(s, step),
-                          lambda s: s, st)
-            st = lax.cond(st.scanned_since >= limit_blocks,
-                          compact, lambda s: s, st)
+            st = cond_narrow(can_split, lambda s: do_split(s, step),
+                             st, _SPLIT_MUT)
+            st = cond_narrow(st.scanned_since >= limit_blocks,
+                             compact, st, _COMPACT_MUT)
             return st
 
         st = fresh_state(binsT, w8, n, L, G_cols, B, F, max_blocks,
