@@ -158,17 +158,29 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
     fblk = max(1, _fblk(B) // (2 if packed4 else 1))
     chunk = _pick_chunk(rb)
 
+    # LIGHTGBM_TPU_ONEHOT_DTYPE=u8 compares bins against the iota in
+    # uint8 instead of int32 — v5e VPU lanes pack 4 u8 values, so the
+    # compare (the kernel's measured bound: ~18 ms of the ~27 ms full-N
+    # pass) may vectorize denser.  Experiment knob until measured.
+    import os as _os
+    cmp_dtype = (jnp.uint8 if _os.environ.get(
+        "LIGHTGBM_TPU_ONEHOT_DTYPE") == "u8" else jnp.int32)
+
     def one_chunk(c, carry):
         wc = wfn(c, chunk)                                  # [8, chunk]
         for p0 in range(0, Fp, fblk):
             np_ = min(fblk, Fp - p0)
             b = binsT_ref[p0:p0 + np_, pl.ds(c * chunk, chunk)].astype(
-                jnp.int32)
+                cmp_dtype)
             if packed4:
-                b = jnp.stack([b & 15, b >> 4], axis=1).reshape(
-                    2 * np_, chunk)
+                if cmp_dtype == jnp.uint8:
+                    b = jnp.stack([b & jnp.uint8(15), b >> 4],
+                                  axis=1).reshape(2 * np_, chunk)
+                else:
+                    b = jnp.stack([b & 15, b >> 4], axis=1).reshape(
+                        2 * np_, chunk)
             nf = b.shape[0]
-            iota = lax.broadcasted_iota(jnp.int32, (nf, B, chunk), 1)
+            iota = lax.broadcasted_iota(cmp_dtype, (nf, B, chunk), 1)
             onehot = (b[:, None, :] == iota).astype(
                 jnp.bfloat16).reshape(nf * B, chunk)
             f0 = (2 * p0 if packed4 else p0)

@@ -165,7 +165,13 @@ def main():
                   "LIGHTGBM_TPU_IMPL": "frontier",
                   "LIGHTGBM_TPU_DYN_GRID": "1"})
 
-    # 8. scoreboard with the unpermute fix (internally A/Bs impls)
+    # 8. u8 one-hot compare experiment (the kernel's measured bound is
+    # the one-hot build; u8 lanes may vectorize 4x denser)
+    run_step("strict ONEHOT=u8 10.5M", [PY, probe, "10500000,255,1,2"],
+             2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_ONEHOT_DTYPE": "u8"})
+
+    # 9. scoreboard with the unpermute fix (internally A/Bs impls)
     run_step("bench (4b)", [PY, os.path.join(REPO, "bench.py")], 9000)
 
     log("plan 4b complete")
