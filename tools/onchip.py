@@ -171,6 +171,17 @@ def main():
              2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
                     "LIGHTGBM_TPU_ONEHOT_DTYPE": "u8"})
 
+    # 8b. wide-K frontier with compaction effectively off: ~10 full-N
+    # rounds/tree and ZERO sorts (the sort term is ~0.7 s/iter at the
+    # current default).  K=64 may blow VMEM — K=32 is the fallback probe.
+    for k in ("64", "32"):
+        run_step(f"frontier K={k} no-compact 10.5M",
+                 [PY, probe, "10500000,255,1,2"], 2100,
+                 {"LIGHTGBM_TPU_SEG_STATS": "1",
+                  "LIGHTGBM_TPU_IMPL": "frontier",
+                  "LIGHTGBM_TPU_FRONTIER_K": k,
+                  "LIGHTGBM_TPU_COMPACT_WASTE": "50.0"})
+
     # 9. scoreboard with the unpermute fix (internally A/Bs impls)
     run_step("bench (4b)", [PY, os.path.join(REPO, "bench.py")], 9000)
 

@@ -34,9 +34,13 @@ def run(n_rows: int, num_leaves: int, warmup: int, measure: int) -> None:
     # block-granularity A/Bs (finer blocks = tighter confinement
     # intervals but more grid steps)
     row_chunk = int(os.environ.get("LIGHTGBM_TPU_ROW_CHUNK", "0"))
+    # LIGHTGBM_TPU_FRONTIER_K overrides the frontier batch width (wide-K
+    # + huge COMPACT_WASTE approximates sort-free level-ish growth)
+    frontier_k = int(os.environ.get("LIGHTGBM_TPU_FRONTIER_K", "0"))
     cfg = Config(objective="binary", num_leaves=num_leaves, max_bin=63,
                  learning_rate=0.1, min_sum_hessian_in_leaf=100.0,
-                 verbosity=-1, tpu_tree_impl=impl, tpu_row_chunk=row_chunk)
+                 verbosity=-1, tpu_tree_impl=impl, tpu_row_chunk=row_chunk,
+                 tpu_frontier_width=frontier_k)
     ds = TpuDataset.from_numpy(X, y, config=cfg)
     obj = create_objective(cfg)
     obj.init(ds.metadata, ds.num_data)
