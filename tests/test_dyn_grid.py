@@ -1,0 +1,62 @@
+"""Dynamic-grid histogram variants (LIGHTGBM_TPU_DYN_GRID=1).
+
+The gated dispatch sizes the pallas grid to the traced interval length
+instead of lax.switching over the static bucket ladder
+(ops/pallas_histogram.{_histogram_segment_dyn,_histogram_frontier_dyn}).
+These tests pin exact parity with the ladder path on the same inputs —
+the variants must be drop-in interchangeable because the on-chip driver
+A/Bs them via env alone.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.pallas_histogram import (histogram_frontier,
+                                               histogram_segment,
+                                               pack_channels, unpack_hist)
+
+
+@pytest.fixture()
+def data():
+    rng = np.random.RandomState(11)
+    F, B, rb = 6, 32, 256
+    n = rb * 5
+    binsT = jnp.asarray(rng.randint(0, B, size=(F, n)).astype(np.uint8))
+    g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.5, 1.0, n).astype(np.float32))
+    m = jnp.asarray((rng.rand(n) > 0.25).astype(np.float32))
+    lid = jnp.asarray(rng.randint(0, 4, size=n).astype(np.int32))
+    return F, B, rb, n, binsT, pack_channels(g, h, m), lid
+
+
+def _seg(monkeypatch, dyn, *args, **kw):
+    monkeypatch.setenv("LIGHTGBM_TPU_DYN_GRID", "1" if dyn else "")
+    return np.asarray(unpack_hist(histogram_segment(*args, **kw)))
+
+
+def test_segment_dyn_matches_ladder(monkeypatch, data):
+    F, B, rb, n, binsT, w8, lid = data
+    for lo, nb, leaf in [(0, 5, 2), (1, 3, 0), (4, 1, 3), (0, 0, 1)]:
+        a = _seg(monkeypatch, False, binsT, w8, lid, jnp.int32(lo),
+                 jnp.int32(nb), jnp.int32(leaf), B, rb)
+        b = _seg(monkeypatch, True, binsT, w8, lid, jnp.int32(lo),
+                 jnp.int32(nb), jnp.int32(leaf), B, rb)
+        np.testing.assert_allclose(a, b, rtol=0, atol=0,
+                                   err_msg=f"lo={lo} nb={nb} leaf={leaf}")
+
+
+def test_frontier_dyn_matches_ladder(monkeypatch, data):
+    F, B, rb, n, binsT, w8, lid = data
+    bl = jnp.asarray(np.r_[0, 2, 3, np.zeros(2)].astype(np.int32))
+    tg = jnp.asarray([3, 1, -1, 0], jnp.int32)
+
+    monkeypatch.setenv("LIGHTGBM_TPU_DYN_GRID", "")
+    a = np.asarray(histogram_frontier(binsT, w8, lid, bl, jnp.int32(3),
+                                      tg, B, rb))
+    monkeypatch.setenv("LIGHTGBM_TPU_DYN_GRID", "1")
+    b = np.asarray(histogram_frontier(binsT, w8, lid, bl, jnp.int32(3),
+                                      tg, B, rb))
+    np.testing.assert_array_equal(a, b)
+    # -1 targets stay zero in both
+    assert np.asarray(b)[2].sum() == 0
