@@ -50,6 +50,8 @@ def run(n_rows: int, num_leaves: int, warmup: int, measure: int) -> None:
         booster.train_one_iter()
     jax.block_until_ready(booster.train_score)
     t_warm = time.time() - t0
+    from lightgbm_tpu.utils.phase import GLOBAL_TIMER
+    GLOBAL_TIMER.reset()
     t0 = time.time()
     for _ in range(measure):
         booster.train_one_iter()
@@ -58,6 +60,7 @@ def run(n_rows: int, num_leaves: int, warmup: int, measure: int) -> None:
     print(f"PROBE rows={n_rows} leaves={num_leaves} impl="
           f"{'segment' if booster._use_segment else 'fused'} "
           f"warmup={t_warm:.1f}s per_iter={per_iter:.4f}s", flush=True)
+    print("PROBE " + GLOBAL_TIMER.summary(), flush=True)
 
 
 if __name__ == "__main__":
