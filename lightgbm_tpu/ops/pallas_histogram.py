@@ -376,15 +376,24 @@ def _histogram_segment_fixed(binsT: jax.Array, w8: jax.Array,
     return out.reshape(F_log, num_bins, NUM_CHANNELS)
 
 
+# Flip to True once the plan-4b on-chip lowering check validates Mosaic
+# dynamic grids on the axon backend (interpret-mode green is not
+# lowering-green — ONCHIP_LOG.md); env still overrides either way.
+_DYN_GRID_DEFAULT = False
+
+
 def dyn_grid_enabled() -> bool:
     """LIGHTGBM_TPU_DYN_GRID=1 dispatches segment/frontier histograms on
     a DYNAMIC pallas grid sized exactly to the interval: one Mosaic
     compile instead of a bucket-ladder of variants (less remote-compile
-    warmup) and zero skipped grid steps.  Gated until the axon backend's
-    Mosaic lowering of dynamic grids is validated on-chip (interpret-mode
-    green is not lowering-green — ONCHIP_LOG.md)."""
+    warmup) and zero skipped grid steps.  =0 forces the bucket ladder."""
     import os
-    return os.environ.get("LIGHTGBM_TPU_DYN_GRID", "") == "1"
+    env = os.environ.get("LIGHTGBM_TPU_DYN_GRID", "")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return _DYN_GRID_DEFAULT
 
 
 @functools.partial(jax.jit,
