@@ -45,6 +45,9 @@ BASELINE_500_ITERS_S_10M5 = 238.505  # reference CPU, 10.5M rows
 # outage number for a TPU measurement.
 TIERS = [
     ("tpu", 10_500_000, 2, 4, 2700),
+    # second shot at the primary tier: the axon backend flaps, and one
+    # mid-run UNAVAILABLE should not degrade the scoreboard to 1M rows
+    ("tpu", 10_500_000, 2, 4, 2700),
     ("tpu", 1_000_000, 3, 12, 1800),
     ("cpu", 10_000, 1, 3, 600),
     ("cpu", 2_000, 1, 2, 300),
