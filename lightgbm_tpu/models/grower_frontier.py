@@ -37,15 +37,14 @@ from jax import lax
 
 from ..ops.pallas_histogram import (_segment_buckets, frontier_width,
                                     histogram_frontier, pack_channels,
-                                    segment_grid_size, slice_packed_column,
-                                    unpack_hist)
+                                    segment_grid_size, unpack_hist)
 from ..ops.split import (NEG_INF, FeatureMeta, best_split,
-                         expand_group_hist, reconstruct_feature_column)
-from .grower import (GrowerParams, _node_feature_mask, mono_handoff,
-                     routed_left)
+                         expand_group_hist)
+from .grower import (GrowerParams, _node_feature_mask, mono_handoff)
 from .grower_seg import (COMPACT_WASTE, _COMPACT_MUT, _SegState,
                          _unpermute, compact_state, cond_narrow,
-                         fresh_state, seg_stats_enabled)
+                         fresh_state, route_split_windowed,
+                         seg_stats_enabled)
 
 # fields apply_split may mutate — its per-split lax.cond carries only
 # these (see grower_seg's cond-narrowing note; binsT/w8/leaf_hist/order
@@ -167,24 +166,17 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             cat = bi[3].astype(bool)
             bitset = st.best_cat_bitset[leaf]
 
-            col = f if fmeta.feat_group is None else fmeta.feat_group[f]
-            if p.packed4:
-                fcol = slice_packed_column(st.binsT, col)
-            else:
-                fcol = lax.dynamic_slice_in_dim(st.binsT, col, 1,
-                                                axis=0)[0, :]
-            fcol = reconstruct_feature_column(fcol, f, fmeta)
-            go_left = routed_left(fcol, t, dl, cat, bitset,
-                                  fmeta.missing_type[f],
-                                  fmeta.default_bin[f], fmeta.num_bin[f])
-            in_leaf = st.leaf_id == leaf
-            leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, st.leaf_id)
+            # routing confined to the parent's inherited block interval
+            # (grower_seg.route_split_windowed)
+            lo, hi = st.leaf_lo[leaf], st.leaf_hi[leaf]
+            leaf_id = route_split_windowed(
+                st.binsT, st.leaf_id, fmeta, p.packed4, rb,
+                f, t, dl, cat, bitset, leaf, new_leaf, lo, hi - lo)
 
             Gl, Hl, Cl = bf[1], bf[2], bf[3]
             Gp, Hp, Cp = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
             Gr, Hr, Cr = Gp - Gl, Hp - Hl, Cp - Cl
 
-            lo, hi = st.leaf_lo[leaf], st.leaf_hi[leaf]
             st = st._replace(
                 leaf_id=leaf_id,
                 leaf_lo=st.leaf_lo.at[new_leaf].set(lo),

@@ -313,6 +313,16 @@ def _segment_buckets(max_blocks: int) -> list:
     return sorted(set(buckets))
 
 
+def bucket_index(bucket_list, n_blocks) -> jax.Array:
+    """Index of the smallest ladder bucket covering an ``n_blocks``-long
+    interval — THE smallest-covering rule.  Shared by the kernels'
+    ``lax.switch`` dispatch, ``segment_grid_size`` accounting, and the
+    growers' windowed routing so the three can never drift."""
+    nb = jnp.asarray(n_blocks, jnp.int32).reshape(())
+    return jnp.minimum(jnp.sum(jnp.asarray(bucket_list, jnp.int32) < nb),
+                       len(bucket_list) - 1)
+
+
 def segment_grid_size(bucket_arr: jax.Array, n_blocks) -> jax.Array:
     """Grid steps the bucketed dispatch runs for an ``n_blocks``-long
     interval — the same smallest-covering-bucket rule histogram_segment
@@ -323,9 +333,7 @@ def segment_grid_size(bucket_arr: jax.Array, n_blocks) -> jax.Array:
     if dyn_grid_enabled():
         # dynamic grids are sized exactly to the interval (min 1 step)
         return jnp.maximum(jnp.asarray(n_blocks, jnp.int32), 1)
-    idx = jnp.minimum(jnp.sum(bucket_arr < n_blocks),
-                      bucket_arr.shape[0] - 1)
-    return bucket_arr[idx]
+    return bucket_arr[bucket_index(bucket_arr, n_blocks)]
 
 
 @functools.partial(jax.jit,
@@ -478,10 +486,7 @@ def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
                                         block_rows, buckets[0], interpret,
                                         packed4)
     n_blocks = jnp.asarray(n_blocks, jnp.int32)
-    # smallest bucket >= n_blocks
-    idx = jnp.sum(jnp.asarray(buckets, jnp.int32)[None, :]
-                  < n_blocks[None], axis=1)[0] if n_blocks.ndim else \
-        jnp.sum(jnp.asarray(buckets, jnp.int32) < n_blocks)
+    idx = bucket_index(buckets, n_blocks)
     branches = [
         (lambda gb: lambda b, w, l, s0, nb, tl: _histogram_segment_fixed(
             b, w, l, s0, nb, tl, num_bins, block_rows, gb, interpret,
@@ -733,10 +738,16 @@ def pack_bins_4bit(binsT):
     return (binsT[0::2] | (binsT[1::2] << 4)).astype(np.uint8)
 
 
+def unpack_nibble(byte, col):
+    """Logical column ``col``'s 4-bit bins from its packed byte row — the
+    single place that knows the nibble convention (odd logical column =
+    high nibble; inverse of pack_bins_4bit)."""
+    b = byte.astype(jnp.int32)
+    return jnp.where(col % 2 == 1, b >> 4, b & 15)
+
+
 def slice_packed_column(binsT, col):
     """One logical column [N] i32 out of a 4-bit packed feature-major
-    matrix (inverse of pack_bins_4bit for a single, possibly traced,
-    column index) — the single place that knows the nibble convention."""
-    byte = lax.dynamic_slice_in_dim(binsT, col // 2, 1,
-                                    axis=0)[0, :].astype(jnp.int32)
-    return jnp.where(col % 2 == 1, byte >> 4, byte & 15)
+    matrix (for a single, possibly traced, column index)."""
+    byte = lax.dynamic_slice_in_dim(binsT, col // 2, 1, axis=0)[0, :]
+    return unpack_nibble(byte, col)
