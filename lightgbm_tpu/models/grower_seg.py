@@ -54,13 +54,14 @@ from .grower import (CommHooks, GrowerParams, TreeArrays,
 # tree; the amortized rule bounds scan waste at ~(1 + COMPACT_WASTE/2) x
 # ideal while the number of sorts stays <= total_scanned / (COMPACT_WASTE
 # x N).  Overridable via LIGHTGBM_TPU_COMPACT_WASTE (in N multiples).
-# Default from the round-4 on-chip sweep at 10.5M rows (ONCHIP_LOG.md):
+# Default from the round-4 on-chip sweeps at 10.5M rows (ONCHIP_LOG.md):
 # the full-payload sort measures ~190 ms in context — ~5x the in-jit
 # micro's estimate — so trading scan waste for fewer sorts wins:
-# per-iter 3.13 s (waste=1.0) / 2.30 s (2.0) / 1.91 s (3.0).
+# strict per-iter 3.13 s (waste=1.0) / 2.30 (2.0) / 1.91 (3.0) / 1.45
+# (6.0); frontier 1.28 (3.0) / 1.12 (6.0).
 import os as _os
 
-COMPACT_WASTE = float(_os.environ.get("LIGHTGBM_TPU_COMPACT_WASTE", "3.0"))
+COMPACT_WASTE = float(_os.environ.get("LIGHTGBM_TPU_COMPACT_WASTE", "6.0"))
 
 
 def seg_stats_enabled() -> bool:

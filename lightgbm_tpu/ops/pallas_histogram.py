@@ -384,10 +384,12 @@ def _histogram_segment_fixed(binsT: jax.Array, w8: jax.Array,
     return out.reshape(F_log, num_bins, NUM_CHANNELS)
 
 
-# Flip to True once the plan-4b on-chip lowering check validates Mosaic
-# dynamic grids on the axon backend (interpret-mode green is not
-# lowering-green — ONCHIP_LOG.md); env still overrides either way.
-_DYN_GRID_DEFAULT = False
+# Validated on-chip 2026-07-31 (ONCHIP_LOG.md "dyn-grid lowering check"
+# rc=0; strict 10.5M probe 1.53 s/iter dyn vs 1.81-1.91 ladder): Mosaic
+# accepts traced grid dims on the axon backend, so exact grids are the
+# default — one kernel compile instead of a bucket ladder, zero skipped
+# steps.  LIGHTGBM_TPU_DYN_GRID=0 restores the ladder.
+_DYN_GRID_DEFAULT = True
 
 
 def dyn_grid_enabled() -> bool:
