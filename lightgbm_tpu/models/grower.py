@@ -319,6 +319,13 @@ class CommHooks(NamedTuple):
     # gains)`` with a leading batch axis on every SplitInfo field
     reduce_hist_batch: object = None
     merge_split_batch: object = None
+    # ``uniform_scan(blocks)`` maps a per-shard scanned-block count to a
+    # shard-UNIFORM value (data-parallel: pmax).  The strict segment
+    # grower's epoch-while predicates gate on the scan counter, and a
+    # while_loop whose body runs collectives must have shard-uniform trip
+    # counts — per-shard confinement intervals differ, so the raw count
+    # does not qualify.  None (serial) = identity.
+    uniform_scan: object = None
 
 
 def make_grow_tree(num_bins: int, params: GrowerParams,

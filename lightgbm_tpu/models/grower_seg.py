@@ -157,12 +157,12 @@ def _pack_w8_words(w8):
 # lax.cond narrowing: a cond whose branches pass large arrays through
 # unchanged still names them as branch OUTPUTS, and the merge can
 # materialize copies of them every iteration (binsT is ~336 MB and w8
-# ~168 MB at 10.5M rows; 254 split conds + 254 compact conds per tree).
-# Each cond therefore carries ONLY the fields its true branch mutates;
-# everything else reaches the branch as a closure capture (a read-only
-# implicit input, never an output).
-_SPLIT_MUT = tuple(f for f in _SegState._fields
-                   if f not in ("binsT", "w8", "order"))
+# ~168 MB at 10.5M rows — the round-4 trace measured 0.77 s/iter of such
+# copies when the compact cond sat inside the per-split loop).  Each cond
+# therefore carries ONLY the fields its true branch mutates — everything
+# else reaches the branch as a closure capture — and the strict grower
+# additionally keeps every remaining cond off the per-split path (epoch
+# structure below).
 _COMPACT_MUT = ("binsT", "w8", "order", "leaf_id", "leaf_lo", "leaf_hi",
                 "scanned_since", "num_sorts")
 
@@ -477,7 +477,11 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             G0, H0, C0 = (comm.reduce_stats(G0), comm.reduce_stats(H0),
                           comm.reduce_stats(C0))
 
-        def do_split(st: _SegState, step):
+        def do_split(st: _SegState):
+            # split ordinal (feature_fraction_bynode key folding); the
+            # epoch-while structure has no fori index, but num_leaves-1
+            # counts splits identically
+            step = st.num_leaves - 1
             leaf = jnp.argmax(st.best_f32[:, 0]).astype(jnp.int32)
             new_leaf = st.num_leaves
             node = st.num_leaves - 1
@@ -524,7 +528,12 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             smaller_is_left = Cl <= Cr
             smaller = jnp.where(smaller_is_left, leaf, new_leaf)
             hist_small, blk = hist_leaf(st, smaller, G_cols)
-            st = st._replace(scanned_since=st.scanned_since + blk,
+            # the epoch-while predicates gate on scanned_since, so it must
+            # be shard-uniform under the distributed wrappers (CommHooks
+            # doc); scanned_total stays the shard-local truth for stats
+            blk_u = (comm.uniform_scan(blk)
+                     if comm.uniform_scan is not None else blk)
+            st = st._replace(scanned_since=st.scanned_since + blk_u,
                              scanned_total=st.scanned_total + blk,
                              grid_total=st.grid_total + grid_of(blk))
             hist_parent = st.leaf_hist[leaf]
@@ -595,16 +604,31 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             return st
 
         # adaptive compaction (module docstring): amortize the sort against
-        # the histogram DMA it saves.  Traced as a cond inside ONE
-        # fori_loop body so the body and the compaction each compile once.
+        # the histogram DMA it saves.  Structured as EPOCH loops — an
+        # inner while that splits unconditionally until the scan budget is
+        # spent, and an outer loop that compacts between epochs.  The
+        # round-3 form (one fori_loop whose body wrapped do_split and
+        # compact in per-split lax.conds) made XLA materialize the conds'
+        # carried operands through the identity branches every split:
+        # the compact cond alone copied binsT+w8+order+leaf_id (~590 MB
+        # at 10.5M rows) 254x/tree — 0.77 s/iter of pure copy in the
+        # round-4 profiler trace (ONCHIP_LOG.md).  With the split work in
+        # the loop PREDICATE instead of a cond, nothing is copied; the
+        # compact cond now executes once per epoch (~#compactions/tree).
         limit_blocks = min(max(1, int(COMPACT_WASTE * max_blocks)),
                            2**31 - 1)   # compared against an i32 counter
 
-        def body(step, st: _SegState):
-            can_split = jnp.max(st.best_f32[:, 0]) > 0.0
-            st = cond_narrow(can_split, lambda s: do_split(s, step),
-                             st, _SPLIT_MUT)
-            st = cond_narrow(st.scanned_since >= limit_blocks,
+        def can_grow(st: _SegState):
+            return (st.num_leaves < L) & (jnp.max(st.best_f32[:, 0]) > 0.0)
+
+        def epoch(st: _SegState) -> _SegState:
+            st = lax.while_loop(
+                lambda s: can_grow(s) & (s.scanned_since < limit_blocks),
+                do_split, st)
+            # compact only when another epoch follows (skip the pointless
+            # final sort when growth ended mid-epoch)
+            st = cond_narrow(can_grow(st)
+                             & (st.scanned_since >= limit_blocks),
                              compact, st, _COMPACT_MUT)
             return st
 
@@ -621,7 +645,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                          grid_total=jnp.int32(max_blocks))
         st = scan_leaf(st, 0, root_hist, G0, H0, C0, jnp.int32(0), fmeta,
                        feature_mask, key, 2 * L)
-        st = lax.fori_loop(0, L - 1, body, st)
+        st = lax.while_loop(can_grow, epoch, st)
         leaf_id_orig = _unpermute(st.order, st.leaf_id)
         # scan/compaction counters always leave the jit as a third output
         # (stable arity; the axon PJRT backend rejects host callbacks, so

@@ -369,7 +369,8 @@ def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
         reduce_stats=lambda x: lax.psum(x, axis),
         merge_split=lambda info, gain: _merge_split_by_gain(info, gain,
                                                             axis),
-        shard_feature_mask=shard_mask)
+        shard_feature_mask=shard_mask,
+        uniform_scan=lambda b: lax.pmax(b, axis))
 
     def wrap(grow):
         return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))

@@ -458,7 +458,10 @@ def cmd_trace(argv):
 
 
 def _summarize_xplane(trace_dir):
-    from tensorboard_plugin_profile.protobuf import xplane_pb2
+    # the tensorboard_plugin_profile wheel in this image ships no
+    # python protobufs; tensorflow's tsl copy of xplane_pb2 parses the
+    # same .xplane.pb files
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                       recursive=True)
