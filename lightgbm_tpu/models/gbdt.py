@@ -652,7 +652,15 @@ class GBDT:
                                               fmeta, fmask, sub, **kw)
             if pad:
                 leaf_id = leaf_id[:N]
-            new_row = score[k] + shrinkage * arrays.leaf_value[leaf_id]
+            if self.grower_params.hist_backend == "pallas":
+                # one-hot-matmul scorer: the plain table gather lowers
+                # to ~1.6 GB/s on this backend (ops/pallas_score)
+                from ..ops.pallas_score import score_gather_add
+                new_row = score_gather_add(
+                    score[k], leaf_id, shrinkage * arrays.leaf_value)
+            else:
+                new_row = (score[k]
+                           + shrinkage * arrays.leaf_value[leaf_id])
             score = score.at[k].set(new_row)
             ints_d, floats_d = _pack_tree_device(arrays)
             return score, ints_d, floats_d, tuple(stats)

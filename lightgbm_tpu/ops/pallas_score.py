@@ -1,0 +1,76 @@
+"""Pallas score-update kernel: ``score + table[leaf_id]`` as a one-hot
+MXU contraction.
+
+The boosting score update is a [L]-table gather by a full-N index
+vector; XLA lowers that gather at ~1.6 GB/s on v5e (81 ms/iter at 10.5M
+rows — round-4 ``score_table_gather`` micro), while the one-hot
+formulation streams the row blocks at full block bandwidth like the
+histogram kernels.  It is EXACT in f32: each row's dot product has
+exactly one nonzero term (1.0f * table[leaf]), so no rounding
+accumulates — required for train-score/predict parity.
+
+Covers the score side of the reference's ScoreUpdater
+(src/boosting/score_updater.hpp:84-99), whose AddScore(tree, ...) loops
+rows on the host threadpool.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .pallas_histogram import _interpret_default
+
+BLOCK = 32768
+CHUNK = 512
+
+
+def _kernel(lv_ref, lid_ref, score_ref, out_ref, *, table_pad):
+    def one_chunk(c, carry):
+        sl = pl.ds(c * CHUNK, CHUNK)
+        lid = lid_ref[0, sl]
+        iota = lax.broadcasted_iota(jnp.int32, (table_pad, CHUNK), 0)
+        onehot = (iota == lid[None, :]).astype(jnp.float32)
+        v = lax.dot_general(lv_ref[...], onehot, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        out_ref[0, sl] = score_ref[0, sl] + v[0]
+        return carry
+
+    lax.fori_loop(0, BLOCK // CHUNK, one_chunk, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def score_gather_add(score_row: jax.Array, leaf_id: jax.Array,
+                     table: jax.Array,
+                     interpret: bool | None = None) -> jax.Array:
+    """``score_row + table[leaf_id]`` — [N] f32, [N] i32, [L] f32.
+
+    Scale factors (shrinkage, DART normalization) belong pre-applied to
+    ``table``; indices >= len(table) contribute zero (all-zero one-hot
+    column), and callers never produce them.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    n = score_row.shape[0]
+    L = table.shape[0]
+    table_pad = -(-L // 128) * 128
+    pad = (-n) % BLOCK
+    sp = jnp.pad(score_row.astype(jnp.float32), (0, pad)).reshape(1, -1)
+    lp = jnp.pad(leaf_id, (0, pad)).reshape(1, -1)
+    tv = jnp.pad(table.astype(jnp.float32),
+                 (0, table_pad - L)).reshape(1, -1)
+    out = pl.pallas_call(
+        functools.partial(_kernel, table_pad=table_pad),
+        grid=(sp.shape[1] // BLOCK,),
+        in_specs=[pl.BlockSpec((1, table_pad), lambda i: (0, 0)),
+                  pl.BlockSpec((1, BLOCK), lambda i: (0, i)),
+                  pl.BlockSpec((1, BLOCK), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((1, BLOCK), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct(sp.shape, jnp.float32),
+        interpret=interpret,
+    )(tv, lp, sp)
+    return out[0, :n]

@@ -215,3 +215,16 @@ def test_histogram_all_multi_channel_sets(rng, packed4):
         np.testing.assert_allclose(np.asarray(multi[c]),
                                    np.asarray(single), rtol=1e-6,
                                    atol=1e-6)
+
+
+def test_score_gather_add_matches_gather(rng):
+    """One-hot-matmul scorer == plain table gather, exactly (f32)."""
+    from lightgbm_tpu.ops.pallas_score import score_gather_add
+    for n, L in ((1000, 7), (70000, 255), (32768, 300)):
+        score = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        lid = jnp.asarray(rng.randint(0, L, size=n).astype(np.int32))
+        table = jnp.asarray(rng.normal(size=L).astype(np.float32))
+        got = np.asarray(score_gather_add(score, lid, table,
+                                          interpret=True))
+        want = np.asarray(score) + np.asarray(table)[np.asarray(lid)]
+        np.testing.assert_array_equal(got, want)
