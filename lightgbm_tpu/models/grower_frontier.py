@@ -46,12 +46,6 @@ from .grower_seg import (COMPACT_WASTE, _COMPACT_MUT, _SegState,
                          fresh_state, route_split_windowed,
                          seg_stats_enabled)
 
-# fields apply_split may mutate — its per-split lax.cond carries only
-# these (see grower_seg's cond-narrowing note; binsT/w8/leaf_hist/order
-# stay closure-captured read-only inputs)
-_APPLY_MUT = ("leaf_id", "leaf_lo", "leaf_hi", "leaf_mono_lo",
-              "leaf_mono_hi", "feat_used", "num_leaves", "leaf_g",
-              "leaf_h", "leaf_c", "tree")
 
 
 def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
@@ -267,15 +261,21 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             Cp = st.leaf_c[leaves_top]
             smaller_is_left = Cl <= Cp - Cl
 
-            # 1) apply the K splits sequentially (cheap VPU/scalar work)
+            # 1) apply the valid splits sequentially (cheap VPU/scalar
+            # work).  ``valid`` is a PREFIX of K (top_k sorts gains
+            # descending and the budget/ratio gates preserve order), so a
+            # traced-bound fori over the prefix applies each split
+            # UNCONDITIONALLY — the old per-split lax.cond made XLA copy
+            # its carried leaf_id (~42 MB) through the identity branch
+            # every split (the same copy class the strict grower's epoch
+            # restructure eliminated; round-4 trace).  n_valid is uniform
+            # across shards: it derives from merged gains and the budget.
             def apply_one(j, s):
-                return cond_narrow(
-                    valid[j],
-                    lambda ss: apply_split(ss, leaves_top[j],
-                                           new_leaves[j], nodes[j]),
-                    s, _APPLY_MUT)
+                return apply_split(s, leaves_top[j], new_leaves[j],
+                                   nodes[j])
             parent_hist = st.leaf_hist[leaves_top]          # [K, G, B, 3]
-            st = lax.fori_loop(0, K, apply_one, st)
+            n_valid = jnp.sum(valid).astype(jnp.int32)
+            st = lax.fori_loop(0, n_valid, apply_one, st)
 
             # 2) union block list of the K smaller children's confinement
             # intervals (children inherit the parent interval, so read

@@ -76,7 +76,7 @@ def chip_up(timeout_s: int = 420) -> bool:
         return False
 
 
-def wait_for_chip(max_wait_s: int = 10800) -> bool:
+def wait_for_chip(max_wait_s: int = 28800) -> bool:
     """Poll until the backend answers (it flaps: up 03:16-04:04, down
     04:04+ on 2026-07-31).  Returns False after ``max_wait_s``."""
     t0 = time.time()
@@ -106,86 +106,47 @@ def main():
     probe = os.path.join(REPO, "tools", "perf_probe.py")
     probe_cli = os.path.join(REPO, "tools", "probe.py")
 
-    # Plan 4b: chase the ~0.8 s/iter residual both growers share.
-    # 1. microbenches incl. the new op-class probes (unpermute scatter vs
-    # sort2, score-table gather, per-skipped-grid-step cost)
-    run_step("micro 10.5M (4b)", [PY, probe_cli, "micro", "10500000"],
-             2400)
+    # Plan 4c: measure the post-fix state (windowed route + epoch loops
+    # + dyn-grid/WASTE=6 defaults + one-hot-matmul scorer).  Last clean
+    # numbers: strict 1.39 (bench, partial fixes), frontier 1.12
+    # (WASTE=6, pre-epoch).  Baseline 0.477.
+    # 1-2. both growers at current defaults — the headline A/B
+    run_step("strict post-fix 10.5M", [PY, probe, "10500000,255,1,3"],
+             2100, {"LIGHTGBM_TPU_SEG_STATS": "1"})
+    run_step("frontier post-fix 10.5M", [PY, probe, "10500000,255,1,3"],
+             2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_IMPL": "frontier"})
 
-    # 2. profiler trace of 2 strict iterations — the op-level breakdown
-    # that settles where the residual actually goes
+    # 3. trace of 2 strict iterations (parser fixed: tsl protobuf) —
+    # what is the bound NOW?
     run_step("trace strict 10.5M", [PY, probe_cli, "trace", "10500000"],
              2700)
 
-    # 3. fewer sorts now that the sort measures ~190ms in context
-    run_step("strict WASTE=6 10.5M", [PY, probe, "10500000,255,1,2"],
-             2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
-                    "LIGHTGBM_TPU_COMPACT_WASTE": "6.0"})
+    # 4. finer blocks: granularity floor under scanned N-eq now that
+    # skipped steps are gone (PERF_NOTES "next levers" #2)
+    run_step("frontier ROW_CHUNK=8192 10.5M",
+             [PY, probe, "10500000,255,1,2"], 2100,
+             {"LIGHTGBM_TPU_SEG_STATS": "1",
+              "LIGHTGBM_TPU_IMPL": "frontier",
+              "LIGHTGBM_TPU_ROW_CHUNK": "8192"})
+    run_step("strict ROW_CHUNK=8192 10.5M",
+             [PY, probe, "10500000,255,1,2"], 2100,
+             {"LIGHTGBM_TPU_SEG_STATS": "1",
+              "LIGHTGBM_TPU_ROW_CHUNK": "8192"})
 
-    # 4. frontier with the sort-unpermute fix + grid counters
-    run_step("frontier stats 10.5M", [PY, probe, "10500000,255,1,4"],
-             2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
-                    "LIGHTGBM_TPU_IMPL": "frontier"})
-
-    # 5. frontier, fewer compactions (it scans less per split)
-    run_step("frontier WASTE=6 10.5M", [PY, probe, "10500000,255,1,2"],
+    # 5. push the sort trade further now that scans are all that's left
+    run_step("frontier WASTE=10 10.5M", [PY, probe, "10500000,255,1,2"],
              2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
                     "LIGHTGBM_TPU_IMPL": "frontier",
-                    "LIGHTGBM_TPU_COMPACT_WASTE": "6.0"})
-
-    # 6. dynamic-grid lowering check (interpret-green is not
-    # lowering-green): one tiny segment+frontier call on the real chip
-    dyn_check = (
-        "import numpy as np, jax.numpy as jnp\n"
-        "from lightgbm_tpu.ops.pallas_histogram import (histogram_segment,"
-        " histogram_frontier, pack_channels)\n"
-        "rng = np.random.RandomState(0); F, B, rb = 8, 16, 512\n"
-        "n = rb * 4\n"
-        "bT = jnp.asarray(rng.randint(0, B, (F, n)).astype(np.uint8))\n"
-        "w8 = pack_channels(jnp.ones(n), jnp.ones(n), jnp.ones(n))\n"
-        "lid = jnp.zeros(n, jnp.int32)\n"
-        "o = histogram_segment(bT, w8, lid, jnp.int32(0), jnp.int32(2),"
-        " jnp.int32(0), B, rb)\n"
-        "print('seg dyn sum', float(o.sum()))\n"
-        "bl = jnp.arange(4, dtype=jnp.int32)\n"
-        "tg = jnp.zeros(4, jnp.int32)\n"
-        "of = histogram_frontier(bT, w8, lid, bl, jnp.int32(4), tg, B, rb)\n"
-        "print('frontier dyn sum', float(of.sum()))\n")
-    dyn_ok = run_step("dyn-grid lowering check", [PY, "-c", dyn_check],
-                      900, {"LIGHTGBM_TPU_DYN_GRID": "1"})
-
-    if dyn_ok:
-        # 7. dyn-grid A/B: no bucket ladder, exact grids
-        run_step("strict DYN_GRID 10.5M", [PY, probe, "10500000,255,1,2"],
-                 2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
-                        "LIGHTGBM_TPU_DYN_GRID": "1"})
-        run_step("frontier DYN_GRID 10.5M",
-                 [PY, probe, "10500000,255,1,2"], 2100,
-                 {"LIGHTGBM_TPU_SEG_STATS": "1",
-                  "LIGHTGBM_TPU_IMPL": "frontier",
-                  "LIGHTGBM_TPU_DYN_GRID": "1"})
-
-    # 8. u8 one-hot compare experiment (the kernel's measured bound is
-    # the one-hot build; u8 lanes may vectorize 4x denser)
-    run_step("strict ONEHOT=u8 10.5M", [PY, probe, "10500000,255,1,2"],
+                    "LIGHTGBM_TPU_COMPACT_WASTE": "10.0"})
+    run_step("strict WASTE=10 10.5M", [PY, probe, "10500000,255,1,2"],
              2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
-                    "LIGHTGBM_TPU_ONEHOT_DTYPE": "u8"})
+                    "LIGHTGBM_TPU_COMPACT_WASTE": "10.0"})
 
-    # 8b. wide-K frontier with compaction effectively off: ~10 full-N
-    # rounds/tree and ZERO sorts (the sort term is ~0.7 s/iter at the
-    # current default).  K=64 may blow VMEM — K=32 is the fallback probe.
-    for k in ("64", "32"):
-        run_step(f"frontier K={k} no-compact 10.5M",
-                 [PY, probe, "10500000,255,1,2"], 2100,
-                 {"LIGHTGBM_TPU_SEG_STATS": "1",
-                  "LIGHTGBM_TPU_IMPL": "frontier",
-                  "LIGHTGBM_TPU_FRONTIER_K": k,
-                  "LIGHTGBM_TPU_COMPACT_WASTE": "50.0"})
+    # 6. scoreboard (internally A/Bs impls with the quality guard)
+    run_step("bench (4c)", [PY, os.path.join(REPO, "bench.py")], 9000)
 
-    # 9. scoreboard with the unpermute fix (internally A/Bs impls)
-    run_step("bench (4b)", [PY, os.path.join(REPO, "bench.py")], 9000)
-
-    log("plan 4b complete")
+    log("plan 4c complete")
 
 
 if __name__ == "__main__":
