@@ -158,27 +158,29 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
     fblk = max(1, _fblk(B) // (2 if packed4 else 1))
     chunk = _pick_chunk(rb)
 
-    # LIGHTGBM_TPU_ONEHOT_DTYPE=u8 compares bins against the iota in
-    # uint8 instead of int32 — v5e VPU lanes pack 4 u8 values, so the
-    # compare (the kernel's measured bound: ~18 ms of the ~27 ms full-N
-    # pass) may vectorize denser.  Experiment knob until measured.
+    # LIGHTGBM_TPU_ONEHOT_DTYPE picks the compare dtype for the one-hot
+    # build — the kernel's measured bound (~18 ms of the ~27 ms full-N
+    # pass at i32).  u8 (4 values/lane) FAILED to lower on v5e: Mosaic
+    # supports only 16/32-bit iota (ONCHIP_LOG.md).  bf16 packs 2
+    # values/lane with a legal 16-bit iota, and bins 0..255 are exact in
+    # bf16, so `bf16` may halve the compare cost; i32 is the default
+    # until the on-chip A/B lands.
     import os as _os
-    cmp_dtype = (jnp.uint8 if _os.environ.get(
-        "LIGHTGBM_TPU_ONEHOT_DTYPE") == "u8" else jnp.int32)
+    cmp_dtype = {"u8": jnp.uint8, "bf16": jnp.bfloat16}.get(
+        _os.environ.get("LIGHTGBM_TPU_ONEHOT_DTYPE", ""), jnp.int32)
 
     def one_chunk(c, carry):
         wc = wfn(c, chunk)                                  # [8, chunk]
         for p0 in range(0, Fp, fblk):
             np_ = min(fblk, Fp - p0)
-            b = binsT_ref[p0:p0 + np_, pl.ds(c * chunk, chunk)].astype(
-                cmp_dtype)
+            b = binsT_ref[p0:p0 + np_, pl.ds(c * chunk, chunk)]
             if packed4:
-                if cmp_dtype == jnp.uint8:
-                    b = jnp.stack([b & jnp.uint8(15), b >> 4],
-                                  axis=1).reshape(2 * np_, chunk)
-                else:
-                    b = jnp.stack([b & 15, b >> 4], axis=1).reshape(
-                        2 * np_, chunk)
+                # unpack nibbles in integer space (bitwise ops are not
+                # defined for the bf16 compare dtype), then cast
+                bi = b.astype(jnp.int32)
+                b = jnp.stack([bi & 15, bi >> 4], axis=1).reshape(
+                    2 * np_, chunk)
+            b = b.astype(cmp_dtype)
             nf = b.shape[0]
             iota = lax.broadcasted_iota(cmp_dtype, (nf, B, chunk), 1)
             onehot = (b[:, None, :] == iota).astype(

@@ -117,6 +117,13 @@ def main():
              2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
                     "LIGHTGBM_TPU_IMPL": "frontier"})
 
+    # 2b. bf16 one-hot build: legal 16-bit iota, 2 values/lane — may
+    # halve the compare cost that bounds the kernel (u8 failed to lower)
+    run_step("frontier ONEHOT=bf16 10.5M", [PY, probe, "10500000,255,1,2"],
+             2100, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_IMPL": "frontier",
+                    "LIGHTGBM_TPU_ONEHOT_DTYPE": "bf16"})
+
     # 3. trace of 2 strict iterations (parser fixed: tsl protobuf) —
     # what is the bound NOW?
     run_step("trace strict 10.5M", [PY, probe_cli, "trace", "10500000"],
