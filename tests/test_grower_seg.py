@@ -249,3 +249,36 @@ def test_multiclass_batched_roots_parity_packed4(rng):
     np.testing.assert_allclose(fused._raw_predict(X),
                                eager._raw_predict(X),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_segment_epoch_edges(rng, monkeypatch):
+    """Epoch-while edge cases: a tiny compaction budget (compact after
+    nearly every split -> many epochs), a 2-leaf tree (single split,
+    inner loop exits on the leaf budget), and unsplittable data (root
+    only; the outer loop must terminate without a split)."""
+    import lightgbm_tpu.models.grower_seg as gs
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float64)
+
+    monkeypatch.setattr(gs, "COMPACT_WASTE", 0.01)
+    fused, seg = _train_pair(X, y, rng, n_iters=2, objective="binary",
+                             num_leaves=15, max_bin=31,
+                             min_data_in_leaf=5)
+    _assert_tree_parity(fused, seg, X)
+    monkeypatch.setattr(gs, "COMPACT_WASTE", 6.0)
+
+    fused2, seg2 = _train_pair(X, y, rng, n_iters=1, objective="binary",
+                               num_leaves=2, max_bin=31,
+                               min_data_in_leaf=5)
+    assert seg2.models[0].num_leaves == 2
+    _assert_tree_parity(fused2, seg2, X)
+
+    y_const = np.zeros(n)
+    _, seg3 = _train_pair(X, y_const, rng, n_iters=1,
+                          objective="regression", num_leaves=15,
+                          max_bin=31, min_data_in_leaf=5)
+    # the all-constant iteration is dropped entirely (reference
+    # semantics, gbdt.cpp:543-551) — the point here is only that the
+    # epoch-while terminated without a split instead of hanging
+    assert seg3.models == []
