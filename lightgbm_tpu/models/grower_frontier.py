@@ -43,8 +43,7 @@ from ..ops.split import (NEG_INF, FeatureMeta, best_split,
 from .grower import (GrowerParams, _node_feature_mask, mono_handoff)
 from .grower_seg import (COMPACT_WASTE, _COMPACT_MUT, _SegState,
                          _unpermute, compact_state, cond_narrow,
-                         fresh_state, route_split_windowed,
-                         seg_stats_enabled)
+                         fresh_state, route_split_windowed)
 
 
 

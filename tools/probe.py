@@ -171,7 +171,8 @@ def cmd_micro(argv):
         return fn
     timed(mk_unperm_sort2, "unpermute_sort2", args, scale=1.0)
 
-    # score update's [L]-table gather by a full-N index vector
+    # score update's [L]-table gather by a full-N index vector, vs the
+    # one-hot-matmul pallas scorer that replaced it (ops/pallas_score)
     lv = jnp.asarray(rng.normal(size=256).astype(np.float32))
 
     def mk_table_gather(reps):
@@ -182,6 +183,17 @@ def cmd_micro(argv):
                                  jnp.zeros(npad, jnp.float32))
         return fn
     timed(mk_table_gather, "score_table_gather", args, scale=1.0)
+
+    from lightgbm_tpu.ops.pallas_score import score_gather_add
+
+    def mk_score_kernel(reps):
+        def fn(bT, w, lid):
+            def body(i, acc):
+                return score_gather_add(acc, jnp.minimum(lid + i, 255), lv)
+            return lax.fori_loop(0, reps, body,
+                                 jnp.zeros(npad, jnp.float32))
+        return fn
+    timed(mk_score_kernel, "score_onehot_kernel", args, scale=1.0)
 
     # per-skipped-grid-step cost: a 1-block interval dispatched on the
     # full-size grid pays (blocks-1) skipped steps; against the 1-block
