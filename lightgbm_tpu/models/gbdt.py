@@ -285,21 +285,40 @@ class GBDT:
                                                  max(2, cfg.num_leaves))
         data_mode = (tl in ("data", "data_parallel") and impl != "fused"
                      and not forced_plan)
+        # feature-/voting-parallel on the O(leaf) growers are OPT-IN via
+        # an explicit tpu_tree_impl (the auto default keeps the fused
+        # grower those modes always had); every reference parallel
+        # learner inherits the serial O(leaf) machinery
+        # (feature_parallel_tree_learner.cpp:74-75)
+        feature_mode = (tl in ("feature", "feature_parallel")
+                        and impl in ("segment", "frontier")
+                        and not forced_plan)
+        voting_mode = (tl in ("voting", "voting_parallel")
+                       and impl in ("segment", "frontier")
+                       and not forced_plan)
+        oleaf_mode = data_mode or feature_mode or voting_mode
         D = int(mesh.devices.size) if parallel else 1
-        backend = self._resolve_hist_backend(parallel and not data_mode)
+        backend = self._resolve_hist_backend(parallel and not oleaf_mode)
         rb = 0
         self._packed4 = False
         if backend == "pallas":
             from ..ops.pallas_histogram import pick_block_rows
+            # feature-parallel replicates rows (only split FINDING is
+            # sharded); rows-sharded modes pad to whole blocks per shard
+            rows_D = 1 if (parallel and feature_mode) else D
             rb = (cfg.tpu_row_chunk if cfg.tpu_row_chunk > 0 else
                   pick_block_rows(train_set.num_columns,
-                                  self.num_bins, -(-self.num_data // D)))
+                                  self.num_bins,
+                                  -(-self.num_data // rows_D)))
             # each shard's row count must be a whole number of blocks
             # 4-bit packing (Dense4bitsBin equivalent) for <=16-bin
             # datasets: two columns per byte halves the bin-stream DMA
-            # and the compaction sort payload
-            self._packed4 = self.num_bins <= 16
-            self.bins = train_set.device_binned_T(rb * D,
+            # and the compaction sort payload.  Feature-parallel column
+            # stripes slice physical rows, so they keep unpacked bins
+            # (a stripe boundary inside a packed byte would split it).
+            self._packed4 = self.num_bins <= 16 and not (
+                parallel and feature_mode)
+            self.bins = train_set.device_binned_T(rb * rows_D,
                                                   packed4=self._packed4)
             self._row_pad = int(self.bins.shape[1]) - self.num_data
         else:
@@ -345,16 +364,36 @@ class GBDT:
                              and not forced_plan and not use_cegb_lazy)
         if impl in ("segment", "frontier") and not self._use_segment:
             if parallel:
-                log_warning(f"tpu_tree_impl={impl} is unavailable for the "
-                            "feature/voting learners; using the fused "
-                            "grower")
+                log_warning(f"tpu_tree_impl={impl} needs the pallas "
+                            "backend under this parallel layout; using "
+                            "the fused grower")
             else:
                 log_warning(f"tpu_tree_impl={impl} requires the pallas "
                             "histogram backend (and no forced splits / "
                             "CEGB-lazy); using the fused grower")
         bundle_fg = (train_set.bundle.feat_group
                      if train_set.bundle is not None else None)
-        if parallel and self._use_segment and impl == "frontier":
+        if parallel and self._use_segment and (feature_mode or voting_mode):
+            from ..parallel.learners import (
+                make_feature_parallel_oleaf_grower,
+                make_voting_parallel_oleaf_grower)
+            kw = dict(
+                feat_group=bundle_fg, impl=impl,
+                batch_k=(_auto_frontier_k(cfg, train_set.num_columns,
+                                          self.num_bins)
+                         if impl == "frontier" else 0),
+                gain_ratio=float(cfg.tpu_frontier_gain_ratio))
+            if feature_mode:
+                self._grow_fn = make_feature_parallel_oleaf_grower(
+                    self.num_bins, self.grower_params, mesh, rb,
+                    train_set.num_columns,
+                    column_bins=train_set.column_bins, **kw)
+            else:
+                self._grow_fn = make_voting_parallel_oleaf_grower(
+                    self.num_bins, self.grower_params, mesh, rb,
+                    train_set.num_columns, top_k=cfg.top_k, **kw)
+            self._mesh = mesh
+        elif parallel and self._use_segment and impl == "frontier":
             from ..parallel.learners import (
                 make_data_parallel_frontier_grower)
             k = _auto_frontier_k(cfg, train_set.num_columns, self.num_bins)
@@ -639,6 +678,15 @@ class GBDT:
         else:
             fused_roots = None
 
+        # Resolve the scorer choice OUTSIDE the trace: the auto mode
+        # runs a real on-device self-check (lowering + bit-exactness)
+        # and falls back to the gather if the kernel misbehaves.
+        if self.grower_params.hist_backend == "pallas":
+            from ..ops.pallas_score import scorer_available
+            use_score_kernel = scorer_available()
+        else:
+            use_score_kernel = False
+
         @functools.partial(jax.jit, donate_argnums=(0,))
         def fused_step(score, grads, hesss, member, bins, fmeta, fmask,
                        sub, shrinkage, k, roots=None):
@@ -652,7 +700,7 @@ class GBDT:
                                               fmeta, fmask, sub, **kw)
             if pad:
                 leaf_id = leaf_id[:N]
-            if self.grower_params.hist_backend == "pallas":
+            if use_score_kernel:
                 # one-hot-matmul scorer: the plain table gather lowers
                 # to ~1.6 GB/s on this backend (ops/pallas_score)
                 from ..ops.pallas_score import score_gather_add

@@ -36,14 +36,18 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.pallas_histogram import (_segment_buckets, frontier_width,
-                                    histogram_frontier, pack_channels,
+                                    fused_route_available,
+                                    histogram_frontier,
+                                    histogram_frontier_routed, null_route,
+                                    pack_channels, pack_route,
                                     segment_grid_size, unpack_hist)
 from ..ops.split import (NEG_INF, FeatureMeta, best_split,
                          expand_group_hist)
 from .grower import (GrowerParams, _node_feature_mask, mono_handoff)
 from .grower_seg import (COMPACT_WASTE, _COMPACT_MUT, _SegState,
                          _unpermute, compact_state, cond_narrow,
-                         fresh_state, route_split_windowed)
+                         fresh_state, route_split_windowed,
+                         stripe_histogram)
 
 
 
@@ -74,6 +78,11 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
     # a ratio above 1 would gate out even the round-best leaf and hang
     # the growth loop; config validates, this clamp guards direct callers
     gain_ratio = min(max(float(gain_ratio), 0.0), 1.0)
+    # fused route+histogram: the K routes ride the batched histogram pass
+    # (grower_seg has the single-split analog; self-checked at build
+    # time).  Feature-parallel stripes keep the unfused pair — the
+    # histogram scans a column slice, the route needs the full matrix.
+    fused_route = fused_route_available() and comm.column_block is None
 
     def _one_scan(st, hist, g, h, c, depth, fmeta, fmask, key, step,
                   lo, hi):
@@ -138,15 +147,34 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         def grid_of(nb):
             return segment_grid_size(bucket_arr, nb)
 
-        def hist_batch(st: _SegState, targets, block_list, n_blocks):
-            """[K] targets (-1 = skip) -> [K, G, B, 3] over the union."""
-            out = histogram_frontier(st.binsT, st.w8, st.leaf_id,
-                                     block_list, n_blocks, targets, B, rb,
-                                     packed4=p.packed4)
+        def hist_batch(st: _SegState, targets, block_list, n_blocks,
+                       routes=None, fmeta=None):
+            """[K] targets (-1 = skip) -> (st, [K, G, B, 3]) over the
+            union.  ``routes`` [K, 19] applies the round's K split routes
+            inside the kernel (fused path) and updates st.leaf_id."""
+            if comm.column_block is not None:
+                # feature-parallel: batch-histogram only this shard's
+                # column stripe (grower_seg.stripe_histogram)
+                start, ncols = comm.column_block(st.binsT)
+                out = stripe_histogram(
+                    st.binsT, start, ncols,
+                    lambda sub: histogram_frontier(
+                        sub, st.w8, st.leaf_id, block_list, n_blocks,
+                        targets, B, rb, packed4=p.packed4),
+                    feat_axis=1)
+            elif routes is not None:
+                lid, out = histogram_frontier_routed(
+                    st.binsT, st.w8, st.leaf_id, block_list, n_blocks,
+                    targets, routes, B, rb, K, packed4=p.packed4)
+                st = st._replace(leaf_id=lid)
+            else:
+                out = histogram_frontier(st.binsT, st.w8, st.leaf_id,
+                                         block_list, n_blocks, targets, B,
+                                         rb, packed4=p.packed4)
             h = unpack_hist(out[:, :G_cols])
             if comm.reduce_hist_batch is not None:
-                h = comm.reduce_hist_batch(h)
-            return h
+                h = comm.reduce_hist_batch(h, fmeta)
+            return st, h
 
         def apply_split(st: _SegState, leaf, new_leaf, node):
             """Routing + tree-array bookkeeping for ONE split (the cheap
@@ -159,19 +187,21 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             cat = bi[3].astype(bool)
             bitset = st.best_cat_bitset[leaf]
 
-            # routing confined to the parent's inherited block interval
-            # (grower_seg.route_split_windowed)
             lo, hi = st.leaf_lo[leaf], st.leaf_hi[leaf]
-            leaf_id = route_split_windowed(
-                st.binsT, st.leaf_id, fmeta, p.packed4, rb,
-                f, t, dl, cat, bitset, leaf, new_leaf, lo, hi - lo)
+            if not fused_route:
+                # routing confined to the parent's inherited block
+                # interval (grower_seg.route_split_windowed); the fused
+                # path routes inside the batched histogram kernel instead
+                leaf_id = route_split_windowed(
+                    st.binsT, st.leaf_id, fmeta, p.packed4, rb,
+                    f, t, dl, cat, bitset, leaf, new_leaf, lo, hi - lo)
+                st = st._replace(leaf_id=leaf_id)
 
             Gl, Hl, Cl = bf[1], bf[2], bf[3]
             Gp, Hp, Cp = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
             Gr, Hr, Cr = Gp - Gl, Hp - Hl, Cp - Cl
 
             st = st._replace(
-                leaf_id=leaf_id,
                 leaf_lo=st.leaf_lo.at[new_leaf].set(lo),
                 leaf_hi=st.leaf_hi.at[new_leaf].set(hi),
             )
@@ -251,6 +281,11 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             valid = (gains_top > 0.0) & (jnp.arange(K) < budget)
             if gain_ratio > 0.0:
                 valid &= gains_top >= gain_ratio * gains_top[0]
+            # clamp to the longest true PREFIX once, here, so the apply
+            # loop, the fused routes and the histogram targets can never
+            # disagree if a future gate is non-monotone in j (new leaf
+            # ids are base + j, which only works applied in order)
+            valid &= jnp.cumsum(~valid) == 0
             leaves_top = leaves_top.astype(jnp.int32)
             new_leaves = base + jnp.arange(K, dtype=jnp.int32)
             nodes = base - 1 + jnp.arange(K, dtype=jnp.int32)
@@ -273,6 +308,8 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                 return apply_split(s, leaves_top[j], new_leaves[j],
                                    nodes[j])
             parent_hist = st.leaf_hist[leaves_top]          # [K, G, B, 3]
+            # ``valid`` is prefix-clamped above, so the popcount IS the
+            # prefix length
             n_valid = jnp.sum(valid).astype(jnp.int32)
             st = lax.fori_loop(0, n_valid, apply_one, st)
 
@@ -294,8 +331,38 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             # 3) ONE batched kernel pass for the K smaller children
             smaller = jnp.where(smaller_is_left, leaves_top, new_leaves)
             targets = jnp.where(valid, smaller, -1)
-            hist_small = hist_batch(st, targets, block_list, n_un)
-            hist_large = parent_hist - hist_small
+            if fused_route:
+                # the round's K routes ride the same pass (invalid slots
+                # match nothing); split params still live in the best-*
+                # cache — the scans that overwrite them run in step 4
+                routes = jax.vmap(
+                    lambda l, nl, v: jnp.where(
+                        v,
+                        pack_route(l, nl, st.best_i32[l, 0],
+                                   st.best_i32[l, 1],
+                                   st.best_i32[l, 2] == 1,
+                                   st.best_i32[l, 3] == 1,
+                                   st.best_cat_bitset[l], fmeta,
+                                   p.packed4),
+                        null_route()))(leaves_top, new_leaves, valid)
+            else:
+                routes = None
+            st, hist_small = hist_batch(st, targets, block_list, n_un,
+                                        routes, fmeta)
+            if comm.no_subtract:
+                # voting-parallel: election masks differ per call, so the
+                # subtraction trick is invalid — batch-histogram the
+                # larger children from data too (routes already applied)
+                larger = jnp.where(smaller_is_left, new_leaves, leaves_top)
+                targets_l = jnp.where(valid, larger, -1)
+                _, hist_large = hist_batch(st, targets_l, block_list,
+                                           n_un, None, fmeta)
+                scanned = 2 * n_un
+                grid_inc = 2 * grid_of(n_un)
+            else:
+                hist_large = parent_hist - hist_small
+                scanned = n_un
+                grid_inc = grid_of(n_un)
             sel = smaller_is_left[:, None, None, None]
             hist_left = jnp.where(sel, hist_small, hist_large)
             hist_right = jnp.where(sel, hist_large, hist_small)
@@ -305,9 +372,9 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                 leaf_hist=st.leaf_hist
                 .at[idx_l].set(hist_left, mode="drop")
                 .at[idx_r].set(hist_right, mode="drop"),
-                scanned_since=st.scanned_since + n_un,
-                scanned_total=st.scanned_total + n_un,
-                grid_total=st.grid_total + grid_of(n_un),
+                scanned_since=st.scanned_since + scanned,
+                scanned_total=st.scanned_total + scanned,
+                grid_total=st.grid_total + grid_inc,
             )
 
             # 4) scan all 2K children in one vmapped pass
@@ -341,8 +408,13 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                          G0, H0, C0, fmeta, p)
         if root_hist is None:
             root_targets = jnp.full(K, -1, jnp.int32).at[0].set(0)
-            root_hist = hist_batch(st, root_targets, all_blocks,
-                                   jnp.int32(max_blocks))[0]
+            # all-null routes on the fused path: same kernel as the round
+            # passes, so the root costs no extra Mosaic compile
+            root_routes = (jnp.tile(null_route(), (K, 1))
+                           if fused_route else None)
+            _, rh = hist_batch(st, root_targets, all_blocks,
+                               jnp.int32(max_blocks), root_routes, fmeta)
+            root_hist = rh[0]
         st = st._replace(leaf_hist=st.leaf_hist.at[0].set(root_hist),
                          scanned_since=jnp.int32(max_blocks),
                          scanned_total=jnp.int32(max_blocks),

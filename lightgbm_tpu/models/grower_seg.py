@@ -38,9 +38,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.pallas_histogram import (NUM_CHANNELS, _segment_buckets,
-                                    bucket_index, histogram_segment,
-                                    pack_channels, segment_grid_size,
-                                    unpack_hist, unpack_nibble)
+                                    bucket_index, fused_route_available,
+                                    histogram_segment,
+                                    histogram_segment_routed, null_route,
+                                    pack_channels, pack_route,
+                                    segment_grid_size, unpack_hist,
+                                    unpack_nibble)
 from ..ops.split import (NEG_INF, FeatureMeta, best_split, expand_group_hist,
                          reconstruct_feature_column)
 from .grower import (CommHooks, GrowerParams, TreeArrays,
@@ -246,6 +249,21 @@ def route_split_windowed(binsT, leaf_id, fmeta, packed4, rb,
     return lax.switch(idx, [make_branch(b) for b in buckets], leaf_id)
 
 
+def stripe_histogram(binsT, start, ncols, kernel_fn, feat_axis: int):
+    """Feature-parallel stripe scatter shared by the strict and frontier
+    growers: histogram a column SLICE of the bin matrix, then place the
+    result back at its offset in a zero tensor (the scan masks hide the
+    zero columns).  ``kernel_fn(sub)`` maps the [ncols, N] slice to a
+    histogram whose feature axis is ``feat_axis``."""
+    sub = lax.dynamic_slice_in_dim(binsT, start, ncols, axis=0)
+    part = kernel_fn(sub)
+    shape = (part.shape[:feat_axis] + (binsT.shape[0],)
+             + part.shape[feat_axis + 1:])
+    out = jnp.zeros(shape, part.dtype)
+    return lax.dynamic_update_slice_in_dim(out, part, start,
+                                           axis=feat_axis)
+
+
 def _unpermute(order, leaf_id):
     """leaf_id (permuted space) -> original row order.
 
@@ -368,16 +386,44 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
     L = p.num_leaves
     B = num_bins
     rb = block_rows
+    # fused route+histogram: the split's leaf_id update rides the
+    # smaller-child histogram pass instead of separate XLA passes over
+    # the same blocks (self-checked on the live backend at build time).
+    # Feature-parallel stripes (column_block) keep the unfused pair: the
+    # histogram scans a column SLICE while the route needs the full
+    # matrix (the winning split may live on another shard's stripe).
+    fused_route = fused_route_available() and comm.column_block is None
 
-    def hist_leaf(st: _SegState, leaf, G_cols):
+    def hist_leaf(st: _SegState, leaf, G_cols, fmeta=None):
         """Returns (hist [G,B,3], blocks scanned)."""
         lo = st.leaf_lo[leaf]
         n_blk = st.leaf_hi[leaf] - lo
-        out = histogram_segment(st.binsT, st.w8, st.leaf_id, lo, n_blk,
-                                leaf, B, rb, packed4=p.packed4)
+        if comm.column_block is not None:
+            # feature-parallel: histogram only this shard's column
+            # stripe (the reference histograms only the rank's own
+            # features, feature_parallel_tree_learner.cpp:36-75)
+            start, ncols = comm.column_block(st.binsT)
+            out = stripe_histogram(
+                st.binsT, start, ncols,
+                lambda sub: histogram_segment(sub, st.w8, st.leaf_id, lo,
+                                              n_blk, leaf, B, rb,
+                                              packed4=p.packed4),
+                feat_axis=0)
+        elif fused_route and not comm.no_subtract:
+            # same kernel as the split path (one Mosaic compile), with a
+            # match-nothing route; the aliased leaf_id passes through.
+            # no_subtract comms never run the fused split path, so they
+            # keep the plain kernel instead of paying the route's lid
+            # write-back for nothing.
+            _, out = histogram_segment_routed(
+                st.binsT, st.w8, st.leaf_id, lo, n_blk, leaf,
+                null_route(), B, rb, packed4=p.packed4)
+        else:
+            out = histogram_segment(st.binsT, st.w8, st.leaf_id, lo,
+                                    n_blk, leaf, B, rb, packed4=p.packed4)
         h = unpack_hist(out[:G_cols])
         if comm.reduce_hist is not None:
-            h = comm.reduce_hist(h, None, None, None, None)
+            h = comm.reduce_hist(h, None, None, None, fmeta)
         return h, n_blk
 
     def _one_scan(hist, g, h, c, depth, fmeta, fmask, key, step,
@@ -495,15 +541,31 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             bitset = st.best_cat_bitset[leaf]
 
             # children inherit the parent's confinement interval; routing
-            # only needs to touch that window (route_split_windowed)
+            # only needs to touch that window
             lo, hi = st.leaf_lo[leaf], st.leaf_hi[leaf]
-            leaf_id = route_split_windowed(
-                st.binsT, st.leaf_id, fmeta, p.packed4, rb,
-                f, t, dl, cat, bitset, leaf, new_leaf, lo, hi - lo)
-
             Gl, Hl, Cl = bf[1], bf[2], bf[3]
             Gp, Hp, Cp = st.leaf_g[leaf], st.leaf_h[leaf], st.leaf_c[leaf]
             Gr, Hr, Cr = Gp - Gl, Hp - Hl, Cp - Cl
+            smaller_is_left = Cl <= Cr
+            smaller = jnp.where(smaller_is_left, leaf, new_leaf)
+
+            if fused_route and not comm.no_subtract:
+                # route + smaller-child histogram in ONE kernel pass over
+                # the parent interval (histogram_segment_routed)
+                route = pack_route(leaf, new_leaf, f, t, dl, cat, bitset,
+                                   fmeta, p.packed4)
+                leaf_id, out = histogram_segment_routed(
+                    st.binsT, st.w8, st.leaf_id, lo, hi - lo, smaller,
+                    route, B, rb, packed4=p.packed4)
+                hist_small = unpack_hist(out[:G_cols])
+                if comm.reduce_hist is not None:
+                    hist_small = comm.reduce_hist(hist_small, None, None,
+                                                  None, fmeta)
+                blk = hi - lo
+            else:
+                leaf_id = route_split_windowed(
+                    st.binsT, st.leaf_id, fmeta, p.packed4, rb,
+                    f, t, dl, cat, bitset, leaf, new_leaf, lo, hi - lo)
 
             st = st._replace(
                 leaf_id=leaf_id,
@@ -525,9 +587,25 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
             if p.use_cegb_coupled:
                 st = st._replace(feat_used=st.feat_used.at[f].set(1.0))
 
-            smaller_is_left = Cl <= Cr
-            smaller = jnp.where(smaller_is_left, leaf, new_leaf)
-            hist_small, blk = hist_leaf(st, smaller, G_cols)
+            if comm.no_subtract:
+                # voting-parallel: each call's election masks differ, so
+                # parent-minus-smaller is invalid (CommHooks doc) — build
+                # BOTH children from data over the same interval
+                hist_left, _b1 = hist_leaf(st, leaf, G_cols, fmeta)
+                hist_right, _b2 = hist_leaf(st, new_leaf, G_cols, fmeta)
+                blk = _b1 + _b2
+                grid_blk = grid_of(_b1) + grid_of(_b2)
+            else:
+                if not fused_route:
+                    hist_small, blk = hist_leaf(st, smaller, G_cols,
+                                                fmeta)
+                grid_blk = grid_of(blk)
+                hist_parent = st.leaf_hist[leaf]
+                hist_large = hist_parent - hist_small
+                hist_left = jnp.where(smaller_is_left, hist_small,
+                                      hist_large)
+                hist_right = jnp.where(smaller_is_left, hist_large,
+                                       hist_small)
             # the epoch-while predicates gate on scanned_since, so it must
             # be shard-uniform under the distributed wrappers (CommHooks
             # doc); scanned_total stays the shard-local truth for stats
@@ -535,11 +613,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                      if comm.uniform_scan is not None else blk)
             st = st._replace(scanned_since=st.scanned_since + blk_u,
                              scanned_total=st.scanned_total + blk,
-                             grid_total=st.grid_total + grid_of(blk))
-            hist_parent = st.leaf_hist[leaf]
-            hist_large = hist_parent - hist_small
-            hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
-            hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
+                             grid_total=st.grid_total + grid_blk)
             leaf_hist = (st.leaf_hist.at[leaf].set(hist_left)
                          .at[new_leaf].set(hist_right))
 
@@ -635,7 +709,8 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         st = fresh_state(binsT, w8, n, L, G_cols, B, F, max_blocks,
                          G0, H0, C0, fmeta, p)
         if root_hist is None:
-            root_hist, root_blk = hist_leaf(st, jnp.int32(0), G_cols)
+            root_hist, root_blk = hist_leaf(st, jnp.int32(0), G_cols,
+                                            fmeta)
         else:
             # external batched pass: charge the same scan cost so the
             # adaptive-compaction accounting is unchanged

@@ -718,6 +718,455 @@ def histogram_frontier(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
                           n_blocks, targets)
 
 
+# ---------------------------------------------------------------------------
+# Fused route + histogram (PERF_NOTES "Designed, not yet built", landed r5).
+#
+# The windowed route (grower_seg.route_split_windowed) runs as separate XLA
+# slice/where/update passes over the SAME blocks the smaller-child histogram
+# kernel DMAs anyway.  These kernels fold the split routing into the
+# histogram pass: per block, update the leaf_id VMEM block with the split's
+# route (computed from the split feature's own bin row, streamed as an extra
+# [1, rb] input whose index_map reads the prefetched row — dynamic sublane
+# indexing of the u8 block is not safely supported on Mosaic), THEN
+# accumulate the target leaf's histogram from the UPDATED ids.  leaf_id is
+# an aliased input/output: blocks outside the interval are never written and
+# keep their values; the route update is idempotent (rows moved to new_leaf
+# stop matching leaf), so out-of-range grid-step remapping to the last
+# in-range block stays correct even when a revisited block re-reads
+# post-write data.  Reference analog: routing rides the partition work the
+# histogram already pays for (src/treelearner/data_partition.hpp:111).
+# ---------------------------------------------------------------------------
+
+_ROUTE_WORDS = 19  # leaf,new_leaf,row,col,thr,dl,cat,mt,dbin,nbf,off + 8 bitset
+_MISSING_ZERO = 1  # core/binning.py:24-26 (kept literal: kernels must not
+_MISSING_NAN = 2   # import the host-side binning module)
+
+
+def pack_route(leaf, new_leaf, f, t, dl, cat, bitset, fmeta,
+               packed4: bool) -> jax.Array:
+    """[_ROUTE_WORDS] i32 route descriptor for the fused kernels.
+
+    ``f`` is the LOGICAL feature; the descriptor carries the physical
+    bin row, the group column (for the packed4 nibble parity) and the
+    EFB reconstruction scalars so the kernel can reproduce
+    reconstruct_feature_column + routed_left exactly."""
+    f = jnp.asarray(f, jnp.int32)
+    col = (fmeta.feat_group[f] if fmeta.feat_group is not None else f)
+    row = col // 2 if packed4 else col
+    off = (fmeta.feat_offset[f] if fmeta.feat_group is not None
+           else jnp.int32(0))
+    head = jnp.stack([
+        jnp.asarray(leaf, jnp.int32), jnp.asarray(new_leaf, jnp.int32),
+        row, col, jnp.asarray(t, jnp.int32),
+        jnp.asarray(dl, jnp.int32), jnp.asarray(cat, jnp.int32),
+        fmeta.missing_type[f], fmeta.default_bin[f], fmeta.num_bin[f],
+        off]).astype(jnp.int32)
+    return jnp.concatenate([head, lax.bitcast_convert_type(
+        jnp.asarray(bitset, jnp.uint32), jnp.int32)])
+
+
+def null_route() -> jax.Array:
+    """Route that matches nothing (leaf == -1): the root-histogram case."""
+    return (jnp.zeros(_ROUTE_WORDS, jnp.int32).at[0].set(-1))
+
+
+def _route_block_ids(sref, o: int, frow_ref, lid, packed4: bool):
+    """[1, rb] updated leaf ids from the route descriptor at scalar
+    offset ``o`` (all sref reads are static-offset SMEM scalars)."""
+    g = frow_ref[...].astype(jnp.int32)                 # [1, rb]
+    if packed4:
+        g = jnp.where(sref[o + 3] % 2 == 1, g >> 4, g & 15)
+    thr, dl = sref[o + 4], sref[o + 5] == 1
+    cat, mt = sref[o + 6] == 1, sref[o + 7]
+    dbin, nbf, off = sref[o + 8], sref[o + 9], sref[o + 10]
+    in_range = (g >= off) & (g < off + nbf)
+    fcol = jnp.where(in_range, g - off, dbin)
+    is_missing = (((mt == _MISSING_ZERO) & (fcol == dbin))
+                  | ((mt == _MISSING_NAN) & (fcol == nbf - 1)))
+    num_left = jnp.where(is_missing, dl, fcol <= thr)
+    idx = jnp.clip(fcol, 0, 255)
+    # cat bitset membership: 8 unrolled word selects (no vector SMEM loads)
+    word = jnp.zeros_like(g)
+    for k in range(8):
+        word = jnp.where(idx // 32 == k, sref[o + 11 + k], word)
+    cat_left = ((word >> (idx % 32)) & 1) == 1
+    go_left = jnp.where(cat, cat_left, num_left)
+    return jnp.where((lid == sref[o]) & ~go_left, sref[o + 1], lid)
+
+
+def _kernel_segment_routed(sref, binsT_ref, w_ref, frow_ref, lid_ref,
+                           lid_out_ref, out_ref, acc_ref, *,
+                           num_bins, packed4):
+    # sref: [3 + _ROUTE_WORDS] = (start_block, n_blocks, target_leaf, route)
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # 1) route this block — unconditional: skipped steps revisit an
+    # in-range block and the update is idempotent
+    lid_out_ref[...] = _route_block_ids(sref, 3, frow_ref, lid_ref[...],
+                                        packed4)
+
+    # 2) accumulate the target's histogram from the UPDATED ids
+    @pl.when(i < sref[1])
+    def _():
+        def wfn(c, chunk):
+            wc = w_ref[:, pl.ds(c * chunk, chunk)]
+            lc = lid_out_ref[:, pl.ds(c * chunk, chunk)]
+            return wc * (lc == sref[2]).astype(jnp.bfloat16)
+
+        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block_rows", "interpret",
+                                    "packed4"))
+def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
+                             leaf_id: jax.Array, start_block: jax.Array,
+                             n_blocks: jax.Array, target_leaf: jax.Array,
+                             route: jax.Array, num_bins: int,
+                             block_rows: int = 0,
+                             interpret: bool | None = None,
+                             packed4: bool = False):
+    """Apply one split's route to ``leaf_id`` AND histogram ``target_leaf``
+    in a single pass over the confinement interval.
+
+    ``route`` is a [_ROUTE_WORDS] i32 descriptor (pack_route /
+    null_route).  Returns ``(leaf_id', [F, B, 8] hist)`` where the ids
+    are post-route over the whole array (blocks outside the interval
+    keep their values via input/output aliasing).  Dynamic-grid only —
+    callers needing the bucket ladder use the unfused pair.
+    """
+    F, n = binsT.shape
+    F_log = 2 * F if packed4 else F
+    if block_rows <= 0:
+        block_rows = pick_block_rows(F_log, num_bins)
+    assert n % block_rows == 0, (n, block_rows)
+    if interpret is None:
+        interpret = _interpret_default()
+    max_blocks = n // block_rows
+    grid_n = jnp.clip(n_blocks, 1, max_blocks).astype(jnp.int32)
+    scalars = jnp.concatenate([
+        jnp.stack([start_block, n_blocks, target_leaf]).astype(jnp.int32),
+        route.astype(jnp.int32)])
+
+    def im_data(i, s):
+        return (0, jnp.minimum(s[0] + i, max_blocks - 1))
+
+    def im_frow(i, s):
+        return (s[5], jnp.minimum(s[0] + i, max_blocks - 1))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(grid_n,),
+        in_specs=[
+            pl.BlockSpec((F, block_rows), im_data),
+            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((1, block_rows), im_frow),
+            pl.BlockSpec((1, block_rows), im_data),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_rows), im_data),
+            pl.BlockSpec((F_log * num_bins, NUM_CHANNELS),
+                         lambda i, s: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, NUM_CHANNELS),
+                                   jnp.float32)],
+    )
+    lid_out, hist = pl.pallas_call(
+        functools.partial(_kernel_segment_routed, num_bins=num_bins,
+                          packed4=packed4),
+        out_shape=[jax.ShapeDtypeStruct((1, n), jnp.int32),
+                   jax.ShapeDtypeStruct((F_log * num_bins, NUM_CHANNELS),
+                                        jnp.float32)],
+        grid_spec=grid_spec,
+        # alias indices include the scalar operand: input 4 is leaf_id
+        input_output_aliases={4: 0},
+        interpret=interpret,
+    )(scalars, binsT, w8, binsT, leaf_id.reshape(1, -1))
+    return lid_out[0], hist.reshape(F_log, num_bins, NUM_CHANNELS)
+
+
+def _kernel_frontier_routed(sref, binsT_ref, w_ref, *rest, num_bins, K,
+                            packed4):
+    # rest: (frow_0..frow_{K-1}, lid_ref, lid_out_ref, out_ref, acc_ref)
+    # sref: [2 + K + K*_ROUTE_WORDS + n_grid] =
+    #   (n_blocks, pad, targets[K], routes[K*19], block_list[n_grid])
+    frows = rest[:K]
+    lid_ref, lid_out_ref, out_ref, acc_ref = rest[K:]
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # 1) K route updates — leaves are disjoint and new ids exceed every
+    # routed leaf, so at most one route matches a row and application
+    # order is irrelevant; invalid slots carry leaf == -1
+    lid = lid_ref[...]
+    for k in range(K):
+        lid = _route_block_ids(sref, 2 + K + k * _ROUTE_WORDS, frows[k],
+                               lid, packed4)
+    lid_out_ref[...] = lid
+
+    # 2) batched accumulate of the K targets from the UPDATED ids
+    @pl.when(i < sref[0])
+    def _():
+        def wfn(c, chunk):
+            wc = w_ref[:, pl.ds(c * chunk, chunk)]
+            lc = lid_out_ref[:, pl.ds(c * chunk, chunk)]
+            rows = []
+            for k in range(K):
+                mask = (lc == sref[2 + k]).astype(jnp.bfloat16)
+                rows.append(mask * wc)
+            return jnp.concatenate(rows, axis=0)
+
+        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block_rows", "K",
+                                    "interpret", "packed4"))
+def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
+                              leaf_id: jax.Array, block_list: jax.Array,
+                              n_blocks: jax.Array, targets: jax.Array,
+                              routes: jax.Array, num_bins: int,
+                              block_rows: int = 0, K: int = 0,
+                              interpret: bool | None = None,
+                              packed4: bool = False):
+    """Frontier variant: apply K splits' routes and histogram the K
+    target leaves in one pass over the union block list.
+
+    ``routes`` is [K, _ROUTE_WORDS] i32 (invalid slots: null_route()).
+    Each split's feature row streams as its own [1, rb] input (K static
+    unrolled refs — Mosaic cannot index the u8 block's sublanes
+    dynamically).  Returns ``(leaf_id', [K, F, B, 8])``.
+    """
+    F, n = binsT.shape
+    K = K or int(targets.shape[0])
+    F_log = 2 * F if packed4 else F
+    if block_rows <= 0:
+        block_rows = pick_block_rows(F_log, num_bins)
+    assert n % block_rows == 0, (n, block_rows)
+    if interpret is None:
+        interpret = _interpret_default()
+    max_blocks = n // block_rows
+    grid_n = jnp.clip(n_blocks, 1, max_blocks).astype(jnp.int32)
+    bl = block_list.astype(jnp.int32)[:max_blocks]
+    scalars = jnp.concatenate([
+        jnp.stack([n_blocks.astype(jnp.int32), jnp.int32(0)]),
+        targets.astype(jnp.int32), routes.astype(jnp.int32).reshape(-1),
+        bl])
+    blk_base = 2 + K + K * _ROUTE_WORDS
+
+    def im_data(i, s):
+        idx = jnp.minimum(i, jnp.maximum(s[0] - 1, 0))
+        return (0, jnp.minimum(s[blk_base + idx], max_blocks - 1))
+
+    def im_frow(k):
+        def im(i, s):
+            idx = jnp.minimum(i, jnp.maximum(s[0] - 1, 0))
+            return (s[2 + K + k * _ROUTE_WORDS + 2],
+                    jnp.minimum(s[blk_base + idx], max_blocks - 1))
+        return im
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(grid_n,),
+        in_specs=[
+            pl.BlockSpec((F, block_rows), im_data),
+            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+        ] + [pl.BlockSpec((1, block_rows), im_frow(k)) for k in range(K)]
+        + [pl.BlockSpec((1, block_rows), im_data)],
+        out_specs=[
+            pl.BlockSpec((1, block_rows), im_data),
+            pl.BlockSpec((F_log * num_bins, K * NUM_CHANNELS),
+                         lambda i, s: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, K * NUM_CHANNELS),
+                                   jnp.float32)],
+    )
+    lid_out, hist = pl.pallas_call(
+        functools.partial(_kernel_frontier_routed, num_bins=num_bins, K=K,
+                          packed4=packed4),
+        out_shape=[jax.ShapeDtypeStruct((1, n), jnp.int32),
+                   jax.ShapeDtypeStruct((F_log * num_bins,
+                                         K * NUM_CHANNELS), jnp.float32)],
+        grid_spec=grid_spec,
+        # inputs: scalars, binsT, w8, frow_0..frow_{K-1}, leaf_id
+        input_output_aliases={3 + K: 0},
+        interpret=interpret,
+    )(scalars, binsT, w8, *([binsT] * K), leaf_id.reshape(1, -1))
+    return lid_out[0], hist.reshape(F_log, num_bins, K,
+                                    NUM_CHANNELS).transpose(2, 0, 1, 3)
+
+
+_FUSED_ROUTE_CHECK: bool | None = None
+
+
+def fused_route_available() -> bool:
+    """Whether the growers should use the fused route+histogram kernels.
+
+    ``LIGHTGBM_TPU_FUSED_ROUTE=0/1`` forces; default ("auto") runs a
+    one-shot self-check on the live backend — the kernels must lower
+    AND reproduce the separate route+histogram pair exactly, including
+    untouched-block retention through the input/output alias.  Requires
+    the dynamic-grid dispatch (the bucket ladder keeps the unfused
+    pair).
+    """
+    global _FUSED_ROUTE_CHECK
+    import os
+    env = os.environ.get("LIGHTGBM_TPU_FUSED_ROUTE", "auto").lower()
+    if env in ("0", "off", "false"):
+        return False
+    if not dyn_grid_enabled():
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    if _FUSED_ROUTE_CHECK is None:
+        try:
+            _FUSED_ROUTE_CHECK = _fused_route_self_check()
+        except Exception:
+            _FUSED_ROUTE_CHECK = False
+    return _FUSED_ROUTE_CHECK
+
+
+def _fused_route_self_check() -> bool:
+    """Tiny multi-block parity run of the fused kernels vs the unfused
+    pair on the real backend (numerical + categorical + missing routes,
+    out-of-window retention)."""
+    import numpy as np
+    rng = np.random.default_rng(7)
+    F, B, rb, nblk = 4, 16, 512, 6
+    n = rb * nblk
+    binsT = jnp.asarray(rng.integers(0, B, (F, n)), jnp.uint8)
+    grad = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    hess = jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32)
+    member = jnp.ones(n, jnp.float32)
+    w8 = pack_channels(grad, hess, member)
+    # two leaves confined to blocks [1, 4); leaf 7 elsewhere
+    lid = np.full(n, 7, np.int32)
+    lid[rb:4 * rb] = np.where(rng.random(3 * rb) < 0.5, 3, 5)
+    lid = jnp.asarray(lid)
+    bitset = jnp.asarray(rng.integers(0, 2**32, 8, dtype=np.uint64)
+                         .astype(np.uint32))
+
+    class _M:  # minimal FeatureMeta-alike for pack_route
+        feat_group = None
+        feat_offset = None
+        missing_type = jnp.asarray([1, 2, 0, 0], jnp.int32)
+        default_bin = jnp.asarray([3, 0, 0, 0], jnp.int32)
+        num_bin = jnp.full((4,), B, jnp.int32)
+
+    for f, cat in ((0, False), (1, True)):
+        route = pack_route(3, 9, f, B // 2, True, cat, bitset, _M, False)
+        lid2, hist = histogram_segment_routed(
+            binsT, w8, lid, jnp.int32(1), jnp.int32(3), jnp.int32(9),
+            route, B, rb)
+        # reference: separate route + segment histogram
+        fcol = np.asarray(binsT[f]).astype(np.int64)
+        mt = int(_M.missing_type[f])
+        miss = ((mt == 1) & (fcol == int(_M.default_bin[f]))
+                | (mt == 2) & (fcol == B - 1))
+        if cat:
+            w = np.asarray(bitset)[np.clip(fcol, 0, 255) // 32]
+            go_left = (w >> (np.clip(fcol, 0, 255) % 32)) & 1 > 0
+        else:
+            go_left = np.where(miss, True, fcol <= B // 2)
+        exp = np.asarray(lid).copy()
+        win = np.zeros(n, bool)
+        win[rb:4 * rb] = True
+        exp[(exp == 3) & ~go_left & win] = 9
+        if not np.array_equal(np.asarray(lid2), exp):
+            return False
+        ref = histogram_segment(binsT, w8, jnp.asarray(exp), jnp.int32(1),
+                                jnp.int32(3), jnp.int32(9), B, rb)
+        if not np.allclose(np.asarray(hist), np.asarray(ref), atol=1e-5):
+            return False
+    # packed4: the in-kernel route must unpack the split column by
+    # nibble parity (both parities), on 4-bit bins
+    bins4 = jnp.asarray(rng.integers(0, 15, (F, n)), jnp.uint8)
+    packedT = jnp.asarray(pack_bins_4bit(bins4))
+
+    class _M4(_M):
+        num_bin = jnp.full((4,), 15, jnp.int32)
+        missing_type = jnp.zeros(4, jnp.int32)
+        default_bin = jnp.zeros(4, jnp.int32)
+
+    for f in (1, 2):   # odd = high nibble, even = low
+        route = pack_route(3, 9, f, 7, False, False,
+                           jnp.zeros(8, jnp.uint32), _M4, True)
+        lid4, hist4 = histogram_segment_routed(
+            packedT, w8, lid, jnp.int32(1), jnp.int32(3), jnp.int32(9),
+            route, 16, rb, packed4=True)
+        fcol = np.asarray(bins4[f]).astype(np.int64)
+        exp4 = np.asarray(lid).copy()
+        win = np.zeros(n, bool)
+        win[rb:4 * rb] = True
+        exp4[(exp4 == 3) & (fcol > 7) & win] = 9
+        if not np.array_equal(np.asarray(lid4), exp4):
+            return False
+        ref4 = histogram_segment(packedT, w8, jnp.asarray(exp4),
+                                 jnp.int32(1), jnp.int32(3), jnp.int32(9),
+                                 16, rb, packed4=True)
+        if not np.allclose(np.asarray(hist4), np.asarray(ref4),
+                           atol=1e-5):
+            return False
+
+    # EFB: group column carries feature at offset; out-of-range bins
+    # reconstruct to the feature default
+    class _ME(_M):
+        feat_group = jnp.asarray([0, 0, 1, 1], jnp.int32)
+        feat_offset = jnp.asarray([0, 6, 0, 6], jnp.int32)
+        num_bin = jnp.full((4,), 6, jnp.int32)
+        missing_type = jnp.zeros(4, jnp.int32)
+        default_bin = jnp.zeros(4, jnp.int32)
+
+    route = pack_route(3, 9, 1, 2, False, False, jnp.zeros(8, jnp.uint32),
+                       _ME, False)  # feature 1 -> col 0, offset 6
+    lid5, _h5 = histogram_segment_routed(
+        binsT, w8, lid, jnp.int32(1), jnp.int32(3), jnp.int32(9), route,
+        B, rb)
+    g = np.asarray(binsT[0]).astype(np.int64)
+    fcol = np.where((g >= 6) & (g < 12), g - 6, 0)
+    exp5 = np.asarray(lid).copy()
+    win = np.zeros(n, bool)
+    win[rb:4 * rb] = True
+    exp5[(exp5 == 3) & (fcol > 2) & win] = 9
+    if not np.array_equal(np.asarray(lid5), exp5):
+        return False
+
+    # frontier: one real route + one null slot
+    K = 2
+    routes = jnp.stack([pack_route(5, 10, 2, 4, False, False,
+                                   jnp.zeros(8, jnp.uint32), _M, False),
+                        null_route()])
+    targets = jnp.asarray([10, -1], jnp.int32)
+    # union = leaf 5's confinement blocks [1, 4)
+    bl = jnp.asarray([1, 2, 3, 0, 0, 0], jnp.int32)
+    lid3, hist3 = histogram_frontier_routed(
+        binsT, w8, lid, bl, jnp.int32(3), targets, routes, B, rb, K)
+    fcol = np.asarray(binsT[2]).astype(np.int64)
+    exp3 = np.asarray(lid).copy()
+    exp3[(exp3 == 5) & (fcol > 4)] = 10
+    if not np.array_equal(np.asarray(lid3), exp3):
+        return False
+    ref3 = histogram_frontier(binsT, w8, jnp.asarray(exp3), bl,
+                              jnp.int32(3), targets, B, rb)
+    return bool(np.allclose(np.asarray(hist3[0]), np.asarray(ref3[0]),
+                            atol=1e-5))
+
+
 def leaf_histogram_pallas(binsT: jax.Array, grad: jax.Array,
                           hess: jax.Array, member: jax.Array,
                           num_bins: int, block_rows: int = 0,

@@ -17,9 +17,11 @@ rows on the host threadpool.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -28,6 +30,38 @@ from .pallas_histogram import _interpret_default
 BLOCK = 32768
 CHUNK = 512
 
+_SELF_CHECK: bool | None = None
+
+
+def scorer_available() -> bool:
+    """Whether the one-hot scorer should replace the table gather.
+
+    ``LIGHTGBM_TPU_SCORE_KERNEL=0/1`` forces it; the default ("auto")
+    runs a one-shot self-check on the live backend: the kernel must
+    lower AND reproduce ``score + table[leaf_id]`` bit-for-bit.  The
+    interpret-mode parity tests run in full f32 and cannot see MXU
+    rounding or Mosaic lowering failures, so the check has to happen
+    here, non-interpret, on the real device.
+    """
+    global _SELF_CHECK
+    env = os.environ.get("LIGHTGBM_TPU_SCORE_KERNEL", "auto").lower()
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    if _SELF_CHECK is None:
+        rng = np.random.default_rng(0)
+        table = jnp.asarray(rng.standard_normal(255), jnp.float32)
+        lid = jnp.asarray(rng.integers(0, 255, 4096), jnp.int32)
+        score = jnp.asarray(rng.standard_normal(4096), jnp.float32)
+        try:
+            got = score_gather_add(score, lid, table)
+            want = score + table[lid]
+            _SELF_CHECK = bool(jnp.array_equal(got, want))
+        except Exception:  # lowering/compile failure -> gather path
+            _SELF_CHECK = False
+    return _SELF_CHECK
+
 
 def _kernel(lv_ref, lid_ref, score_ref, out_ref, *, table_pad):
     def one_chunk(c, carry):
@@ -35,8 +69,14 @@ def _kernel(lv_ref, lid_ref, score_ref, out_ref, *, table_pad):
         lid = lid_ref[0, sl]
         iota = lax.broadcasted_iota(jnp.int32, (table_pad, CHUNK), 0)
         onehot = (iota == lid[None, :]).astype(jnp.float32)
+        # Precision.HIGHEST: the MXU otherwise rounds f32 operands to
+        # bf16, corrupting the leaf-value table and breaking the
+        # train-score/predict exactness contract above.  The 3-pass
+        # bf16 decomposition is exact here (one nonzero 1.0f term per
+        # row), and the matmul is not the kernel bound.
         v = lax.dot_general(lv_ref[...], onehot, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32,
+                            precision=lax.Precision.HIGHEST)
         out_ref[0, sl] = score_ref[0, sl] + v[0]
         return carry
 

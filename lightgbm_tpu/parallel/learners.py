@@ -152,6 +152,44 @@ def _log_collective_estimate(mode: str, D: int, num_columns: int,
              f"{total / 1e6:.1f} MB/tree on the wire")
 
 
+def _make_voting_reduce(axis, sp, top_k: int):
+    """Voting-parallel histogram reduction (PV-Tree,
+    voting_parallel_tree_learner.cpp:170-380): local top-k vote in
+    FEATURE space, global election by psum'd votes, and only elected
+    columns' histograms cross the wire."""
+    def reduce_voted(h, G, H, C, fmeta):
+        # vote in FEATURE space on the expanded view (identity when
+        # unbundled), reduce in COLUMN space.  The vote must use LOCAL
+        # leaf totals — G/H/C are already psum'd global stats, and
+        # expanding the pre-reduce partial histogram with global totals
+        # would inflate the reconstructed default-bin slot by the other
+        # shards' mass.  Every row lands in exactly one bin of every
+        # column, so column 0's bin-sum IS the local (g, h, count).
+        loc = h[0].sum(axis=0)
+        hf = expand_group_hist(h, fmeta, loc[0], loc[1], loc[2])
+        local_gains = per_feature_gains(hf, loc[0], loc[1], loc[2],
+                                        fmeta, sp)               # [F]
+        F = local_gains.shape[0]
+        k = min(top_k, F)
+        gains_top, local_top = lax.top_k(local_gains, k)
+        votes = jnp.zeros(F, dtype=jnp.int32).at[local_top].add(
+            jnp.where(gains_top > NEG_INF, 1, 0))
+        votes = lax.psum(votes, axis)
+        k2 = min(2 * top_k, F)
+        _, elected = lax.top_k(votes, k2)
+        fmask = jnp.zeros(F, dtype=h.dtype).at[elected].set(1.0)
+        if fmeta.feat_group is not None:
+            # a column crosses the wire if ANY member feature is elected
+            mask = jnp.zeros(h.shape[0], dtype=h.dtype) \
+                .at[fmeta.feat_group].max(fmask)
+        else:
+            mask = fmask
+        # only elected columns' histograms cross the wire; the rest are
+        # zeroed so their candidates mask out in the scan
+        return lax.psum(h * mask[:, None, None], axis)
+    return reduce_voted
+
+
 def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
                          mode: str, top_k: int = 20,
                          num_columns: int = 0, feat_group=None,
@@ -219,29 +257,8 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
         # boundaries balance per-shard Σbins like the reference (:36-47)
         # when per-column bin counts are known; even column split is the
         # uniform-bins special case.
-        G = num_columns
-        if column_bins is not None and len(column_bins) == G and D > 1:
-            starts_np, widths_np, per = _balanced_stripes(column_bins, D)
-        else:
-            per = -(-G // D)
-            starts_np = (np.arange(D) * per).astype(np.int32)
-            widths_np = np.minimum(per, np.maximum(
-                G - starts_np, 0)).astype(np.int32)
-        # the static block every shard READS is `per` wide; clamp its
-        # start so the read stays in-bounds (mask start stays exact)
-        block_starts_np = np.minimum(starts_np,
-                                     max(G - per, 0)).astype(np.int32)
-        starts_d = jnp.asarray(starts_np)
-        widths_d = jnp.asarray(widths_np)
-        block_starts_d = jnp.asarray(block_starts_np)
-
-        def column_block(bins):
-            return block_starts_d[lax.axis_index(axis)], per
-
-        def shard_mask(fmask):
-            me = lax.axis_index(axis)
-            return _stripe_feature_mask(fmask, axis, starts_d[me],
-                                        widths_d[me], feat_group)
+        column_block, shard_mask, per = _feature_stripes(
+            mesh, num_columns, feat_group, column_bins)
 
         comm = CommHooks(
             merge_split=lambda info, gain: _merge_split_by_gain(
@@ -251,37 +268,7 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
         in_specs = (repl, repl, repl, repl, repl, repl, repl)
         out_specs = (repl, repl)
     elif mode in ("voting", "voting_parallel"):
-        def reduce_voted(h, G, H, C, fmeta):
-            # vote in FEATURE space on the expanded view (identity when
-            # unbundled), reduce in COLUMN space.  The vote must use LOCAL
-            # leaf totals — G/H/C are already psum'd global stats, and
-            # expanding the pre-reduce partial histogram with global totals
-            # would inflate the reconstructed default-bin slot by the other
-            # shards' mass.  Every row lands in exactly one bin of every
-            # column, so column 0's bin-sum IS the local (g, h, count).
-            loc = h[0].sum(axis=0)
-            hf = expand_group_hist(h, fmeta, loc[0], loc[1], loc[2])
-            local_gains = per_feature_gains(hf, loc[0], loc[1], loc[2],
-                                            fmeta, sp)               # [F]
-            F = local_gains.shape[0]
-            k = min(top_k, F)
-            gains_top, local_top = lax.top_k(local_gains, k)
-            votes = jnp.zeros(F, dtype=jnp.int32).at[local_top].add(
-                jnp.where(gains_top > NEG_INF, 1, 0))
-            votes = lax.psum(votes, axis)
-            k2 = min(2 * top_k, F)
-            _, elected = lax.top_k(votes, k2)
-            fmask = jnp.zeros(F, dtype=h.dtype).at[elected].set(1.0)
-            if fmeta.feat_group is not None:
-                # a column crosses the wire if ANY member feature is elected
-                mask = jnp.zeros(h.shape[0], dtype=h.dtype) \
-                    .at[fmeta.feat_group].max(fmask)
-            else:
-                mask = fmask
-            # only elected columns' histograms cross the wire; the rest are
-            # zeroed so their candidates mask out in the scan
-            return lax.psum(h * mask[:, None, None], axis)
-
+        reduce_voted = _make_voting_reduce(axis, sp, top_k)
         # votes differ per histogram call, so parent/child histograms carry
         # different election masks; the subtraction trick is invalid here
         # and both children must be histogrammed from data
@@ -403,7 +390,7 @@ def make_data_parallel_frontier_grower(num_bins: int, params: GrowerParams,
     axis, D, Gpad, per, shard_mask, in_specs, out_specs = _stripe_setup(
         mesh, G, feat_group)
 
-    def reduce_hist_batch(h):
+    def reduce_hist_batch(h, fmeta=None):
         # [K, G, B, 3] per-shard partials -> each shard owns the reduced
         # [K, stripe, B, 3] of one contiguous column stripe, placed back
         # at its offset (zeros elsewhere; stripe masks hide them)
@@ -414,21 +401,12 @@ def make_data_parallel_frontier_grower(num_bins: int, params: GrowerParams,
         out = lax.dynamic_update_slice(out, mine, (0, me * per, 0, 0))
         return out[:, :G]
 
-    def merge_split_batch(infos, gains):
-        # [2K] per-child SplitInfos -> per-child global best by gain
-        # (SyncUpGlobalBestSplit batched over the round)
-        gall = lax.all_gather(gains, axis)              # [D, 2K]
-        winner = jnp.argmax(gall, axis=0)               # [2K]
-        pick = jnp.arange(gains.shape[0])
-        merged = SplitInfo(*[lax.all_gather(f, axis)[winner, pick]
-                             for f in infos])
-        return merged, gall[winner, pick]
-
     comm = CommHooks(
         reduce_stats=lambda x: lax.psum(x, axis),
         shard_feature_mask=shard_mask,
         reduce_hist_batch=reduce_hist_batch,
-        merge_split_batch=merge_split_batch)
+        merge_split_batch=lambda infos, gains: _merge_batch_by_gain(
+            infos, gains, axis))
 
     def wrap(grow):
         return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
@@ -438,3 +416,150 @@ def make_data_parallel_frontier_grower(num_bins: int, params: GrowerParams,
     return make_grow_tree_frontier(num_bins, params, block_rows,
                                    batch_k=batch_k, gain_ratio=gain_ratio,
                                    comm=comm, wrap=wrap)
+
+
+def _feature_stripes(mesh: Mesh, num_columns: int, feat_group,
+                     column_bins):
+    """Feature-parallel stripe maps shared by the fused and O(leaf)
+    learners: (column_block, shard_mask, per) with Σbins balancing
+    (feature_parallel_tree_learner.cpp:36-47)."""
+    axis = mesh.axis_names[0]
+    D = int(mesh.devices.size)
+    G = num_columns
+    if column_bins is not None and len(column_bins) == G and D > 1:
+        starts_np, widths_np, per = _balanced_stripes(column_bins, D)
+    else:
+        per = -(-G // D)
+        starts_np = (np.arange(D) * per).astype(np.int32)
+        widths_np = np.minimum(per, np.maximum(
+            G - starts_np, 0)).astype(np.int32)
+    block_starts_d = jnp.asarray(np.minimum(starts_np, max(G - per, 0))
+                                 .astype(np.int32))
+    starts_d = jnp.asarray(starts_np)
+    widths_d = jnp.asarray(widths_np)
+
+    def column_block(bins):
+        return block_starts_d[lax.axis_index(axis)], per
+
+    def shard_mask(fmask):
+        me = lax.axis_index(axis)
+        return _stripe_feature_mask(fmask, axis, starts_d[me],
+                                    widths_d[me], feat_group)
+
+    return column_block, shard_mask, per
+
+
+def make_feature_parallel_oleaf_grower(num_bins: int, params: GrowerParams,
+                                       mesh: Mesh, block_rows: int,
+                                       num_columns: int, feat_group=None,
+                                       column_bins=None,
+                                       impl: str = "segment",
+                                       batch_k: int = 0,
+                                       gain_ratio: float = 0.0):
+    """Feature-parallel learner on the O(leaf) segment/frontier growers.
+
+    The reference's feature-parallel contract
+    (feature_parallel_tree_learner.cpp:33-75) on the O(leaf) machinery:
+    data REPLICATED on every shard; each shard histograms AND scans only
+    its Σbins-balanced column stripe over the leaf's confinement
+    interval; SplitInfos merge by max-gain all_gather; every shard then
+    routes/compacts locally (identical layouts, no row data on the
+    wire).  Histogram kernel cost is cut D× by the column slice — the
+    interval scan structure is untouched.
+    """
+    from ..models.grower_frontier import make_grow_tree_frontier
+    from ..models.grower_seg import make_grow_tree_segment
+
+    axis = mesh.axis_names[0]
+    D = int(mesh.devices.size)
+    column_block, shard_mask, _per = _feature_stripes(
+        mesh, num_columns, feat_group, column_bins)
+
+    repl = P()
+    in_specs = (repl,) * 7
+    out_specs = (repl, repl, repl)
+
+    def wrap(grow):
+        return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
+
+    _log_collective_estimate("feature", D, num_columns, num_bins,
+                             params.num_leaves)
+    if impl == "frontier":
+        comm = CommHooks(
+            shard_feature_mask=shard_mask, column_block=column_block,
+            merge_split_batch=lambda infos, gains: _merge_batch_by_gain(
+                infos, gains, axis))
+        return make_grow_tree_frontier(num_bins, params, block_rows,
+                                       batch_k=batch_k,
+                                       gain_ratio=gain_ratio, comm=comm,
+                                       wrap=wrap)
+    comm = CommHooks(
+        merge_split=lambda info, gain: _merge_split_by_gain(info, gain,
+                                                            axis),
+        shard_feature_mask=shard_mask, column_block=column_block)
+    return make_grow_tree_segment(num_bins, params, block_rows, comm=comm,
+                                  wrap=wrap)
+
+
+def _merge_batch_by_gain(infos, gains, axis):
+    """[2K]-batched SyncUpGlobalBestSplit (shared by the data- and
+    feature-parallel frontier learners)."""
+    gall = lax.all_gather(gains, axis)              # [D, 2K]
+    winner = jnp.argmax(gall, axis=0)               # [2K]
+    pick = jnp.arange(gains.shape[0])
+    merged = SplitInfo(*[lax.all_gather(f, axis)[winner, pick]
+                         for f in infos])
+    return merged, gall[winner, pick]
+
+
+def make_voting_parallel_oleaf_grower(num_bins: int, params: GrowerParams,
+                                      mesh: Mesh, block_rows: int,
+                                      num_columns: int, feat_group=None,
+                                      top_k: int = 20,
+                                      impl: str = "segment",
+                                      batch_k: int = 0,
+                                      gain_ratio: float = 0.0):
+    """Voting-parallel learner on the O(leaf) segment/frontier growers.
+
+    PV-Tree (voting_parallel_tree_learner.cpp:170-380) with rows sharded
+    like the data-parallel O(leaf) learners: each shard votes its local
+    top-k features per histogram call, only the globally-elected columns'
+    histograms are psum'd, and both children are histogrammed from data
+    (election masks differ per call, so parent-minus-smaller is invalid
+    — CommHooks.no_subtract).
+    """
+    from ..models.grower_frontier import make_grow_tree_frontier
+    from ..models.grower_seg import make_grow_tree_segment
+
+    G = num_columns
+    axis, D, Gpad, per, _smask, in_specs, out_specs = _stripe_setup(
+        mesh, G, feat_group)
+    reduce_voted = _make_voting_reduce(axis, params.split, top_k)
+
+    def wrap(grow):
+        return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
+
+    _log_collective_estimate("voting", D, G, num_bins, params.num_leaves,
+                             top_k)
+    if impl == "frontier":
+        def reduce_batch(h, fmeta=None):
+            # per-leaf elections over the [K, G, B, 3] round batch
+            return jax.vmap(
+                lambda hk: reduce_voted(hk, None, None, None, fmeta))(h)
+
+        comm = CommHooks(
+            reduce_stats=lambda x: lax.psum(x, axis),
+            reduce_hist_batch=reduce_batch,
+            merge_split_batch=lambda infos, gains: (infos, gains),
+            no_subtract=True)
+        return make_grow_tree_frontier(num_bins, params, block_rows,
+                                       batch_k=batch_k,
+                                       gain_ratio=gain_ratio, comm=comm,
+                                       wrap=wrap)
+    comm = CommHooks(
+        reduce_hist=reduce_voted,
+        reduce_stats=lambda x: lax.psum(x, axis),
+        no_subtract=True,
+        uniform_scan=lambda b: lax.pmax(b, axis))
+    return make_grow_tree_segment(num_bins, params, block_rows, comm=comm,
+                                  wrap=wrap)

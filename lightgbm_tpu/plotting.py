@@ -16,54 +16,15 @@ from .basic import Booster
 from .utils.log import LightGBMError
 
 
-def _check_not_tuple_of_2_elements(obj, obj_name="obj"):
-    if not isinstance(obj, tuple) or len(obj) != 2:
-        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
-
-
-def plot_importance(booster, ax=None, height: float = 0.2,
-                    xlim=None, ylim=None, title="Feature importance",
-                    xlabel="Feature importance", ylabel="Features",
-                    importance_type="split", max_num_features=None,
-                    ignore_zero=True, figsize=None, dpi=None, grid=True,
-                    precision=3, **kwargs):
-    try:
-        import matplotlib.pyplot as plt
-    except ImportError:
-        raise ImportError("You must install matplotlib "
-                          "to plot importance.")
-    if isinstance(booster, Booster):
-        b = booster
-    elif hasattr(booster, "booster_"):
-        b = booster.booster_
-    else:
-        raise TypeError("booster must be Booster or LGBMModel.")
-    importance = b.feature_importance(importance_type)
-    feature_name = b.feature_name()
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
-    if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
-    if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    if not tuples:
-        raise ValueError("Cannot plot empty feature importances.")
-    labels, values = zip(*tuples)
-    if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    ylocs = np.arange(len(values))
-    ax.barh(ylocs, values, align="center", height=height, **kwargs)
-    for x, y in zip(values, ylocs):
-        ax.text(x + 1, y,
-                f"{x:.{precision}f}" if importance_type == "gain" else str(x),
-                va="center")
-    ax.set_yticks(ylocs)
-    ax.set_yticklabels(labels)
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-        ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-        ax.set_ylim(ylim)
+def _decorate_axes(ax, *, xlim, ylim, title, xlabel, ylabel, grid):
+    """Shared axis cosmetics for the plot_* helpers."""
+    for name, lim, setter in (("xlim", xlim, ax.set_xlim),
+                              ("ylim", ylim, ax.set_ylim)):
+        if lim is None:
+            continue
+        if not (isinstance(lim, tuple) and len(lim) == 2):
+            raise TypeError(f"{name} must be a tuple of 2 elements.")
+        setter(lim)
     if title:
         ax.set_title(title)
     if xlabel:
@@ -72,6 +33,53 @@ def plot_importance(booster, ax=None, height: float = 0.2,
         ax.set_ylabel(ylabel)
     ax.grid(grid)
     return ax
+
+
+def _to_booster(booster) -> Booster:
+    if isinstance(booster, Booster):
+        return booster
+    if hasattr(booster, "booster_"):  # fitted sklearn estimator
+        return booster.booster_
+    raise TypeError("booster must be Booster or LGBMModel.")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim=None, ylim=None, title="Feature importance",
+                    xlabel="Feature importance", ylabel="Features",
+                    importance_type="split", max_num_features=None,
+                    ignore_zero=True, figsize=None, dpi=None, grid=True,
+                    precision=3, **kwargs):
+    """Horizontal bar chart of per-feature importance, least important
+    at the bottom (reference signature: plotting.py:21)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib "
+                          "to plot importance.")
+    b = _to_booster(booster)
+    values = np.asarray(b.feature_importance(importance_type))
+    names = np.asarray(b.feature_name(), dtype=object)
+    order = np.argsort(values, kind="stable")
+    if ignore_zero:
+        order = order[values[order] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        order = order[max(len(order) - max_num_features, 0):]
+    if order.size == 0:
+        raise ValueError("Cannot plot empty feature importances.")
+    values, names = values[order], names[order]
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    rows = np.arange(order.size)
+    ax.barh(rows, values, align="center", height=height, **kwargs)
+    as_text = ((lambda v: f"{v:.{precision}f}")
+               if importance_type == "gain" else str)
+    for row, v in enumerate(values):
+        ax.text(v + 1, row, as_text(v), va="center")
+    ax.set_yticks(rows)
+    ax.set_yticklabels(names)
+    return _decorate_axes(ax, xlim=xlim, ylim=ylim, title=title,
+                          xlabel=xlabel, ylabel=ylabel, grid=grid)
 
 
 def plot_metric(booster, metric=None, dataset_names=None, ax=None,

@@ -1,0 +1,80 @@
+"""Fused route+histogram kernels (ops/pallas_histogram.py r5).
+
+The fused kernels fold the split's leaf_id routing into the histogram
+pass (the reference's routing likewise rides the partition work,
+src/treelearner/data_partition.hpp:111).  They must reproduce the
+unfused route_split_windowed + histogram_segment/frontier pair exactly:
+same leaf ids (including untouched blocks through the input/output
+alias), same histograms, hence identical trees.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.core.dataset import TpuDataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objective import create_objective
+
+
+def _train(X, y, impl, fused, monkeypatch, cat_feats=(), n_iters=3,
+           **params):
+    monkeypatch.setenv("LIGHTGBM_TPU_FUSED_ROUTE", "1" if fused else "0")
+    cfg = Config(verbosity=-1, tpu_histogram_backend="pallas",
+                 tpu_tree_impl=impl, **params)
+    ds = TpuDataset.from_numpy(X, y, config=cfg,
+                               categorical_features=list(cat_feats))
+    obj = create_objective(cfg)
+    obj.init(ds.metadata, ds.num_data)
+    bst = GBDT(cfg, ds, obj)
+    for _ in range(n_iters):
+        bst.train_one_iter()
+    return bst
+
+
+def _assert_identical(a, b, X):
+    assert len(a.models) == len(b.models)
+    for i, (ta, tb) in enumerate(zip(a.models, b.models)):
+        assert ta.num_leaves == tb.num_leaves, f"tree {i}"
+        assert np.array_equal(ta.split_feature, tb.split_feature), i
+        assert np.array_equal(ta.threshold_in_bin, tb.threshold_in_bin), i
+        np.testing.assert_allclose(ta.leaf_value, tb.leaf_value,
+                                   rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(a._raw_predict(X), b._raw_predict(X),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_kernel_self_check():
+    from lightgbm_tpu.ops.pallas_histogram import _fused_route_self_check
+    assert _fused_route_self_check()
+
+
+@pytest.mark.parametrize("impl", ["segment", "frontier"])
+def test_fused_matches_unfused(rng, monkeypatch, impl):
+    """Numerical + categorical + NaN routing, multi-block, compaction."""
+    n = 4000
+    X = rng.normal(size=(n, 6))
+    X[rng.random(size=n) < 0.1, 3] = np.nan
+    X[:, 5] = rng.randint(0, 12, size=n)
+    y = ((X[:, 0] + 0.5 * X[:, 1] > 0)
+         | (X[:, 5] > 8)).astype(np.float64)
+    kw = dict(objective="binary", num_leaves=31, max_bin=63,
+              min_data_in_leaf=5)
+    unfused = _train(X, y, impl, False, monkeypatch, cat_feats=[5], **kw)
+    fused = _train(X, y, impl, True, monkeypatch, cat_feats=[5], **kw)
+    assert fused._use_segment or impl == "frontier"
+    _assert_identical(unfused, fused, X)
+
+
+def test_fused_matches_unfused_packed4(rng, monkeypatch):
+    """max_bin <= 15 selects the packed4 nibble layout; the in-kernel
+    route must unpack the split column by parity."""
+    n = 3000
+    X = rng.normal(size=(n, 5))
+    y = (X[:, 0] - 0.7 * X[:, 2] > 0).astype(np.float64)
+    kw = dict(objective="binary", num_leaves=15, max_bin=15,
+              min_data_in_leaf=5)
+    unfused = _train(X, y, "segment", False, monkeypatch, **kw)
+    fused = _train(X, y, "segment", True, monkeypatch, **kw)
+    assert fused.grower_params.packed4
+    _assert_identical(unfused, fused, X)

@@ -261,12 +261,13 @@ def test_segment_epoch_edges(rng, monkeypatch):
     X = rng.normal(size=(n, 4))
     y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float64)
 
-    monkeypatch.setattr(gs, "COMPACT_WASTE", 0.01)
-    fused, seg = _train_pair(X, y, rng, n_iters=2, objective="binary",
-                             num_leaves=15, max_bin=31,
-                             min_data_in_leaf=5)
-    _assert_tree_parity(fused, seg, X)
-    monkeypatch.setattr(gs, "COMPACT_WASTE", 6.0)
+    with monkeypatch.context() as mp:
+        mp.setattr(gs, "COMPACT_WASTE", 0.01)
+        fused, seg = _train_pair(X, y, rng, n_iters=2, objective="binary",
+                                 num_leaves=15, max_bin=31,
+                                 min_data_in_leaf=5)
+        _assert_tree_parity(fused, seg, X)
+    # context exit restores the module default for the sub-cases below
 
     fused2, seg2 = _train_pair(X, y, rng, n_iters=1, objective="binary",
                                num_leaves=2, max_bin=31,
