@@ -33,7 +33,7 @@ lgb.Dataset <- function(data, label = NULL, params = list(),
     reference$ptr
   }
   if (is.character(data)) {
-    ptr <- .Call(LGBMTPU_DatasetCreateFromFile_R, data, pstr)
+    ptr <- .Call(LGBMTPU_DatasetCreateFromFile_R, data, pstr, ref_ptr)
   } else {
     data <- as.matrix(data)
     storage.mode(data) <- "double"
@@ -73,4 +73,30 @@ lgb.Dataset.set.field <- function(dataset, field, values) {
 dim.lgb.Dataset.tpu <- function(x) {
   c(.Call(LGBMTPU_DatasetGetNumData_R, x$ptr),
     .Call(LGBMTPU_DatasetGetNumFeature_R, x$ptr))
+}
+
+#' Save the binned dataset to the reference binary format
+lgb.Dataset.save <- function(dataset, fname) {
+  stopifnot(inherits(dataset, "lgb.Dataset.tpu"))
+  .Call(LGBMTPU_DatasetSaveBinary_R, dataset$ptr, fname)
+  invisible(dataset)
+}
+
+#' Validation data binned with the training data's mappers
+lgb.Dataset.create.valid <- function(dataset, data, label = NULL,
+                                     params = list(), ...) {
+  stopifnot(inherits(dataset, "lgb.Dataset.tpu"))
+  lgb.Dataset(data, label = label, params = params,
+              reference = dataset, ...)
+}
+
+#' Feature names of a constructed Dataset
+dimnames.lgb.Dataset.tpu <- function(x) {
+  list(NULL, .Call(LGBMTPU_DatasetGetFeatureNames_R, x$ptr))
+}
+
+#' Read a metadata field back (label / weight / group / init_score)
+lgb.Dataset.get.field <- function(dataset, field) {
+  stopifnot(inherits(dataset, "lgb.Dataset.tpu"))
+  .Call(LGBMTPU_DatasetGetField_R, dataset$ptr, field)
 }

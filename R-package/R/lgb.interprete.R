@@ -1,0 +1,146 @@
+# Per-prediction feature contributions (the role of the reference
+# R-package's lgb.interprete.R / lgb.plot.interpretation.R, rebuilt in
+# base R over the TEXT model format instead of jsonlite+data.table over
+# lgb.dump: the path-walk attribution only needs the per-tree arrays the
+# text format already carries).
+
+#' Parse a Booster's trees into one data.frame per tree
+#'
+#' Columns: kind ("node"/"leaf"), index, parent (node id, -1 for the
+#' root), feature (split feature for nodes, NA for leaves), value
+#' (internal_value for nodes, leaf_value for leaves).  Node child
+#' references in the text format encode leaves as ~leaf (negative);
+#' parents are reconstructed by scanning the child arrays.
+lgb.model.dt.tree <- function(booster, num_iteration = -1L) {
+  model_str <- if (is.character(booster)) booster else
+    lgb.model.to.string(booster, num_iteration)
+  blocks <- strsplit(model_str, "\nTree=", fixed = TRUE)[[1L]]
+  if (length(blocks) < 2L) {
+    stop("model string carries no trees")
+  }
+  lapply(blocks[-1L], function(block) {
+    lines <- strsplit(block, "\n", fixed = TRUE)[[1L]]
+    get_arr <- function(key, mode) {
+      row <- grep(paste0("^", key, "="), lines, value = TRUE)
+      if (length(row) == 0L) return(vector(mode, 0L))
+      vals <- strsplit(sub(paste0("^", key, "="), "", row[1L]),
+                       " ", fixed = TRUE)[[1L]]
+      storage.mode(vals) <- mode
+      vals
+    }
+    num_leaves <- get_arr("num_leaves", "integer")[1L]
+    leaf_value <- get_arr("leaf_value", "double")
+    if (num_leaves <= 1L) {
+      return(data.frame(kind = "leaf", index = 0L, parent = -1L,
+                        feature = NA_integer_, value = leaf_value[1L]))
+    }
+    split_feature <- get_arr("split_feature", "integer")
+    internal_value <- get_arr("internal_value", "double")
+    left_child <- get_arr("left_child", "integer")
+    right_child <- get_arr("right_child", "integer")
+    n_nodes <- num_leaves - 1L
+    node_parent <- rep(-1L, n_nodes)
+    leaf_parent <- rep(-1L, num_leaves)
+    for (p in seq_len(n_nodes)) {
+      for (child in c(left_child[p], right_child[p])) {
+        if (child >= 0L) {
+          node_parent[child + 1L] <- p - 1L
+        } else {
+          leaf_parent[-child] <- p - 1L    # ~leaf == -(leaf)-1
+        }
+      }
+    }
+    rbind(
+      data.frame(kind = "node", index = seq_len(n_nodes) - 1L,
+                 parent = node_parent, feature = split_feature,
+                 value = internal_value),
+      data.frame(kind = "leaf", index = seq_len(num_leaves) - 1L,
+                 parent = leaf_parent, feature = NA_integer_,
+                 value = leaf_value)
+    )
+  })
+}
+
+.single_tree_interprete <- function(tree_df, leaf_idx, n_features) {
+  contrib <- numeric(n_features)
+  leaves <- tree_df[tree_df$kind == "leaf", ]
+  nodes <- tree_df[tree_df$kind == "node", ]
+  row <- leaves[leaves$index == leaf_idx, ]
+  if (nrow(row) == 0L || row$parent < 0L) {
+    return(contrib)                      # stump: no split to attribute
+  }
+  value <- row$value
+  p <- row$parent
+  while (p >= 0L) {
+    prow <- nodes[nodes$index == p, ]
+    f <- prow$feature + 1L
+    contrib[f] <- contrib[f] + (value - prow$value)
+    value <- prow$value
+    p <- prow$parent
+  }
+  contrib
+}
+
+#' Feature contributions of individual predictions (path attribution)
+#'
+#' For each requested row, walks every tree from the predicted leaf to
+#' the root; each split contributes the change in expected value across
+#' it, attributed to the split feature (the reference lgb.interprete
+#' contract, R-package/R/lgb.interprete.R).  Returns one data.frame per
+#' row with a Feature column and one Contribution column per class.
+#'
+#' @param model lgb.Booster.tpu.
+#' @param data numeric matrix.
+#' @param idxset integer row indices (1-based) to interpret.
+#' @param num_iteration iterations to use (-1 = all).
+lgb.interprete <- function(model, data, idxset, num_iteration = -1L) {
+  data <- as.matrix(data)
+  trees <- lgb.model.dt.tree(model, num_iteration)
+  num_class <- .Call(LGBMTPU_BoosterGetNumClasses_R, model$ptr)
+  n_features <- ncol(data)
+  feature_names <- tryCatch(
+    .Call(LGBMTPU_BoosterGetFeatureNames_R, model$ptr),
+    error = function(e) NULL)
+  if (is.null(feature_names) || length(feature_names) != n_features) {
+    feature_names <- paste0("Column_", seq_len(n_features) - 1L)
+  }
+  leaf_mat <- predict(model, data[idxset, , drop = FALSE],
+                      predleaf = TRUE, num_iteration = num_iteration)
+  leaf_mat <- matrix(leaf_mat, nrow = length(idxset))
+  lapply(seq_along(idxset), function(i) {
+    contrib <- matrix(0.0, n_features, num_class)
+    for (t in seq_along(trees)) {
+      cls <- (t - 1L) %% num_class + 1L
+      contrib[, cls] <- contrib[, cls] +
+        .single_tree_interprete(trees[[t]], leaf_mat[i, t], n_features)
+    }
+    out <- data.frame(Feature = feature_names)
+    for (cls in seq_len(num_class)) {
+      col <- if (num_class == 1L) "Contribution" else
+        paste0("Class_", cls - 1L)
+      out[[col]] <- contrib[, cls]
+    }
+    # rank by total attribution magnitude across classes — ordering by
+    # class 0 alone buries features dominant for other classes
+    ord <- order(-rowSums(abs(contrib)))
+    out[ord, , drop = FALSE]
+  })
+}
+
+#' Plot one row's interpretation as a horizontal bar chart
+#'
+#' @param tree_interpretation one element of lgb.interprete()'s result.
+#' @param top_n number of features to show.
+#' @param cols reserved for multiclass layouts (reference signature).
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
+                                    cols = 1L, ...) {
+  df <- tree_interpretation
+  valcol <- setdiff(colnames(df), "Feature")[1L]
+  df <- df[order(abs(df[[valcol]]), decreasing = TRUE), ]
+  df <- utils::head(df, top_n)
+  df <- df[rev(seq_len(nrow(df))), ]
+  graphics::barplot(df[[valcol]], names.arg = df$Feature, horiz = TRUE,
+                    las = 1L, main = "Feature contribution",
+                    xlab = valcol, ...)
+  invisible(df)
+}

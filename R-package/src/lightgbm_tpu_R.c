@@ -63,10 +63,13 @@ SEXP LGBMTPU_GetLastError_R(void) {
 
 /* ---------- Dataset ---------- */
 
-SEXP LGBMTPU_DatasetCreateFromFile_R(SEXP filename, SEXP params) {
+SEXP LGBMTPU_DatasetCreateFromFile_R(SEXP filename, SEXP params,
+                                     SEXP reference) {
   DatasetHandle h = NULL;
+  DatasetHandle ref = reference == R_NilValue ? NULL
+                                              : get_handle(reference);
   CHECK_CALL(LGBM_DatasetCreateFromFile(
-      CHAR(STRING_ELT(filename, 0)), CHAR(STRING_ELT(params, 0)), NULL,
+      CHAR(STRING_ELT(filename, 0)), CHAR(STRING_ELT(params, 0)), ref,
       &h));
   return wrap_handle(h, dataset_finalizer);
 }
@@ -150,6 +153,48 @@ SEXP LGBMTPU_DatasetGetFeatureNames_R(SEXP handle) {
   }
   int got = 0;
   CHECK_CALL(LGBM_DatasetGetFeatureNames(get_handle(handle), buf, &got));
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, got));
+  for (int i = 0; i < got; ++i) {
+    SET_STRING_ELT(out, i, Rf_mkChar(buf[i]));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMTPU_DatasetGetField_R(SEXP handle, SEXP name) {
+  int len = 0, dtype = -1;
+  const void* ptr = NULL;
+  CHECK_CALL(LGBM_DatasetGetField(get_handle(handle),
+                                  CHAR(STRING_ELT(name, 0)), &len, &ptr,
+                                  &dtype));
+  SEXP out;
+  if (dtype == 0) {                       /* C_API_DTYPE_FLOAT32 */
+    out = PROTECT(Rf_allocVector(REALSXP, len));
+    for (int i = 0; i < len; ++i)
+      REAL(out)[i] = (double)((const float*)ptr)[i];
+  } else if (dtype == 1) {                /* FLOAT64 */
+    out = PROTECT(Rf_allocVector(REALSXP, len));
+    for (int i = 0; i < len; ++i)
+      REAL(out)[i] = ((const double*)ptr)[i];
+  } else {                                /* INT32 (group boundaries) */
+    out = PROTECT(Rf_allocVector(INTSXP, len));
+    for (int i = 0; i < len; ++i)
+      INTEGER(out)[i] = ((const int*)ptr)[i];
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMTPU_BoosterGetFeatureNames_R(SEXP handle) {
+  int n = 0;
+  CHECK_CALL(LGBM_BoosterGetNumFeature(get_handle(handle), &n));
+  char** buf = (char**)R_alloc(n, sizeof(char*));
+  for (int i = 0; i < n; ++i) {
+    buf[i] = (char*)R_alloc(LGBMTPU_MAX_NAME, 1);
+    buf[i][0] = '\0';
+  }
+  int got = 0;
+  CHECK_CALL(LGBM_BoosterGetFeatureNames(get_handle(handle), &got, buf));
   SEXP out = PROTECT(Rf_allocVector(STRSXP, got));
   for (int i = 0; i < got; ++i) {
     SET_STRING_ELT(out, i, Rf_mkChar(buf[i]));
@@ -357,7 +402,7 @@ SEXP LGBMTPU_BoosterFree_R(SEXP handle) {
 
 static const R_CallMethodDef CallEntries[] = {
     CALLDEF(LGBMTPU_GetLastError_R, 0),
-    CALLDEF(LGBMTPU_DatasetCreateFromFile_R, 2),
+    CALLDEF(LGBMTPU_DatasetCreateFromFile_R, 3),
     CALLDEF(LGBMTPU_DatasetCreateFromMat_R, 3),
     CALLDEF(LGBMTPU_DatasetSetField_R, 3),
     CALLDEF(LGBMTPU_DatasetGetNumData_R, 1),
@@ -381,6 +426,8 @@ static const R_CallMethodDef CallEntries[] = {
     CALLDEF(LGBMTPU_BoosterSaveModel_R, 3),
     CALLDEF(LGBMTPU_BoosterSaveModelToString_R, 2),
     CALLDEF(LGBMTPU_BoosterGetNumFeature_R, 1),
+    CALLDEF(LGBMTPU_BoosterGetFeatureNames_R, 1),
+    CALLDEF(LGBMTPU_DatasetGetField_R, 2),
     CALLDEF(LGBMTPU_BoosterFeatureImportance_R, 3),
     CALLDEF(LGBMTPU_BoosterDumpModel_R, 2),
     CALLDEF(LGBMTPU_BoosterFree_R, 1),

@@ -346,7 +346,8 @@ class Booster:
         elif model_file is not None or model_str is not None:
             from .models.serialization import load_model
             if model_file is not None:
-                with open(model_file) as fh:
+                from .utils.file_io import open_file
+                with open_file(model_file) as fh:
                     model_str = fh.read()
             model_str, self.pandas_categorical = \
                 _split_pandas_categorical(model_str)
@@ -456,7 +457,8 @@ class Booster:
     # ---------------------------------------------------------------- model
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        with open(filename, "w") as fh:
+        from .utils.file_io import open_file
+        with open_file(filename, "w") as fh:
             fh.write(self.model_to_string(num_iteration, start_iteration))
         return self
 

@@ -32,8 +32,16 @@ def load_parameters(argv: List[str]) -> Dict[str, str]:
     """argv key=value pairs + optional config file (application.cpp:48-81)."""
     params: Dict[str, str] = {}
     for arg in argv:
-        if "=" not in arg and os.path.exists(arg):
-            arg = f"config={arg}"
+        if "=" not in arg:
+            if os.path.exists(arg):
+                arg = f"config={arg}"
+            elif arg.strip().lower() in ("train", "training", "predict",
+                                         "prediction", "test",
+                                         "convert_model", "refit",
+                                         "refit_tree"):
+                # subcommand convenience: `... predict data=...` must
+                # not silently fall through to the default task=train
+                arg = f"task={arg.strip()}"
         kv2map(params, arg)
     config_file = params.get("config", params.get("config_file", ""))
     if config_file:

@@ -85,3 +85,62 @@ def greedy_find_bin_native(distinct_values: np.ndarray, counts: np.ndarray,
         len(dv), int(max_bin), int(total_cnt), int(min_data_in_bin),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     return list(out[:n])
+
+
+_text_lib: Optional[ctypes.CDLL] = None
+_text_tried = False
+
+
+def text_lib() -> Optional[ctypes.CDLL]:
+    """Native LibSVM tokenizer (src/native/textparse.cpp), built on first
+    use like fastbin; None -> callers fall back to the Python parser."""
+    global _text_lib, _text_tried
+    if _text_tried:
+        return _text_lib
+    _text_tried = True
+    src = os.path.join(os.path.dirname(_source_path()), "textparse.cpp")
+    if not os.path.exists(src):
+        return None
+    out = os.path.join(os.path.dirname(src), "libtextparse.so")
+    try:
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(src)):
+            _build(src, out)
+        _text_lib = ctypes.CDLL(out)
+        _text_lib.lgbmtpu_libsvm_scan.restype = ctypes.c_int64
+        _text_lib.lgbmtpu_libsvm_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        _text_lib.lgbmtpu_libsvm_fill.restype = ctypes.c_int64
+        _text_lib.lgbmtpu_libsvm_fill.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.c_int64]
+    except Exception as e:  # noqa: BLE001 — parsing must keep working
+        from ..utils.log import log_warning
+        log_warning(f"native textparse unavailable ({type(e).__name__}: "
+                    f"{str(e)[-200:]}); falling back to the Python "
+                    f"LibSVM parser")
+        _text_lib = None
+    return _text_lib
+
+
+def parse_libsvm_native(data: bytes):
+    """bytes -> dense [n, max_idx + 2] float64 (label in column 0), or
+    None when the native tokenizer is unavailable."""
+    L = text_lib()
+    if L is None:
+        return None
+    n_rows = ctypes.c_int64(0)
+    max_idx = ctypes.c_int64(-1)
+    if L.lgbmtpu_libsvm_scan(data, len(data), ctypes.byref(n_rows),
+                             ctypes.byref(max_idx)) != 0:
+        return None
+    out = np.zeros((n_rows.value, max(max_idx.value, -1) + 2),
+                   dtype=np.float64)
+    filled = L.lgbmtpu_libsvm_fill(
+        data, len(data), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        n_rows.value, out.shape[1])
+    if filled != n_rows.value:
+        return None
+    return out

@@ -72,7 +72,10 @@ def _parse_libsvm(lines: List[str]) -> np.ndarray:
             if ":" not in tok:
                 continue
             k, v = tok.split(":", 1)
-            idx = int(k)
+            try:
+                idx = int(k)
+            except ValueError:
+                continue             # qid:-style prefixes are skipped
             feats[idx] = float(v)
             max_idx = max(max_idx, idx)
         parsed.append((label, feats))
@@ -246,9 +249,24 @@ def load_file_to_dataset(filename: str, config: Config, reference=None):
         del ds._qids_tmp
     else:
         if fmt == "libsvm":
-            with open_file(filename) as fh:
-                lines = fh.readlines()[skip_rows:]
-            mat = _parse_libsvm(lines)
+            mat = None
+            if skip_rows == 0:
+                # native two-pass tokenizer (src/native/textparse.cpp);
+                # the Python parser is the spec and the fallback
+                from .native import parse_libsvm_native
+                try:
+                    with open_file(filename, "rb") as fh:
+                        mat = parse_libsvm_native(fh.read())
+                except MemoryError:
+                    # the readlines() fallback holds the same bytes as
+                    # millions of str objects — it can only OOM harder
+                    raise
+                except Exception:
+                    mat = None
+            if mat is None:
+                with open_file(filename) as fh:
+                    lines = fh.readlines()[skip_rows:]
+                mat = _parse_libsvm(lines)
         else:
             mat = _read_dense_matrix(filename, sep, skip_rows)
         if mat.shape[1] != ncol:

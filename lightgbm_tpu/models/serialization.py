@@ -281,7 +281,12 @@ def load_model(model_str: str):
     out = LoadedBoosting()
     out.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", 1))
     out.max_feature_idx = int(kv.get("max_feature_idx", 0))
-    out.feature_names = kv.get("feature_names", "").split()
+    # cap name length at the C-bridge buffer bound (LGBMTPU_MAX_NAME,
+    # R-package shim / strings_out consumers copy into 4096-byte
+    # buffers): an externally-authored model must not be able to
+    # overflow them through a pathological feature_names line
+    out.feature_names = [n[:4095] for n in
+                         kv.get("feature_names", "").split()]
     out.average_output = "average_output" in kv
     if "init_scores" in kv and kv["init_scores"]:
         out.init_scores = [float(x) for x in kv["init_scores"].split()]

@@ -44,6 +44,12 @@ def enable_jax_compilation_cache(repo_root: str | None = None) -> None:
     try:
         jax.config.update("jax_compilation_cache_dir",
                           os.path.join(repo_root, ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+        # cache EVERY executable: the warmup budget is dominated by many
+        # medium-size compiles (bucketed kernels, fused_step variants),
+        # and the round-4 on-chip runs still paid ~200s warm — so no
+        # compile is too small to keep
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:  # noqa: BLE001 — the cache is an optimization only
         pass

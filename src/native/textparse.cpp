@@ -1,0 +1,171 @@
+// Native LibSVM tokenizer.
+//
+// The reference parses text in C++ (LibSVMParser, src/io/parser.cpp /
+// Common::Atof) while the Python path split()s every token in the
+// interpreter — the last interpreter-bound leg of text ingestion (dense
+// CSV already rides the pandas C tokenizer).  Two passes over the raw
+// byte buffer: scan (row count + max feature index) then fill a dense
+// row-major matrix whose column 0 is the label and column idx+1 is
+// feature idx — exactly the layout lightgbm_tpu.core.parser._parse_libsvm
+// produces, which is the spec (results must match it exactly).
+//
+// Built on demand by lightgbm_tpu/core/native.py with the system g++.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+inline const char* skip_ws(const char* p, const char* end) {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+    return p;
+}
+
+inline const char* next_line(const char* p, const char* end) {
+    while (p < end && *p != '\n') ++p;
+    return p < end ? p + 1 : end;
+}
+
+// a line is blank when it holds only whitespace
+inline bool blank_line(const char* p, const char* end) {
+    for (; p < end && *p != '\n'; ++p) {
+        if (*p != ' ' && *p != '\t' && *p != '\r') return false;
+    }
+    return true;
+}
+
+// index token must be an integer (optional sign + digits); non-numeric
+// prefixes like "qid" are skipped, matching the Python parser
+inline bool all_digits(const char* p, const char* end) {
+    if (p < end && (*p == '+' || *p == '-')) ++p;
+    if (p >= end) return false;
+    for (; p < end; ++p) {
+        if (*p < '0' || *p > '9') return false;
+    }
+    return true;
+}
+
+// parse a float token with the Python float() acceptance rules (the
+// spec): full consumption, no hex literals (strtod accepts 0x..,
+// float() raises).  *ok = false makes the caller fail the whole parse
+// over to the Python parser so its error behavior is preserved.
+inline double parse_float_checked(const char* p, const char* end,
+                                  bool* ok) {
+    if (p >= end) {
+        *ok = false;
+        return 0.0;
+    }
+    for (const char* q = p; q < end; ++q) {
+        if (*q == 'x' || *q == 'X') {
+            *ok = false;
+            return 0.0;
+        }
+    }
+    char* after = nullptr;
+    double v = strtod(p, &after);
+    *ok = (after == end);
+    return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pass 1: rows (non-blank lines) and the max feature index seen.
+// Returns 0, or -1 on a negative feature index (the Python parser
+// writes those into column 0 — fall back to the spec).  Label/value
+// validation happens in the fill pass, which parses them anyway.
+int64_t lgbmtpu_libsvm_scan(const char* buf, int64_t len, int64_t* n_rows,
+                            int64_t* max_idx) {
+    const char* p = buf;
+    const char* end = buf + len;
+    *n_rows = 0;
+    *max_idx = -1;
+    while (p < end) {
+        const char* line_end = p;
+        while (line_end < end && *line_end != '\n') ++line_end;
+        if (!blank_line(p, line_end)) {
+            ++*n_rows;
+            const char* q = skip_ws(p, line_end);
+            // skip the label token (validated by the fill pass)
+            while (q < line_end && *q != ' ' && *q != '\t') ++q;
+            while (q < line_end) {
+                q = skip_ws(q, line_end);
+                if (q >= line_end) break;
+                const char* tok_end = q;
+                const char* colon = nullptr;
+                while (tok_end < line_end && *tok_end != ' '
+                       && *tok_end != '\t') {
+                    if (*tok_end == ':' && colon == nullptr) colon = tok_end;
+                    ++tok_end;
+                }
+                if (colon != nullptr && colon > q
+                    && all_digits(q, colon)) {
+                    int64_t idx = strtoll(q, nullptr, 10);
+                    if (idx < 0) return -1;   // Python writes col 0 here
+                    if (idx > *max_idx) *max_idx = idx;
+                }
+                q = tok_end;
+            }
+        }
+        p = line_end < end ? line_end + 1 : end;
+    }
+    return 0;
+}
+
+// Pass 2: fill out[n_rows, ncols] (row-major, PRE-ZEROED by the caller).
+// Column 0 = label; feature idx lands at column idx + 1; tokens without
+// a ':' (or with a non-integer index, e.g. qid:) are skipped — the
+// Python parser's rules.  Returns rows written, or -1 on a malformed
+// label/value token (caller falls back to the Python parser).
+int64_t lgbmtpu_libsvm_fill(const char* buf, int64_t len, double* out,
+                            int64_t n_rows, int64_t ncols) {
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t row = 0;
+    while (p < end && row < n_rows) {
+        const char* line_end = p;
+        while (line_end < end && *line_end != '\n') ++line_end;
+        if (!blank_line(p, line_end)) {
+            double* r = out + row * ncols;
+            const char* q = skip_ws(p, line_end);
+            const char* lab_end = q;
+            while (lab_end < line_end && *lab_end != ' '
+                   && *lab_end != '\t') ++lab_end;
+            const char* le = lab_end;
+            while (le > q && le[-1] == '\r') --le;
+            bool ok = true;
+            r[0] = parse_float_checked(q, le, &ok);
+            if (!ok) return -1;
+            q = lab_end;
+            while (q < line_end) {
+                q = skip_ws(q, line_end);
+                const char* tok_end = q;
+                const char* colon = nullptr;
+                while (tok_end < line_end && *tok_end != ' '
+                       && *tok_end != '\t') {
+                    if (*tok_end == ':' && colon == nullptr) colon = tok_end;
+                    ++tok_end;
+                }
+                if (colon != nullptr && colon > q
+                    && all_digits(q, colon)) {
+                    int64_t idx = strtoll(q, nullptr, 10);
+                    const char* ve = tok_end;
+                    while (ve > colon + 1 && ve[-1] == '\r') --ve;
+                    double v = parse_float_checked(colon + 1, ve, &ok);
+                    if (!ok) return -1;
+                    if (idx >= 0 && idx + 1 < ncols) {
+                        r[idx + 1] = v;
+                    }
+                }
+                q = tok_end;
+            }
+            ++row;
+        }
+        p = line_end < end ? line_end + 1 : end;
+    }
+    return row;
+}
+
+}  // extern "C"

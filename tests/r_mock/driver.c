@@ -34,6 +34,8 @@ extern SEXP LGBMTPU_BoosterSaveModel_R(SEXP, SEXP, SEXP);
 extern SEXP LGBMTPU_BoosterSaveModelToString_R(SEXP, SEXP);
 extern SEXP LGBMTPU_BoosterLoadModelFromString_R(SEXP);
 extern SEXP LGBMTPU_BoosterGetNumFeature_R(SEXP);
+extern SEXP LGBMTPU_BoosterGetFeatureNames_R(SEXP);
+extern SEXP LGBMTPU_DatasetGetField_R(SEXP, SEXP);
 extern SEXP LGBMTPU_BoosterFeatureImportance_R(SEXP, SEXP, SEXP);
 extern SEXP LGBMTPU_BoosterDumpModel_R(SEXP, SEXP);
 extern SEXP LGBMTPU_BoosterFree_R(SEXP);
@@ -190,6 +192,44 @@ int main(int argc, char** argv) {
     }
   }
   LGBMTPU_BoosterFree_R(bst3);
+
+  /* booster feature names (lgb.interprete's label source) */
+  SEXP bfn = LGBMTPU_BoosterGetFeatureNames_R(bst);
+  if (Rf_length(bfn) != F ||
+      strcmp(CHAR(STRING_ELT(bfn, 0)), CHAR(STRING_ELT(back, 0))) != 0) {
+    fprintf(stderr, "booster feature names mismatch\n");
+    return 1;
+  }
+
+  /* metadata read-back (lgb.Dataset.get.field) */
+  SEXP lab_back = LGBMTPU_DatasetGetField_R(ds, Rf_mkString("label"));
+  if (Rf_length(lab_back) != N) {
+    fprintf(stderr, "label read-back length mismatch\n");
+    return 1;
+  }
+  for (int i = 0; i < N; ++i) {
+    if (fabs(lab_back->reals[i] - y[i]) > 1e-7) {
+      fprintf(stderr, "label read-back value mismatch at %d\n", i);
+      return 1;
+    }
+  }
+
+  /* leaf-index prediction (ptype 2): one index per (row, tree), each a
+   * valid leaf — what lgb.interprete's path walk consumes */
+  SEXP two = Rf_ScalarInteger(2);
+  SEXP leaves = LGBMTPU_BoosterPredictForMat_R(bst, mat, two, all_iters,
+                                               empty);
+  if (Rf_length(leaves) != (R_xlen_t)N * 8) {
+    fprintf(stderr, "predleaf length mismatch: %ld\n",
+            (long)Rf_length(leaves));
+    return 1;
+  }
+  for (long i = 0; i < (long)N * 8; ++i) {
+    if (leaves->reals[i] < 0 || leaves->reals[i] > 1024) {
+      fprintf(stderr, "predleaf out of range at %ld\n", i);
+      return 1;
+    }
+  }
 
   LGBMTPU_BoosterFree_R(bst);
   LGBMTPU_BoosterFree_R(bst2);

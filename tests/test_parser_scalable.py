@@ -124,3 +124,124 @@ def test_file_io_scheme_seam(tmp_path):
 
     with pytest.raises(LightGBMError, match="No file-IO handler"):
         file_io.open_file("hdfs://nn/path.csv")
+
+
+def test_fsspec_backend_round_trip(tmp_path):
+    """A REAL filesystem backend behind the seam (reference ships HDFS,
+    src/io/file_io.cpp:60,99): fsspec's in-memory filesystem plays the
+    remote store, with zero egress.  Covers model save/load and binary
+    dataset save/load through `memory://` URIs end-to-end, plus the
+    unregistered-scheme auto-registration path."""
+    import numpy as np
+    import pytest
+
+    fsspec = pytest.importorskip("fsspec")
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import file_io
+
+    rng = np.random.RandomState(11)
+    X = rng.normal(size=(600, 5))
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 7}, lgb.Dataset(X, y),
+                    num_boost_round=3, verbose_eval=False)
+    pred = bst.predict(X)
+
+    try:
+        # NOT pre-registered: open_file must auto-register via fsspec
+        file_io.unregister_scheme("memory")
+        bst.save_model("memory://bucket/model.txt")
+        bst2 = lgb.Booster(model_file="memory://bucket/model.txt")
+        np.testing.assert_array_equal(pred, bst2.predict(X))
+        assert file_io.exists("memory://bucket/model.txt")
+        assert not file_io.exists("memory://bucket/nope.txt")
+
+        # binary dataset cache through the same transport
+        ds = lgb.Dataset(X, y)
+        ds.construct()
+        ds._handle.save_binary("memory://bucket/train.bin")
+        from lightgbm_tpu.core.dataset import TpuDataset
+        ds2 = TpuDataset.load_binary("memory://bucket/train.bin")
+        assert ds2.num_data == 600
+    finally:
+        file_io.unregister_scheme("memory")
+
+
+def test_native_libsvm_tokenizer_parity(tmp_path):
+    """src/native/textparse.cpp must reproduce the Python LibSVM parser
+    (the spec) exactly — including 0/1-based indices, out-of-order
+    tokens, blank lines, nan values, and skipped qid: prefixes — and be
+    an order of magnitude faster on a ~100k-token file."""
+    import time
+
+    import numpy as np
+    import pytest
+
+    from lightgbm_tpu.core import parser
+    from lightgbm_tpu.core.native import parse_libsvm_native, text_lib
+
+    if text_lib() is None:
+        pytest.skip("no C++ toolchain")
+
+    rng = np.random.RandomState(5)
+    lines = []
+    for i in range(4000):
+        feats = sorted(rng.choice(40, size=rng.randint(1, 12),
+                                  replace=False))
+        toks = [f"{rng.normal():.6g}"]
+        if i % 7 == 0:
+            toks.append(f"qid:{i // 50}")      # skipped by both parsers
+        toks += [f"{f}:{rng.normal():.6g}" for f in feats]
+        if i % 211 == 0:
+            toks.append("5:nan")
+        lines.append(" ".join(toks))
+        if i % 97 == 0:
+            lines.append("")                   # blank lines are dropped
+    text = "\n".join(lines) + "\n"
+
+    expected = parser._parse_libsvm(text.splitlines())
+    got = parse_libsvm_native(text.encode())
+    assert got is not None
+    np.testing.assert_array_equal(
+        np.isnan(expected), np.isnan(got))
+    np.testing.assert_allclose(np.nan_to_num(got),
+                               np.nan_to_num(expected), rtol=0, atol=0)
+
+    # end-to-end through load_file_to_dataset (native path inside)
+    p = tmp_path / "train.libsvm"
+    p.write_text(text)
+    from lightgbm_tpu.config import Config
+    ds = parser.load_file_to_dataset(str(p),
+                                     Config(verbosity=-1,
+                                            min_data_in_leaf=2))
+    assert ds.num_data == expected.shape[0]
+
+    # throughput: the native pass must beat the interpreter loop by >=5x
+    # on a larger buffer (conservative: measured ~30-60x)
+    big = (text * 10).encode()
+    t0 = time.perf_counter()
+    parse_libsvm_native(big)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    parser._parse_libsvm(big.decode().splitlines())
+    t_python = time.perf_counter() - t0
+    assert t_native * 5 < t_python, (t_native, t_python)
+
+
+def test_native_libsvm_rejects_malformed():
+    """Malformed labels/values must NOT silently parse natively — the
+    Python parser is the spec and it raises; the native pass returns
+    None so the caller reaches that behavior."""
+    import pytest
+
+    from lightgbm_tpu.core.native import parse_libsvm_native, text_lib
+
+    if text_lib() is None:
+        pytest.skip("no C++ toolchain")
+    for bad in (b"N/A 1:2.0\n", b"1.0 3:abc\n", b"1.0 3:0x10\n",
+                b"1.0 3:\n", b"1.0 -1:5\n"):
+        assert parse_libsvm_native(bad) is None, bad
+    # and well-formed edge tokens still parse
+    ok = parse_libsvm_native(b"1.0 0:nan 2:1e5\r\n\n-2 1:+.5\n")
+    assert ok is not None and ok.shape == (2, 4)

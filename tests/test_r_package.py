@@ -116,3 +116,81 @@ cat("R smoke OK\\n")
                           capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "R smoke OK" in proc.stdout
+
+
+def _parse_trees_like_r(model_str):
+    """Python mirror of R-package/R/lgb.interprete.R::lgb.model.dt.tree:
+    same text-format fields, same parent reconstruction."""
+    trees = []
+    for block in model_str.split("\nTree=")[1:]:
+        fields = {}
+        for line in block.split("\n"):
+            if "=" in line:
+                k, v = line.split("=", 1)
+                fields[k] = v.split(" ")
+        num_leaves = int(fields["num_leaves"][0])
+        leaf_value = [float(v) for v in fields.get("leaf_value", [0.0])]
+        if num_leaves <= 1:
+            trees.append({"stump": leaf_value[0]})
+            continue
+        t = {
+            "split_feature": [int(v) for v in fields["split_feature"]],
+            "internal_value": [float(v) for v in
+                               fields["internal_value"]],
+            "left_child": [int(v) for v in fields["left_child"]],
+            "right_child": [int(v) for v in fields["right_child"]],
+            "leaf_value": leaf_value,
+        }
+        n_nodes = num_leaves - 1
+        node_parent = [-1] * n_nodes
+        leaf_parent = [-1] * num_leaves
+        for p in range(n_nodes):
+            for child in (t["left_child"][p], t["right_child"][p]):
+                if child >= 0:
+                    node_parent[child] = p
+                else:
+                    leaf_parent[~child] = p
+        t["node_parent"] = node_parent
+        t["leaf_parent"] = leaf_parent
+        trees.append(t)
+    return trees
+
+
+def test_interprete_contract():
+    """The data contract R-package/R/lgb.interprete.R builds on: walking
+    leaf->root through the TEXT model's split_feature/internal_value/
+    child arrays, the per-feature contributions of a row must sum (with
+    the root's expected value) to that row's raw prediction."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(1200, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 15}, lgb.Dataset(X, y),
+                    num_boost_round=8, verbose_eval=False)
+    trees = _parse_trees_like_r(bst.model_to_string())
+    leaves = bst.predict(X[:20], pred_leaf=True).astype(int)
+    raw = bst.predict(X[:20], raw_score=True)
+    for i in range(20):
+        acc = 0.0
+        per_feat = np.zeros(5)
+        for t_idx, t in enumerate(trees):
+            if "stump" in t:
+                acc += t["stump"]
+                continue
+            leaf = leaves[i, t_idx]
+            value = t["leaf_value"][leaf]
+            deltas = np.zeros(5)
+            p = t["leaf_parent"][leaf]
+            while p >= 0:
+                f = t["split_feature"][p]
+                deltas[f] += value - t["internal_value"][p]
+                value = t["internal_value"][p]
+                p = t["node_parent"][p]
+            acc += value + deltas.sum()
+            per_feat += deltas
+        assert abs(acc - raw[i]) < 1e-4, (i, acc, raw[i])
+        assert np.abs(per_feat).sum() > 0

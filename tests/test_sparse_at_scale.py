@@ -1,0 +1,93 @@
+"""Sparse-at-scale evidence (VERDICT r4 item 9).
+
+The declared design: wide-sparse input is ingested host-side from
+CSR/CSC WITHOUT densifying (core/dataset.py:253-277), EFB bundles
+exclusive features into dense columns (core/bundle.py, the reference's
+Dataset::FindGroups path, src/io/dataset.cpp:68-138), and only the
+bundled [G, Npad] matrix ever exists in full — so memory scales with
+bundles, not features.  This file pins that contract at 100k+ features,
+and documents the failure mode when bundling cannot compress.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+sp = pytest.importorskip("scipy.sparse")
+
+
+def _block_onehot(rng, n, blocks, width):
+    """One nonzero per (row, block): the EFB-ideal exclusive profile of
+    one-hot encoded categoricals (the workload EFB was designed for)."""
+    F = blocks * width
+    cols = (np.arange(blocks) * width
+            + rng.randint(0, width, size=(n, blocks))).ravel()
+    rows = np.repeat(np.arange(n), blocks)
+    vals = rng.uniform(1.0, 2.0, size=n * blocks)
+    return sp.csr_matrix((vals, (rows, cols)), shape=(n, F))
+
+
+def test_efb_100k_features_under_memory_bound(rng):
+    """100k features at 0.5% density train end-to-end, with the bundled
+    device matrix bounded by BUNDLES, not features.  Group count is set
+    by the 255-bins-per-group cap of u8 bin storage (core/bundle.py
+    MAX_BINS_PER_GROUP, = the reference's offset-packed u8 bins): ~15
+    bins/feature at max_bin=15 packs ~16 features/group, so ~6k groups
+    — a 17x compression over the naive n*F = 1 GB dense binned
+    matrix, which must stay under 80 MB here."""
+    n, blocks, width = 10_000, 500, 200          # F = 100,000; d = 0.5%
+    X = _block_onehot(rng, n, blocks, width)
+    assert X.shape == (n, 100_000)
+    y = np.asarray(
+        X[:, :width].sum(axis=1) - X[:, width:2 * width].sum(axis=1)
+    ).ravel()
+    yb = (y > np.median(y)).astype(float)
+
+    ds = lgb.Dataset(X, yb, params={"verbose": -1, "max_bin": 15,
+                                    "min_data_in_leaf": 5})
+    ds.construct()
+    h = ds._handle
+    assert h.bundle is not None, "EFB did not engage on 0.5% density"
+    G = len(h.bundle.groups)
+    assert G <= 6500, f"bundling barely compressed: {G} groups"
+    assert h.binned.nbytes <= 80 * 1024 * 1024, h.binned.nbytes
+    # and the model actually learns through the bundled representation
+    # (tiny budget: full-N histograms over ~6k bundled columns are CPU
+    # work here; the claim under test is memory + correctness, not
+    # wall-clock)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 7, "max_bin": 15,
+                     "min_data_in_leaf": 5}, ds,
+                    num_boost_round=4, verbose_eval=False)
+    p = bst.predict(X[:1000])
+    ll = -np.mean(yb[:1000] * np.log(p + 1e-9)
+                  + (1 - yb[:1000]) * np.log(1 - p + 1e-9))
+    assert ll < 0.6915   # strictly below the 0.6931 coin-flip prior
+
+
+def test_efb_incompressible_failure_mode(rng):
+    """When features conflict everywhere (dense random sparsity over the
+    conflict budget), bundling degenerates to singleton groups and the
+    binned matrix scales with F — the DOCUMENTED failure mode: memory is
+    then n*F bytes, exactly the reference's behavior when
+    max_conflict_rate is exhausted (src/io/dataset.cpp:110-130).  The
+    framework must still train correctly, just without compression."""
+    n, F = 2000, 64
+    # ~60% density: every pair of features conflicts on ~36% of rows
+    mask = rng.random(size=(n, F)) < 0.6
+    X = sp.csr_matrix(np.where(mask, rng.normal(size=(n, F)), 0.0))
+    yb = (np.asarray(X[:, 0].todense()).ravel() > 0).astype(float)
+    ds = lgb.Dataset(X, yb, params={"verbose": -1})
+    ds.construct()
+    h = ds._handle
+    groups = h.bundle.groups if h.bundle is not None else None
+    if groups is not None:
+        # no multi-feature bundle should have formed
+        assert max(len(g) for g in groups) <= 2
+    # memory is feature-scaled now — the documented cost of no bundling
+    assert h.binned.nbytes >= n * F * 0.9
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 15}, ds, num_boost_round=5,
+                    verbose_eval=False)
+    assert np.mean((bst.predict(X) > 0.5) == yb) > 0.9

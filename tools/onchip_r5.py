@@ -1,0 +1,118 @@
+"""Round-5 unattended on-chip measurement plan.
+
+Runs from the MAIN tree the moment the backend answers (the round-4
+backend outage spanned the whole previous round; tools/onchip.py is the
+round-4 snapshot variant).  Ordered by value-per-chip-minute:
+
+  0. device probe (exit 3 while the backend is down)
+  1. kernel self-checks on REAL hardware: fused route+histogram and the
+     one-hot scorer must lower and match bit-for-bit (auto-gates flip
+     the fast paths on only if this passes — interpret-green is not
+     lowering-green, ONCHIP_LOG round 4)
+  2. strict + frontier 10.5M probes at current defaults (first numbers
+     ever for: epoch-loop restructure + windowed route + scorer +
+     fused route)
+  3. fused-route OFF A/B (attributes the new kernel's share)
+  4. cold-vs-warm warmup: the same bench tier twice in fresh processes
+     against the persistent compile cache — the north-star math needs
+     warm warmup <= 60 s (VERDICT r4 item 3)
+  5. bench.py (the scoreboard; internally A/Bs growers under the
+     quality guard)
+  6. bench_suite.py (BASELINE configs 2-5, quality-gated)
+  7. bf16 one-hot + ROW_CHUNK=8192 exploration probes
+
+Usage:
+    python tools/onchip_r5.py          # run everything now
+    python tools/onchip_r5.py --wait   # poll until the chip answers
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from onchip import PY, REPO, chip_up, log, run_step, wait_for_chip  # noqa: E402
+
+
+def main():
+    if "--wait" in sys.argv:
+        if not wait_for_chip(max_wait_s=10 * 3600):
+            log("r5 probe: backend never came up; giving up")
+            sys.exit(3)
+        log("r5 probe: backend UP — running plan r5")
+    elif not chip_up():
+        if "--if-up" in sys.argv:
+            print("backend down; skipping (--if-up)")
+            sys.exit(3)
+        log("r5 probe: backend DOWN; proceeding anyway")
+    else:
+        log("r5 probe: backend UP — running plan r5")
+
+    probe = os.path.join(REPO, "tools", "perf_probe.py")
+
+    # 1. on-chip kernel self-checks (the auto-gates run these lazily;
+    # running them eagerly here writes the verdict into the log)
+    run_step("self-checks on chip", [PY, "-c", (
+        "from lightgbm_tpu.ops.pallas_histogram import "
+        "_fused_route_self_check;"
+        "from lightgbm_tpu.ops.pallas_score import scorer_available;"
+        "print('fused_route', _fused_route_self_check());"
+        "print('scorer', scorer_available())")], 1200)
+
+    # 2. headline probes at defaults (fused route auto-enables iff the
+    # self-check above passed)
+    run_step("strict r5 defaults 10.5M", [PY, probe, "10500000,255,1,3"],
+             2400, {"LIGHTGBM_TPU_SEG_STATS": "1"})
+    run_step("frontier r5 defaults 10.5M", [PY, probe, "10500000,255,1,3"],
+             2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_IMPL": "frontier"})
+
+    # 3. fused-route attribution A/B
+    run_step("strict FUSED_ROUTE=0 10.5M", [PY, probe, "10500000,255,1,2"],
+             2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_FUSED_ROUTE": "0"})
+    run_step("frontier FUSED_ROUTE=0 10.5M",
+             [PY, probe, "10500000,255,1,2"], 2400,
+             {"LIGHTGBM_TPU_SEG_STATS": "1",
+              "LIGHTGBM_TPU_IMPL": "frontier",
+              "LIGHTGBM_TPU_FUSED_ROUTE": "0"})
+
+    # 4. cold vs warm warmup through the persistent compile cache: the
+    # SAME child command twice in fresh processes; compare their
+    # "warmup(2)=" stderr lines in the log
+    bench = os.path.join(REPO, "bench.py")
+    for tag in ("cold", "warm"):
+        run_step(f"warmup {tag} 10.5M",
+                 [PY, bench, "--child", "tpu", "10500000", "2", "2"],
+                 2700)
+
+    # 5-6. scoreboards
+    run_step("bench (r5)", [PY, bench], 9000)
+    run_step("bench_suite (r5)", [PY, os.path.join(REPO, "bench_suite.py")],
+             10800)
+
+    # 7. exploration probes
+    run_step("frontier ONEHOT=bf16 10.5M", [PY, probe, "10500000,255,1,2"],
+             2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_IMPL": "frontier",
+                    "LIGHTGBM_TPU_ONEHOT_DTYPE": "bf16"})
+    run_step("frontier ROW_CHUNK=8192 10.5M",
+             [PY, probe, "10500000,255,1,2"], 2400,
+             {"LIGHTGBM_TPU_SEG_STATS": "1",
+              "LIGHTGBM_TPU_IMPL": "frontier",
+              "LIGHTGBM_TPU_ROW_CHUNK": "8192"})
+    run_step("strict WASTE=10 10.5M", [PY, probe, "10500000,255,1,2"],
+             2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_COMPACT_WASTE": "10.0"})
+
+    # 8. if the window is still open, the round-4 snapshot plan
+    # (.onchip_r5 worktree at the round-4 HEAD) attributes the round-4
+    # fixes cleanly; it logs to its own ONCHIP_LOG.md
+    snap = os.path.join(REPO, ".onchip_r5", "tools", "onchip.py")
+    if os.path.exists(snap):
+        run_step("plan 4c snapshot", [PY, snap, "--if-up"], 6 * 3600)
+
+    log("plan r5 complete")
+
+
+if __name__ == "__main__":
+    main()
