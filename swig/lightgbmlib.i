@@ -33,6 +33,10 @@
  * natively, so provide it here: a fixed-width buffer table plus
  * getters/setters, the same facility the reference's interface file
  * ships for its JNI consumer. */
+/* must precede the wrapped declaration: hands the malloc'd model
+ * string's ownership to the target language */
+%newobject LGBM_BoosterSaveModelToStringSWIG;
+
 %inline %{
 #include <stdlib.h>
 #include <string.h>
@@ -91,6 +95,99 @@ static void delete_stringBuffers(StringBuffers* sb) {
   for (i = 0; i < sb->n; ++i) free(sb->arr[i]);
   free(sb->arr);
   free(sb);
+}
+
+/* ---- typed helper battery (the reference interface ships the same
+ * facilities for its JNI/mmlspark consumer, swig/lightgbmlib.i:35-200;
+ * these are language-neutral — no JNIEnv — so every SWIG target gets
+ * them) ---- */
+
+/* Model-to-string with grow-on-short-buffer (the reference's
+ * LGBM_BoosterSaveModelToStringSWIG).  The %newobject directive above
+ * the %inline block hands buffer ownership to the target language, so
+ * there is no manual free to mismatch. */
+static char* LGBM_BoosterSaveModelToStringSWIG(void* handle,
+                                               int start_iteration,
+                                               int num_iteration,
+                                               int64_t buffer_len) {
+  int64_t out_len = 0;
+  char* dst = (char*)malloc((size_t)(buffer_len > 1 ? buffer_len : 1));
+  int result;
+  if (dst == NULL) return NULL;
+  result = LGBM_BoosterSaveModelToString(handle, start_iteration,
+                                         num_iteration, buffer_len,
+                                         &out_len, dst);
+  if (result == 0 && out_len > buffer_len) {
+    free(dst);
+    dst = (char*)malloc((size_t)out_len);
+    if (dst == NULL) return NULL;
+    result = LGBM_BoosterSaveModelToString(handle, start_iteration,
+                                           num_iteration, out_len,
+                                           &out_len, dst);
+  }
+  if (result != 0) { free(dst); return NULL; }
+  return dst;
+}
+
+/* Eval names with internal allocation (the reference's
+ * LGBM_BoosterGetEvalNamesSWIG, minus its trust in the caller's count:
+ * the C API strcpy's every ACTUAL name, so the table is sized from
+ * LGBM_BoosterGetEvalCounts here — a stale caller count cannot
+ * overflow).  Items are read with stringBuffers_getitem and freed with
+ * delete_stringBuffers; the unused parameter keeps the reference's
+ * call shape. */
+static StringBuffers* LGBM_BoosterGetEvalNamesSWIG(void* handle,
+                                                   int eval_counts) {
+  StringBuffers* sb;
+  int count = 0;
+  int got = 0;
+  (void)eval_counts;
+  if (LGBM_BoosterGetEvalCounts(handle, &count) != 0) return NULL;
+  sb = new_stringBuffers(count > 0 ? count : 1, 128);
+  if (sb == NULL) return NULL;
+  if (LGBM_BoosterGetEvalNames(handle, &got, sb->arr) != 0
+      || got > sb->n) {
+    delete_stringBuffers(sb);
+    return NULL;
+  }
+  return sb;
+}
+
+/* Dense single-row predict over a pre-filled doubleArray (the
+ * reference's LGBM_BoosterPredictForMatSingle minus the JNI pinning —
+ * array helpers own the buffer on every SWIG target). */
+static int LGBM_BoosterPredictForMatSingleSWIG(void* handle,
+                                               double* row, int ncol,
+                                               int predict_type,
+                                               int num_iteration,
+                                               const char* parameter,
+                                               int64_t* out_len,
+                                               double* out_result) {
+  return LGBM_BoosterPredictForMatSingleRow(
+      handle, row, C_API_DTYPE_FLOAT64, ncol, 1, predict_type,
+      num_iteration, parameter, out_len, out_result);
+}
+
+/* Sparse single-row predict from (indices, values) pairs: builds the
+ * 2-entry CSR indptr the way the reference's
+ * LGBM_BoosterPredictForCSRSingle does. */
+static int LGBM_BoosterPredictForCSRSingleSWIG(void* handle,
+                                               int* indices,
+                                               double* values,
+                                               int num_nonzeros,
+                                               int64_t num_col,
+                                               int predict_type,
+                                               int num_iteration,
+                                               const char* parameter,
+                                               int64_t* out_len,
+                                               double* out_result) {
+  int32_t ind[2];
+  ind[0] = 0;
+  ind[1] = num_nonzeros;
+  return LGBM_BoosterPredictForCSRSingleRow(
+      handle, ind, C_API_DTYPE_INT32, (const int32_t*)indices, values,
+      C_API_DTYPE_FLOAT64, 2, num_nonzeros, num_col, predict_type,
+      num_iteration, parameter, out_len, out_result);
 }
 %}
 

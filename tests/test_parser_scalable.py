@@ -245,3 +245,4 @@ def test_native_libsvm_rejects_malformed():
     # and well-formed edge tokens still parse
     ok = parse_libsvm_native(b"1.0 0:nan 2:1e5\r\n\n-2 1:+.5\n")
     assert ok is not None and ok.shape == (2, 4)
+

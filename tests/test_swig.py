@@ -138,3 +138,81 @@ def test_swig_round_trip(swig_module, rng, tmp_path):
     assert lib.new_stringBuffers(4, 0) is None
 
     assert lib.LGBM_DatasetFree(ds) == 0
+
+
+def _train_booster(lib, rng, n, f, n_iters):
+    """doubleArray-filled dataset + booster trained through the raw
+    entry points (shared by the round-trip and helper-battery tests)."""
+    X = rng.normal(size=(n, f))
+    y = (X[:, 1] > 0).astype(np.float64)
+    arr = lib.new_doubleArray(n * f)
+    for i, v in enumerate(X.ravel()):
+        lib.doubleArray_setitem(arr, i, float(v))
+    hdl = lib.new_voidpp()
+    assert lib.LGBM_DatasetCreateFromMat(
+        arr, lib.C_API_DTYPE_FLOAT64, n, f, 1,
+        "objective=binary verbosity=-1 min_data_in_leaf=5", None, hdl) == 0
+    ds = lib.voidpp_value(hdl)
+    lab = lib.new_floatArray(n)
+    for i, v in enumerate(y):
+        lib.floatArray_setitem(lab, i, float(v))
+    assert lib.LGBM_DatasetSetField(ds, "label", lab, n,
+                                    lib.C_API_DTYPE_FLOAT32) == 0
+    bh = lib.new_voidpp()
+    assert lib.LGBM_BoosterCreate(
+        ds, "objective=binary verbosity=-1 min_data_in_leaf=5", bh) == 0
+    booster = lib.voidpp_value(bh)
+    fin = lib.new_intp()
+    for _ in range(n_iters):
+        assert lib.LGBM_BoosterUpdateOneIter(booster, fin) == 0
+    return X, y, arr, ds, booster
+
+
+def test_swig_typed_helper_battery(swig_module, rng):
+    """The reference .i's JNI helper battery, language-neutral: grow-on-
+    short-buffer model-to-string, allocating eval names, and dense/CSR
+    single-row predict helpers (reference swig/lightgbmlib.i:35-200)."""
+    lib = swig_module
+    n, f = 300, 4
+    X, y, arr, ds, booster = _train_booster(lib, rng, n, f, 4)
+
+    # model-to-string: a 16-byte initial buffer MUST trigger the grow path
+    s = lib.LGBM_BoosterSaveModelToStringSWIG(booster, 0, -1, 16)
+    assert s is not None and "Tree=0" in s
+
+    cnt = lib.new_intp()
+    assert lib.LGBM_BoosterGetEvalCounts(booster, cnt) == 0
+    names = lib.LGBM_BoosterGetEvalNamesSWIG(booster, lib.intp_value(cnt))
+    assert lib.stringBuffers_getitem(names, 0) == "binary_logloss"
+    lib.delete_stringBuffers(names)
+
+    # single-row dense helper == the full-matrix predict row 0
+    out_len = lib.new_int64_tp()
+    full = lib.new_doubleArray(n)
+    assert lib.LGBM_BoosterPredictForMat(
+        booster, arr, lib.C_API_DTYPE_FLOAT64, n, f, 1,
+        lib.C_API_PREDICT_NORMAL, -1, "", out_len, full) == 0
+    row = lib.new_doubleArray(f)
+    for j in range(f):
+        lib.doubleArray_setitem(row, j, float(X[0, j]))
+    one = lib.new_doubleArray(1)
+    assert lib.LGBM_BoosterPredictForMatSingleSWIG(
+        booster, row, f, lib.C_API_PREDICT_NORMAL, -1, "", out_len,
+        one) == 0
+    assert abs(lib.doubleArray_getitem(one, 0)
+               - lib.doubleArray_getitem(full, 0)) < 1e-12
+
+    # sparse single-row helper: same row as (indices, values) pairs
+    idx = lib.new_intArray(f)
+    vals = lib.new_doubleArray(f)
+    for j in range(f):
+        lib.intArray_setitem(idx, j, j)
+        lib.doubleArray_setitem(vals, j, float(X[0, j]))
+    one2 = lib.new_doubleArray(1)
+    assert lib.LGBM_BoosterPredictForCSRSingleSWIG(
+        booster, idx, vals, f, f, lib.C_API_PREDICT_NORMAL, -1, "",
+        out_len, one2) == 0
+    assert abs(lib.doubleArray_getitem(one2, 0)
+               - lib.doubleArray_getitem(full, 0)) < 1e-12
+    assert lib.LGBM_BoosterFree(booster) == 0
+    assert lib.LGBM_DatasetFree(ds) == 0
