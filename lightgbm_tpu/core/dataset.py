@@ -363,10 +363,30 @@ class TpuDataset:
             self._device_binned = None
             return
         dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+        # native one-pass quantizer for the NUMERICAL columns
+        # (src/native/fastbin.cpp lgbmtpu_quantize_rows*) — the
+        # per-column numpy loop paid 21-43s at 10.5M rows; categorical
+        # columns (dict lookups) stay on the python path
+        from .binning import BIN_TYPE_NUMERICAL
+        from .native import quantize_rows_native
         out = np.empty((data.shape[0], len(used)), dtype=dtype)
+        done = [False] * len(used)
+        if isinstance(data, np.ndarray) and data.ndim == 2:
+            num_pos = [j for j, f in enumerate(used)
+                       if self.bin_mappers[f].bin_type
+                       == BIN_TYPE_NUMERICAL]
+            if num_pos:
+                nat = quantize_rows_native(
+                    data, [used[j] for j in num_pos], self.bin_mappers,
+                    dtype)
+                if nat is not None:
+                    out[:, num_pos] = nat
+                    for j in num_pos:
+                        done[j] = True
         for j, f in enumerate(used):
-            out[:, j] = self.bin_mappers[f].value_to_bin(
-                np.asarray(data[:, f], dtype=np.float64)).astype(dtype)
+            if not done[j]:
+                out[:, j] = self.bin_mappers[f].value_to_bin(
+                    np.asarray(data[:, f], dtype=np.float64)).astype(dtype)
         self.binned = out
         self._device_binned = None
 

@@ -26,8 +26,31 @@ def _source_path() -> str:
     return os.path.join(here, "src", "native", "fastbin.cpp")
 
 
+def _host_tag() -> str:
+    """Short hash of this host's CPU capabilities: -march=native builds
+    are keyed by it, so a checkout shared across heterogeneous hosts
+    (NFS multi-machine training) rebuilds per ISA instead of SIGILLing
+    on a foreign host's vectorized .so."""
+    import hashlib
+    import platform
+    raw = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    raw += line
+                    break
+    except OSError:
+        pass
+    return hashlib.md5(raw.encode()).hexdigest()[:8]
+
+
 def _build(src: str, out: str) -> None:
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", src, "-o", out]
+    # -march=native vectorizes the quantizer's compare-count (the 8.8x
+    # vs -O2); the output filename carries _host_tag() so the cache
+    # never crosses ISAs
+    cmd = ["g++", "-O3", "-march=native", "-fPIC", "-shared",
+           "-std=c++17", src, "-o", out]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(proc.stderr[-500:])
@@ -43,7 +66,8 @@ def lib() -> Optional[ctypes.CDLL]:
     src = _source_path()
     if not os.path.exists(src):
         return None
-    out = os.path.join(os.path.dirname(src), "libfastbin.so")
+    out = os.path.join(os.path.dirname(src),
+                       f"libfastbin.{_host_tag()}.so")
     try:
         if (not os.path.exists(out)
                 or os.path.getmtime(out) < os.path.getmtime(src)):
@@ -101,7 +125,8 @@ def text_lib() -> Optional[ctypes.CDLL]:
     src = os.path.join(os.path.dirname(_source_path()), "textparse.cpp")
     if not os.path.exists(src):
         return None
-    out = os.path.join(os.path.dirname(src), "libtextparse.so")
+    out = os.path.join(os.path.dirname(src),
+                       f"libtextparse.{_host_tag()}.so")
     try:
         if (not os.path.exists(out)
                 or os.path.getmtime(out) < os.path.getmtime(src)):
@@ -143,4 +168,110 @@ def parse_libsvm_native(data: bytes):
         n_rows.value, out.shape[1])
     if filled != n_rows.value:
         return None
+    return out
+
+
+def _bind_quantize(L) -> bool:
+    """Bind the quantizer symbols; False when the loaded .so predates
+    them (stale build cache) — callers fall back to Python."""
+    if getattr(L, "_quantize_bound", None) is not None:
+        return L._quantize_bound
+    try:
+        L.lgbmtpu_quantize_rows
+        L.lgbmtpu_quantize_rows_f32
+    except AttributeError:
+        L._quantize_bound = False
+        return False
+    L.lgbmtpu_quantize_rows.restype = None
+    L.lgbmtpu_quantize_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.c_void_p]
+    L.lgbmtpu_quantize_rows_f32.restype = None
+    L.lgbmtpu_quantize_rows_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_void_p]
+    L._quantize_bound = True
+    return True
+
+
+def quantize_rows_native(data: np.ndarray, feat_idx, mappers,
+                         out_dtype) -> Optional[np.ndarray]:
+    """One native pass quantizing every NUMERICAL used column of a
+    row-major float matrix (core/binning.value_to_bin semantics); None
+    when unavailable or any column is categorical (caller falls back).
+
+    ~10x the per-column numpy path at 10M rows: no strided column
+    copies, bounds stay in cache, and the output is written once.
+    """
+    from .binning import BIN_TYPE_NUMERICAL
+    L = lib()
+    if L is None:
+        return None
+    if data.dtype == np.float32:
+        is_f64 = 0
+    elif data.dtype == np.float64:
+        is_f64 = 1
+    else:
+        return None
+    if any(mappers[f].bin_type != BIN_TYPE_NUMERICAL for f in feat_idx):
+        return None
+    if not _bind_quantize(L):
+        return None
+    # contiguity copy LAST: it is only worth the memory once the native
+    # path is certain to run
+    if not data.flags.c_contiguous:
+        data = np.ascontiguousarray(data)
+    n, f_total = data.shape
+    n_used = len(feat_idx)
+    bounds = []
+    offs = np.zeros(n_used + 1, dtype=np.int64)
+    mt = np.zeros(n_used, dtype=np.int32)
+    nb = np.zeros(n_used, dtype=np.int32)
+    for j, f in enumerate(feat_idx):
+        m = mappers[f]
+        n_search = m.num_bin - (1 if m.missing_type == 2 else 0)
+        ub = np.asarray(m.bin_upper_bound,
+                        dtype=np.float64)[:max(n_search - 1, 0)]
+        bounds.append(ub)
+        offs[j + 1] = offs[j] + len(ub)
+        mt[j] = m.missing_type
+        nb[j] = m.num_bin
+    flat = (np.concatenate(bounds) if bounds
+            else np.zeros(0, np.float64))
+    fidx = np.asarray(feat_idx, dtype=np.int64)
+    out = np.empty((n, n_used), dtype=out_dtype)
+    max_nb = int(np.max(offs[1:] - offs[:-1], initial=0))
+    if is_f64 == 0 and out_dtype == np.uint8 and max_nb <= 128:
+        # f32 fast path with EXACT thresholds: t[b] = smallest float
+        # whose f64 value is > ub[b]; then ub[b] < (double)v  <=>
+        # v >= t[b] because v's f64 image is exact and t[b] is the
+        # least representable value past the bound
+        t = flat.astype(np.float32)
+        not_past = t.astype(np.float64) <= flat
+        t = np.where(not_past, np.nextafter(t, np.float32(np.inf)), t)
+        t = np.ascontiguousarray(t, dtype=np.float32)
+        L.lgbmtpu_quantize_rows_f32(
+            data.ctypes.data_as(ctypes.c_void_p), n, f_total,
+            fidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n_used,
+            t.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            mt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            nb.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out.ctypes.data_as(ctypes.c_void_p))
+        return out
+    L.lgbmtpu_quantize_rows(
+        data.ctypes.data_as(ctypes.c_void_p), is_f64, n, f_total,
+        fidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n_used,
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        mt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        nb.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        1 if out_dtype == np.uint16 else 0,
+        out.ctypes.data_as(ctypes.c_void_p))
     return out

@@ -405,7 +405,25 @@ def _load_two_round(filename: str, sep: str, skip_rows: int, config: Config,
             quantize_bundled(col_bins, ds.bundle, default_bins, k,
                              out=out[off:off + k])
         else:
-            for j in range(len(used)):
+            # native one-pass chunk quantizer for the numerical columns
+            # (fastbin.cpp, same path as TpuDataset._quantize); the
+            # remainder takes the per-column fallback
+            from .binning import BIN_TYPE_NUMERICAL
+            from .native import quantize_rows_native
+            num_pos = [j for j in range(len(used))
+                       if ds.bin_mappers[int(used[j])].bin_type
+                       == BIN_TYPE_NUMERICAL]
+            nat = (quantize_rows_native(feats, [int(used[j])
+                                                for j in num_pos],
+                                        ds.bin_mappers, dtype)
+                   if num_pos else None)
+            if nat is not None:
+                out[off:off + k, num_pos] = nat
+                rest = [j for j in range(len(used)) if j not in
+                        set(num_pos)]
+            else:
+                rest = range(len(used))
+            for j in rest:
                 out[off:off + k, j] = col_bins(j).astype(dtype)
         off += k
     ds.binned = out

@@ -143,7 +143,11 @@ static StringBuffers* LGBM_BoosterGetEvalNamesSWIG(void* handle,
   int got = 0;
   (void)eval_counts;
   if (LGBM_BoosterGetEvalCounts(handle, &count) != 0) return NULL;
-  sb = new_stringBuffers(count > 0 ? count : 1, 128);
+  /* width 256 bounds metric names with headroom: they come from the
+   * fixed metric factory registry (metric/__init__.py), whose longest
+   * name plus @k suffix is far below it — the C API's strcpy has no
+   * length argument, so the registry bound is the real invariant */
+  sb = new_stringBuffers(count > 0 ? count : 1, 256);
   if (sb == NULL) return NULL;
   if (LGBM_BoosterGetEvalNames(handle, &got, sb->arr) != 0
       || got > sb->n) {
