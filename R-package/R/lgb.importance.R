@@ -45,3 +45,24 @@ lgb.dump <- function(booster, num_iteration = -1L) {
   .Call(LGBMTPU_BoosterDumpModel_R, booster$ptr,
         as.integer(num_iteration))
 }
+
+#' Plot feature importance as a horizontal bar chart
+#'
+#' @param tree_imp data.frame from lgb.importance().
+#' @param top_n number of features to show.
+#' @param measure importance column to plot ("Gain" or "Split" — the
+#'   columns lgb.importance produces).
+lgb.plot.importance <- function(tree_imp, top_n = 10L,
+                                measure = "Gain", ...) {
+  if (!measure %in% setdiff(colnames(tree_imp), "Feature")) {
+    stop("measure must be one of ", paste(setdiff(
+      colnames(tree_imp), "Feature"), collapse = ", "))
+  }
+  df <- tree_imp[order(tree_imp[[measure]], decreasing = TRUE), ]
+  df <- utils::head(df, top_n)
+  df <- df[rev(seq_len(nrow(df))), ]
+  graphics::barplot(df[[measure]], names.arg = df$Feature, horiz = TRUE,
+                    las = 1L, main = "Feature importance",
+                    xlab = measure, ...)
+  invisible(df)
+}

@@ -46,9 +46,11 @@ TIERS = {
                        ("cpu", 10_000, 1, 2, 900)],
     "lambdarank_msltr": [("tpu", 2_270_000, 2, 4, 2700),
                          ("cpu", 20_000, 1, 2, 900)],
-    # the mesh is virtual CPU devices either way; no TPU tier
-    "feature_parallel": [("cpu-mesh", 200_000, 2, 4, 2400),
-                         ("cpu-mesh", 20_000, 1, 2, 900)],
+    # the mesh is 8 VIRTUAL CPU devices sharing one host core, so this
+    # config is a correctness/liveness gate (serial parity), not a
+    # timing claim — tiers stay tiny and the record says virtual_mesh
+    "feature_parallel": [("cpu-mesh", 20_000, 1, 2, 1800),
+                         ("cpu-mesh", 5_000, 1, 2, 900)],
 }
 
 # published reference wall-clocks for vs_baseline (500 iters, CPU,
@@ -81,7 +83,10 @@ def _gen_multiclass(rng, n):
         X[:, 0] + (cats[:, 0] % 5 == k) * 1.5
         + 0.5 * X[:, k % 4] * (1 if k % 2 else -1)
         for k in range(5)], axis=1)
-    y = np.argmax(logits + rng.gumbel(size=(n, 5)), axis=1)
+    # 2x logit scale keeps Bayes error low enough that the 25-iteration
+    # quality gate separates a working learner from a broken one
+    # (calibrated: 0.78 at 25 iters vs ln(5)=1.609 untrained)
+    y = np.argmax(2.0 * logits + rng.gumbel(size=(n, 5)), axis=1)
     return X, y.astype(np.float64), {
         "categorical_feature": list(range(20, 28)),
         "params": {"objective": "multiclass", "num_class": 5},
@@ -288,6 +293,8 @@ def run_config(config: str, probe_ok: bool) -> dict | None:
                     out["vs_baseline"] = round(total / scaled, 3)
                 if r["backend"] == "cpu" and platform == "tpu":
                     out["fallback"] = True
+                if platform == "cpu-mesh":
+                    out["virtual_mesh"] = True
                 if platform.startswith("cpu") and "tpu" in (
                         t[0] for t in TIERS[config]):
                     out["fallback"] = True

@@ -166,8 +166,17 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
     # bf16, so `bf16` may halve the compare cost; i32 is the default
     # until the on-chip A/B lands.
     import os as _os
-    cmp_dtype = {"u8": jnp.uint8, "bf16": jnp.bfloat16}.get(
-        _os.environ.get("LIGHTGBM_TPU_ONEHOT_DTYPE", ""), jnp.int32)
+    _env = _os.environ.get("LIGHTGBM_TPU_ONEHOT_DTYPE", "")
+    if _env == "u8":
+        # u8 iota fails to lower on Mosaic (16/32-bit iota only,
+        # ONCHIP_LOG round 4) — route to the working 2-values/lane mode
+        # instead of crashing deep in kernel compilation
+        from ..utils.log import log_warning
+        log_warning("LIGHTGBM_TPU_ONEHOT_DTYPE=u8 does not lower on "
+                    "this backend; using i16")
+        _env = "i16"
+    cmp_dtype = {"bf16": jnp.bfloat16, "i16": jnp.int16}.get(
+        _env, jnp.int32)
 
     def one_chunk(c, carry):
         wc = wfn(c, chunk)                                  # [8, chunk]

@@ -95,6 +95,10 @@ def main():
              2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
                     "LIGHTGBM_TPU_IMPL": "frontier",
                     "LIGHTGBM_TPU_ONEHOT_DTYPE": "bf16"})
+    run_step("frontier ONEHOT=i16 10.5M", [PY, probe, "10500000,255,1,2"],
+             2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_IMPL": "frontier",
+                    "LIGHTGBM_TPU_ONEHOT_DTYPE": "i16"})
     run_step("frontier ROW_CHUNK=8192 10.5M",
              [PY, probe, "10500000,255,1,2"], 2400,
              {"LIGHTGBM_TPU_SEG_STATS": "1",
