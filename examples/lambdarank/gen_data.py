@@ -1,0 +1,23 @@
+"""Synthetic rank.train/.test + .query files (the reference's lambdarank
+example layout: LibSVM-ish label-first rows + one query-size per line)."""
+import numpy as np
+
+rng = np.random.RandomState(42)
+for name, n_q in (("rank.train", 200), ("rank.test", 40)):
+    rows = []
+    sizes = []
+    for _ in range(n_q):
+        s = int(rng.randint(10, 30))
+        sizes.append(s)
+        X = rng.normal(size=(s, 30))
+        score = X[:, 0] + 0.5 * X[:, 1] + rng.normal(size=s) * 0.5
+        order = np.argsort(np.argsort(score))
+        y = np.minimum(4, (5 * order) // s)
+        for i in range(s):
+            feats = " ".join(f"{j + 1}:{X[i, j]:.5g}" for j in range(30))
+            rows.append(f"{int(y[i])} {feats}")
+    with open(name, "w") as fh:
+        fh.write("\n".join(rows) + "\n")
+    with open(name + ".query", "w") as fh:
+        fh.write("\n".join(str(s) for s in sizes) + "\n")
+print("wrote rank.train rank.test (+ .query)")

@@ -1,0 +1,10 @@
+"""Synthetic multiclass.train/.test (label-first TSV, 5 classes)."""
+import numpy as np
+
+rng = np.random.RandomState(42)
+centers = rng.normal(size=(5, 20)) * 2
+for name, n in (("multiclass.train", 7000), ("multiclass.test", 500)):
+    X = rng.normal(size=(n, 20))
+    y = np.argmax(X @ centers.T + rng.normal(size=(n, 5)), axis=1)
+    np.savetxt(name, np.column_stack([y, X]), fmt="%.6g", delimiter="\t")
+print("wrote multiclass.train multiclass.test")

@@ -1,0 +1,10 @@
+"""Same data as binary_classification; the point here is the learner."""
+import numpy as np
+
+rng = np.random.RandomState(42)
+for name, n in (("binary.train", 7000), ("binary.test", 500)):
+    X = rng.normal(size=(n, 28))
+    logit = 2 * X[:, 0] + X[:, 1] - X[:, 2] * X[:, 3]
+    y = (logit + rng.normal(size=n) > 0).astype(int)
+    np.savetxt(name, np.column_stack([y, X]), fmt="%.6g", delimiter="\t")
+print("wrote binary.train binary.test")

@@ -1,0 +1,21 @@
+"""CV + sklearn wrapper walk (the reference python-guide's
+sklearn_example.py + advanced bits, condensed)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(7)
+X = rng.normal(size=(4000, 8))
+y = 2 * X[:, 0] - X[:, 1] ** 2 + 0.1 * rng.normal(size=4000)
+
+print("5-fold CV...")
+res = lgb.cv({"objective": "regression", "metric": "l2", "verbose": -1},
+             lgb.Dataset(X, y), num_boost_round=30, nfold=5)
+key = [k for k in res if k.endswith("-mean")][0]
+print(f"CV {key}: {res[key][-1]:.5f}")
+
+print("sklearn API...")
+est = lgb.LGBMRegressor(n_estimators=30, num_leaves=31)
+est.fit(X, y)
+print("R^2-ish corr:",
+      float(np.corrcoef(est.predict(X), y)[0, 1].round(4)))

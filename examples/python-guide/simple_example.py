@@ -1,0 +1,24 @@
+"""The reference's python-guide/simple_example.py, on lightgbm_tpu."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(42)
+X = rng.normal(size=(5000, 10))
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+X_test, y_test = X[4000:], y[4000:]
+lgb_train = lgb.Dataset(X[:4000], y[:4000])
+lgb_eval = lgb_train.create_valid(X_test, y_test)
+
+params = {"boosting_type": "gbdt", "objective": "binary",
+          "metric": ["binary_logloss", "auc"], "num_leaves": 31,
+          "learning_rate": 0.05, "verbose": 0}
+print("Starting training...")
+gbm = lgb.train(params, lgb_train, num_boost_round=20,
+                valid_sets=[lgb_eval], early_stopping_rounds=5)
+print("Saving model...")
+gbm.save_model("model.txt")
+print("Starting predicting...")
+y_pred = gbm.predict(X_test, num_iteration=gbm.best_iteration)
+acc = float(np.mean((y_pred > 0.5) == y_test))
+print(f"Accuracy of prediction: {acc:.4f}")

@@ -1,0 +1,10 @@
+"""Synthetic regression.train/.test (7000/500 x 28, label first, TSV)."""
+import numpy as np
+
+rng = np.random.RandomState(42)
+for name, n in (("regression.train", 7000), ("regression.test", 500)):
+    X = rng.normal(size=(n, 28))
+    y = 2 * X[:, 0] - X[:, 1] ** 2 + np.sin(3 * X[:, 2]) \
+        + 0.2 * rng.normal(size=n)
+    np.savetxt(name, np.column_stack([y, X]), fmt="%.6g", delimiter="\t")
+print("wrote regression.train regression.test")

@@ -1,0 +1,62 @@
+"""Every shipped example must run end-to-end: gen_data -> train.conf ->
+predict (the reference's examples/ are its de-facto acceptance suite)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    from lightgbm_tpu.utils import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    return env
+
+
+@pytest.mark.parametrize("example,data", [
+    ("binary_classification", "binary.test"),
+    ("regression", "regression.test"),
+    ("lambdarank", "rank.test"),
+    ("multiclass_classification", "multiclass.test"),
+    ("parallel_learning", "binary.test"),
+])
+def test_conf_example(example, data, tmp_path):
+    src = os.path.join(REPO, "examples", example)
+    work = tmp_path / example
+    import shutil
+    shutil.copytree(src, work)
+    env = _env()
+    r = subprocess.run([sys.executable, "gen_data.py"], cwd=work, env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-500:]
+    r = subprocess.run([sys.executable, "-m", "lightgbm_tpu",
+                        "train.conf", "num_trees=8"], cwd=work, env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, (r.stderr[-800:], r.stdout[-400:])
+    assert (work / "LightGBM_model.txt").exists()
+    r = subprocess.run([sys.executable, "-m", "lightgbm_tpu",
+                        "task=predict", f"data={data}",
+                        "input_model=LightGBM_model.txt",
+                        "output_result=pred.txt"], cwd=work, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert (work / "pred.txt").exists()
+
+
+@pytest.mark.parametrize("script", ["simple_example.py",
+                                    "cross_validation.py"])
+def test_python_guide(script, tmp_path):
+    src = os.path.join(REPO, "examples", "python-guide", script)
+    env = _env()
+    r = subprocess.run([sys.executable, src], cwd=tmp_path, env=env,
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, (r.stderr[-800:], r.stdout[-400:])

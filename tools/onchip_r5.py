@@ -9,17 +9,18 @@ round-4 snapshot variant).  Ordered by value-per-chip-minute:
      one-hot scorer must lower and match bit-for-bit (auto-gates flip
      the fast paths on only if this passes — interpret-green is not
      lowering-green, ONCHIP_LOG round 4)
-  2. strict + frontier 10.5M probes at current defaults (first numbers
+  2. bench.py FIRST (the scoreboard; internally A/Bs growers under the
+     quality guard) — a short chip window must capture this above all;
+     its children also record the COLD warmup_s in their JSON
+  3. strict + frontier 10.5M probes at current defaults (first numbers
      ever for: epoch-loop restructure + windowed route + scorer +
      fused route)
-  3. fused-route OFF A/B (attributes the new kernel's share)
-  4. cold-vs-warm warmup: the same bench tier twice in fresh processes
-     against the persistent compile cache — the north-star math needs
-     warm warmup <= 60 s (VERDICT r4 item 3)
-  5. bench.py (the scoreboard; internally A/Bs growers under the
-     quality guard)
+  4. fused-route OFF A/B (attributes the new kernel's share)
+  5. ONE warm rerun of the bench child: its warmup_s against step 2's
+     cold number is the persistent-cache verdict (VERDICT r4 item 3
+     needs warm <= 60 s)
   6. bench_suite.py (BASELINE configs 2-5, quality-gated)
-  7. bf16 one-hot + ROW_CHUNK=8192 exploration probes
+  7. bf16/i16 one-hot + ROW_CHUNK=8192 exploration probes
 
 Usage:
     python tools/onchip_r5.py          # run everything now
@@ -58,7 +59,12 @@ def main():
         "print('fused_route', _fused_route_self_check());"
         "print('scorer', scorer_available())")], 1200)
 
-    # 2. headline probes at defaults (fused route auto-enables iff the
+    # 2. THE SCOREBOARD FIRST: if the window is short, bench.py's
+    # strict/frontier A/B is the artifact the round is judged on
+    bench = os.path.join(REPO, "bench.py")
+    run_step("bench (r5, first)", [PY, bench], 9000)
+
+    # 3. headline probes at defaults (fused route auto-enables iff the
     # self-check above passed)
     run_step("strict r5 defaults 10.5M", [PY, probe, "10500000,255,1,3"],
              2400, {"LIGHTGBM_TPU_SEG_STATS": "1"})
@@ -66,7 +72,7 @@ def main():
              2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
                     "LIGHTGBM_TPU_IMPL": "frontier"})
 
-    # 3. fused-route attribution A/B
+    # 4. fused-route attribution A/B
     run_step("strict FUSED_ROUTE=0 10.5M", [PY, probe, "10500000,255,1,2"],
              2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
                     "LIGHTGBM_TPU_FUSED_ROUTE": "0"})
@@ -76,17 +82,14 @@ def main():
               "LIGHTGBM_TPU_IMPL": "frontier",
               "LIGHTGBM_TPU_FUSED_ROUTE": "0"})
 
-    # 4. cold vs warm warmup through the persistent compile cache: the
-    # SAME child command twice in fresh processes; compare their
-    # "warmup(2)=" stderr lines in the log
-    bench = os.path.join(REPO, "bench.py")
-    for tag in ("cold", "warm"):
-        run_step(f"warmup {tag} 10.5M",
-                 [PY, bench, "--child", "tpu", "10500000", "2", "2"],
-                 2700)
+    # 5. one WARM bench child: step 2's children logged the COLD
+    # warmup_s before the cache had these shapes; this fresh process
+    # re-reads them through the persistent cache — the pair is the
+    # cold-vs-warm verdict
+    run_step("warmup warm 10.5M",
+             [PY, bench, "--child", "tpu", "10500000", "2", "2"], 2700)
 
-    # 5-6. scoreboards
-    run_step("bench (r5)", [PY, bench], 9000)
+    # 6. suite scoreboard
     run_step("bench_suite (r5)", [PY, os.path.join(REPO, "bench_suite.py")],
              10800)
 
