@@ -172,11 +172,31 @@ class Dataset:
                         cat_idx.append(feature_names.index(c))
                 else:
                     cat_idx.append(int(c))
-        elif (self.categorical_feature == "auto"
-              and hasattr(self.data, "dtypes")):
-            for i, dt in enumerate(self.data.dtypes):
-                if str(dt) == "category":
-                    cat_idx.append(i)
+        elif self.categorical_feature == "auto":
+            # params-level spec first: categorical_feature /
+            # categorical_column aliases in the conf dialect (the path
+            # the reference resolves in its C++ Config; its own test
+            # suite sets 'categorical_column': 0 this way).  A params
+            # LIST str()-ifies through Config, so strip brackets too.
+            spec = str(cfg.categorical_feature or "").strip("[]() ")
+            for tok in spec.split(","):
+                tok = tok.strip()
+                if not tok:
+                    continue
+                name = tok[5:] if tok.startswith("name:") else tok
+                if feature_names and name in feature_names:
+                    cat_idx.append(feature_names.index(name))
+                else:
+                    try:
+                        cat_idx.append(int(name))
+                    except ValueError:
+                        raise LightGBMError(
+                            f"categorical_feature entry {tok!r} is "
+                            f"neither a column index nor a feature name")
+            if hasattr(self.data, "dtypes"):
+                for i, dt in enumerate(self.data.dtypes):
+                    if str(dt) == "category" and i not in cat_idx:
+                        cat_idx.append(i)
         ref_handle = None
         if self.reference is not None:
             ref_handle = self.reference.construct()._handle

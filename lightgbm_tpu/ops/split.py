@@ -303,11 +303,15 @@ def _categorical_sorted_candidates(hist, parent, fmeta: FeatureMeta,
     left_size = jnp.where(jnp.arange(2)[None, None, :] == 0,
                           k_idx + 1, num_valid - k_idx)
     valid = fmeta.is_cat[:, None, None] & sorted_valid[:, :, None]
-    # a strict non-empty subset; the moved set is capped at
-    # min(max_cat_threshold, (used_bin+1)/2) categories
-    # (feature_histogram.hpp:192: max_num_cat)
+    # the moved set is capped at min(max_cat_threshold, (used_bin+1)/2)
+    # categories (feature_histogram.hpp:192: max_num_cat).  Taking EVERY
+    # usable category left is legal — rows in unlisted bins (the NaN
+    # category, zero-count bins) still route right, so validity is
+    # gated on DATA counts like the reference's scan, not on a strict
+    # category subset (its test_categorical_handle_na isolates {0} left
+    # with the NaN rows falling right by default).
     max_num_cat = jnp.minimum(int(p.max_cat_threshold), (num_valid + 1) // 2)
-    valid &= (left_size >= 1) & (left_size < num_valid)
+    valid &= (left_size >= 1) & (Cl > 0) & (Cr > 0)
     valid &= left_size <= max_num_cat
     valid &= (Cl >= p.min_data_in_leaf) & (Cr >= p.min_data_in_leaf)
     # the right (unmoved) side must keep at least min_data_per_group rows

@@ -1,0 +1,130 @@
+"""Missing-value and categorical handling at the REFERENCE suite's own
+crafted configs (tests/python_package_test/test_engine.py:103-296): tiny
+hand-built datasets where correct missing routing / categorical splits
+must reach near-perfect fit in one or twenty rounds.  These pin the
+missing_type machinery (MISSING_NAN / MISSING_ZERO / use_missing=false)
+and one-hot categorical splits functionally, far tighter than the
+statistical engine gates.
+"""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _auc(y, p):
+    order = np.argsort(p, kind="stable")
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    uniq, inv, cnt = np.unique(p, return_inverse=True, return_counts=True)
+    rs = np.zeros(len(uniq))
+    np.add.at(rs, inv, ranks)
+    ranks = (rs / cnt)[inv]
+    pos = float(np.sum(y))
+    neg = len(y) - pos
+    return (ranks[y > 0.5].sum() - pos * (pos + 1) / 2) / max(pos * neg, 1)
+
+
+def test_missing_value_handle(rng):
+    """reference :103-126 — all-zero feature with NaN marking the
+    positives: 20 rounds must reach l2 < 0.005."""
+    X = np.zeros((1000, 1))
+    y = np.zeros(1000)
+    trues = rng.choice(1000, size=200, replace=False)
+    X[trues, 0] = np.nan
+    y[trues] = 1
+    bst = lgb.train({"metric": "l2", "verbose": -1,
+                     "boost_from_average": False},
+                    lgb.Dataset(X, y), num_boost_round=20,
+                    verbose_eval=False)
+    ret = float(np.mean((bst.predict(X) - y) ** 2))
+    assert ret < 0.005, ret
+
+
+def test_missing_value_handle_na():
+    """reference :128-158 — NaN joins the positive side in ONE round."""
+    x = np.array([0, 1, 2, 3, 4, 5, 6, 7, np.nan]).reshape(-1, 1)
+    y = np.array([1, 1, 1, 1, 0, 0, 0, 0, 1.0])
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "boost_from_average": False, "min_data": 1,
+                     "num_leaves": 2, "learning_rate": 1,
+                     "min_data_in_bin": 1, "zero_as_missing": False},
+                    lgb.Dataset(x, y), num_boost_round=1,
+                    verbose_eval=False)
+    pred = bst.predict(x)
+    np.testing.assert_allclose(pred, y)
+    assert _auc(y, pred) > 0.999
+
+
+def test_missing_value_handle_zero():
+    """reference :160-190 — zero_as_missing: 0 AND NaN route together."""
+    x = np.array([0, 1, 2, 3, 4, 5, 6, 7, np.nan]).reshape(-1, 1)
+    y = np.array([0, 1, 1, 1, 0, 0, 0, 0, 0.0])
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "boost_from_average": False, "min_data": 1,
+                     "num_leaves": 2, "learning_rate": 1,
+                     "min_data_in_bin": 1, "zero_as_missing": True},
+                    lgb.Dataset(x, y), num_boost_round=1,
+                    verbose_eval=False)
+    pred = bst.predict(x)
+    np.testing.assert_allclose(pred, y)
+    assert _auc(y, pred) > 0.999
+
+
+def test_missing_value_handle_none():
+    """reference :192-224 — use_missing=false: NaN quantizes like 0, so
+    rows 0 and NaN must predict identically and AUC only reaches ~0.83."""
+    x = np.array([0, 1, 2, 3, 4, 5, 6, 7, np.nan]).reshape(-1, 1)
+    y = np.array([0, 1, 1, 1, 0, 0, 0, 0, 0.0])
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "boost_from_average": False, "min_data": 1,
+                     "num_leaves": 2, "learning_rate": 1,
+                     "min_data_in_bin": 1, "use_missing": False},
+                    lgb.Dataset(x, y), num_boost_round=1,
+                    verbose_eval=False)
+    pred = bst.predict(x)
+    assert pred[0] == pytest_approx(pred[1])
+    assert pred[-1] == pytest_approx(pred[0])
+    assert _auc(y, pred) > 0.83
+
+
+def pytest_approx(v, eps=1e-5):
+    import pytest
+    return pytest.approx(v, abs=eps)
+
+
+def test_categorical_handle():
+    """reference :225-261 — 8 one-hot categories fit odd/even exactly in
+    one round (max_cat_to_onehot=1 forces sorted-subset splits)."""
+    x = np.arange(8, dtype=np.float64).reshape(-1, 1)
+    y = np.array([0, 1, 0, 1, 0, 1, 0, 1.0])
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "boost_from_average": False, "min_data": 1,
+                     "num_leaves": 2, "learning_rate": 1,
+                     "min_data_in_bin": 1, "min_data_per_group": 1,
+                     "cat_smooth": 1, "cat_l2": 0,
+                     "max_cat_to_onehot": 1, "zero_as_missing": True,
+                     "categorical_column": 0},
+                    lgb.Dataset(x, y), num_boost_round=1,
+                    verbose_eval=False)
+    pred = bst.predict(x)
+    np.testing.assert_allclose(pred, y)
+    assert _auc(y, pred) > 0.999
+
+
+def test_categorical_handle_na():
+    """reference :262-296 — NaN as its own category."""
+    x = np.array([0, np.nan, 0, np.nan, 0, np.nan]).reshape(-1, 1)
+    y = np.array([0, 1, 0, 1, 0, 1.0])
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "boost_from_average": False, "min_data": 1,
+                     "num_leaves": 2, "learning_rate": 1,
+                     "min_data_in_bin": 1, "min_data_per_group": 1,
+                     "cat_smooth": 1, "cat_l2": 0,
+                     "max_cat_to_onehot": 1, "zero_as_missing": False,
+                     "categorical_column": 0},
+                    lgb.Dataset(x, y), num_boost_round=1,
+                    verbose_eval=False)
+    pred = bst.predict(x)
+    np.testing.assert_allclose(pred, y)
+    assert _auc(y, pred) > 0.999
