@@ -343,6 +343,7 @@ class Booster:
         self.best_iteration = -1
         self.best_score: Dict = {}
         self._valid_names: List[str] = []
+        self._valid_sets: List["Dataset"] = []
         if train_set is not None:
             check(isinstance(train_set, Dataset),
                   "Training data should be a Dataset instance")
@@ -396,6 +397,7 @@ class Booster:
         data.construct()
         self.gbdt.add_valid_data(name, data._handle)
         self._valid_names.append(name)
+        self._valid_sets.append(data)
         self._setup_metrics()
         return self
 
@@ -437,12 +439,21 @@ class Booster:
         return self.gbdt.num_tree_per_iteration
 
     # ---------------------------------------------------------------- eval
+    def _feval_preds(self, score) -> np.ndarray:
+        """What feval receives: objective-TRANSFORMED predictions (the
+        reference's GetPredictAt applies ConvertOutput for built-in
+        objectives; raw margins only without one), class-major flat."""
+        score = np.asarray(score)
+        if self.objective is not None:
+            score = np.asarray(self.objective.convert_output(score))
+        return score.ravel()
+
     def eval_train(self, feval=None) -> List:
         out = [("training", name, val, hb)
                for name, val, hb in self.gbdt.eval_train()]
         if feval is not None:
-            score = np.asarray(self.gbdt.train_score).ravel()
-            name, val, hb = feval(score, self.train_set)
+            name, val, hb = feval(self._feval_preds(self.gbdt.train_score),
+                                  self.train_set)
             out.append(("training", name, val, hb))
         return out
 
@@ -451,6 +462,13 @@ class Booster:
         for i, name in enumerate(self._valid_names):
             out.extend([(name, mname, val, hb)
                         for mname, val, hb in self.gbdt.eval_valid(i)])
+            if feval is not None and i < len(self._valid_sets):
+                # custom metric on objective-transformed valid scores,
+                # same contract as eval_train
+                mname, val, hb = feval(
+                    self._feval_preds(self.gbdt.valid_scores[i]),
+                    self._valid_sets[i])
+                out.append((name, mname, val, hb))
         return out
 
     # ------------------------------------------------------------- predict
@@ -556,6 +574,7 @@ class Booster:
         self.best_iteration = state.get("best_iteration", -1)
         self.best_score = state.get("best_score", {})
         self._valid_names = []
+        self._valid_sets = []
         model_str, self.pandas_categorical = \
             _split_pandas_categorical(state["model_str"])
         self.gbdt, self.config, self.objective = load_model(model_str)

@@ -163,7 +163,10 @@ class BinaryLoglossMetric(Metric):
 
     def eval(self, score, objective=None):
         p = np.clip(_convert(score, objective), 1e-15, 1 - 1e-15)
-        loss = -(self.label * np.log(p) + (1 - self.label) * np.log(1 - p))
+        # positive <=> label > 0, the reference's is_pos rule
+        # (binary objective/metric accept any labels)
+        y = (self.label > 0).astype(np.float64)
+        loss = -(y * np.log(p) + (1 - y) * np.log(1 - p))
         return self._avg(loss)
 
 
@@ -173,7 +176,8 @@ class BinaryErrorMetric(Metric):
     def eval(self, score, objective=None):
         p = _convert(score, objective)
         pred = (p > 0.5).astype(np.float64)
-        return self._avg((pred != self.label).astype(np.float64))
+        y = (self.label > 0).astype(np.float64)
+        return self._avg((pred != y).astype(np.float64))
 
 
 class AUCMetric(Metric):
@@ -238,7 +242,10 @@ class CrossEntropyMetric(Metric):
 
     def eval(self, score, objective=None):
         p = np.clip(_convert(score, objective), 1e-15, 1 - 1e-15)
-        loss = -(self.label * np.log(p) + (1 - self.label) * np.log(1 - p))
+        # positive <=> label > 0, the reference's is_pos rule
+        # (binary objective/metric accept any labels)
+        y = (self.label > 0).astype(np.float64)
+        loss = -(y * np.log(p) + (1 - y) * np.log(1 - p))
         return self._avg(loss)
 
 

@@ -25,11 +25,11 @@ class BinaryLogloss(ObjectiveFunction):
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
         lab = self.label_np
-        vals = np.unique(lab)
-        if not np.all(np.isin(vals, [0, 1])):
-            raise ValueError("binary objective requires 0/1 labels")
-        cnt_pos = int((lab == 1).sum())
-        cnt_neg = int((lab == 0).sum())
+        # the reference accepts ANY labels: positive <=> label > 0
+        # (binary_objective.hpp:35 is_pos default)
+        is_pos = lab > 0
+        cnt_pos = int(is_pos.sum())
+        cnt_neg = int(self.num_data - cnt_pos)
         if cnt_neg == 0 or cnt_pos == 0:
             log_info("Contains only one class")
         # is_unbalance: weight each class by the other's frequency
@@ -42,11 +42,11 @@ class BinaryLogloss(ObjectiveFunction):
         else:
             self.label_weights = (1.0, float(self.config.scale_pos_weight))
         self.cnt_pos, self.cnt_neg = cnt_pos, cnt_neg
-        self.sign_label = jnp.asarray(np.where(lab == 1, 1.0, -1.0),
+        self.sign_label = jnp.asarray(np.where(is_pos, 1.0, -1.0),
                                       dtype=jnp.float32)
         w_pos, w_neg = self.label_weights[1], self.label_weights[0]
         self.label_weight_arr = jnp.asarray(
-            np.where(lab == 1, w_pos, w_neg), dtype=jnp.float32)
+            np.where(is_pos, w_pos, w_neg), dtype=jnp.float32)
 
     def get_gradients(self, score):
         s = self.sigmoid
@@ -61,7 +61,7 @@ class BinaryLogloss(ObjectiveFunction):
         """log-odds of the (weighted) positive rate / sigmoid
         (binary_objective.hpp:131-150)."""
         if self.weights_np is not None:
-            suml = float(np.sum((self.label_np == 1) * self.weights_np))
+            suml = float(np.sum((self.label_np > 0) * self.weights_np))
             sumw = float(np.sum(self.weights_np))
         else:
             suml = float(self.cnt_pos)

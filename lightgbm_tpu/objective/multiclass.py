@@ -54,7 +54,9 @@ class MulticlassSoftmax(ObjectiveFunction):
         return grad, hess
 
     def boost_from_score(self, class_id=0):
-        return 0.0
+        """log of the class prior (multiclass_objective.hpp:150-152) —
+        softmax of the inits reproduces the priors exactly."""
+        return float(np.log(max(1e-15, self.class_init_probs[class_id])))
 
     def convert_output(self, score):
         """Softmax over classes; score [C, N] or [N, C]."""

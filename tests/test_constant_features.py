@@ -1,0 +1,80 @@
+"""Constant-feature datasets must predict the objective's base rate
+exactly (reference test_engine.py:992-1040): with no splittable
+feature, two boosting rounds leave the model at boost_from_average's
+init score, and each objective transforms it to the label mean / class
+priors.  Pins BoostFromScore + the no-split early-exit path per
+objective family.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _check(y_true, expected_pred, more_params):
+    X = np.ones((len(y_true), 1))
+    params = {"objective": "regression", "num_class": 1, "verbose": -1,
+              "min_data": 1, "num_leaves": 2, "learning_rate": 1,
+              "min_data_in_bin": 1, "boost_from_average": True}
+    params.update(more_params)
+    bst = lgb.train(params, lgb.Dataset(X, np.array(y_true),
+                                        params=params),
+                    num_boost_round=2, verbose_eval=False)
+    pred = bst.predict(X)
+    assert np.allclose(pred, expected_pred, rtol=1e-5, atol=1e-6), \
+        (pred, expected_pred)
+
+
+def test_constant_features_regression():
+    params = {"objective": "regression"}
+    _check([0.0, 10.0, 0.0, 10.0], 5.0, params)
+    _check([0.0, 1.0, 2.0, 3.0], 1.5, params)
+    _check([-1.0, 1.0, -2.0, 2.0], 0.0, params)
+
+
+def test_constant_features_binary():
+    params = {"objective": "binary"}
+    _check([0.0, 10.0, 0.0, 10.0], 0.5, params)
+    _check([0.0, 1.0, 2.0, 3.0], 0.75, params)
+
+
+def test_constant_features_multiclass():
+    params = {"objective": "multiclass", "num_class": 3}
+    _check([0.0, 1.0, 2.0, 0.0], [0.5, 0.25, 0.25], params)
+    _check([0.0, 1.0, 2.0, 1.0], [0.25, 0.5, 0.25], params)
+
+
+def test_constant_features_multiclassova():
+    params = {"objective": "multiclassova", "num_class": 3}
+    _check([0.0, 1.0, 2.0, 0.0], [0.5, 0.25, 0.25], params)
+    _check([0.0, 1.0, 2.0, 1.0], [0.25, 0.5, 0.25], params)
+
+
+def test_continue_train_custom_eval_parity(rng, tmp_path):
+    """reference :448-475 minus the retired load_boston dataset: continued
+    training from a saved model with a custom feval must track the
+    built-in l1 metric value exactly at every round."""
+    X = rng.normal(size=(2000, 8))
+    y = 3 * X[:, 0] - X[:, 1] ** 2 + 0.1 * rng.normal(size=2000)
+    Xt, yt = X[1800:], y[1800:]
+    params = {"objective": "regression", "metric": "l1", "verbose": -1}
+    train = lgb.Dataset(X[:1800], y[:1800], free_raw_data=False)
+    init = lgb.train(params, train, num_boost_round=20, verbose_eval=False)
+    init.save_model(str(tmp_path / "cont_model.txt"))
+    evals_result = {}
+
+    def mae_feval(p, d):
+        return "mae", float(np.mean(np.abs(p - d.get_label()))), False
+
+    bst = lgb.train(params, train, num_boost_round=30,
+                    valid_sets=[train.create_valid(Xt, yt)],
+                    verbose_eval=False, feval=mae_feval,
+                    evals_result=evals_result,
+                    init_model=str(tmp_path / "cont_model.txt"))
+    ret = float(np.mean(np.abs(bst.predict(Xt) - yt)))
+    assert ret < 0.5 * float(np.mean(np.abs(yt - yt.mean())))
+    assert evals_result["valid_0"]["l1"][-1] == pytest.approx(ret, abs=1e-5)
+    for l1, mae in zip(evals_result["valid_0"]["l1"],
+                       evals_result["valid_0"]["mae"]):
+        assert l1 == pytest.approx(mae, abs=1e-5)
