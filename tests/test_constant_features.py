@@ -78,3 +78,43 @@ def test_continue_train_custom_eval_parity(rng, tmp_path):
     for l1, mae in zip(evals_result["valid_0"]["l1"],
                        evals_result["valid_0"]["mae"]):
         assert l1 == pytest.approx(mae, abs=1e-5)
+
+
+def test_max_bin_by_feature():
+    """reference test_engine.py:899-920 — per-feature bin budgets decide
+    which feature can express the target exactly."""
+    col1 = np.arange(0, 100)[:, np.newaxis]
+    col2 = np.zeros((100, 1))
+    col2[20:] = 1
+    X = np.concatenate([col1, col2], axis=1)
+    y = np.arange(0, 100).astype(np.float64)
+    params = {"objective": "regression_l2", "verbose": -1,
+              "num_leaves": 100, "min_data_in_leaf": 1,
+              "min_sum_hessian_in_leaf": 0, "min_data_in_bin": 1,
+              "max_bin_by_feature": [100, 2]}
+    est = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=1,
+                    verbose_eval=False)
+    assert len(np.unique(est.predict(X))) == 100
+    params["max_bin_by_feature"] = [2, 100]
+    est = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=1,
+                    verbose_eval=False)
+    assert len(np.unique(est.predict(X))) == 3
+
+
+def test_small_max_bin():
+    """reference test_engine.py:922-940 — max_bin=2 (and 3 with a NaN)
+    must bin and train without error."""
+    rng = np.random.RandomState(0)
+    y = rng.choice([0, 1], 100).astype(np.float64)
+    x = np.zeros((100, 1))
+    x[:30, 0] = -1
+    x[30:60, 0] = 1
+    x[60:, 0] = 2
+    params = {"objective": "binary", "seed": 0, "min_data_in_leaf": 1,
+              "verbose": -1, "max_bin": 2}
+    lgb.train(params, lgb.Dataset(x, label=y), num_boost_round=5,
+              verbose_eval=False)
+    x[0, 0] = np.nan
+    params["max_bin"] = 3
+    lgb.train(params, lgb.Dataset(x, label=y), num_boost_round=5,
+              verbose_eval=False)
