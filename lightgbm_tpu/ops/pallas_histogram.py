@@ -734,10 +734,13 @@ def histogram_frontier(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
 # slice/where/update passes over the SAME blocks the smaller-child histogram
 # kernel DMAs anyway.  These kernels fold the split routing into the
 # histogram pass: per block, update the leaf_id VMEM block with the split's
-# route (computed from the split feature's own bin row, streamed as an extra
-# [1, rb] input whose index_map reads the prefetched row — dynamic sublane
-# indexing of the u8 block is not safely supported on Mosaic), THEN
-# accumulate the target leaf's histogram from the UPDATED ids.  leaf_id is
+# route, THEN accumulate the target leaf's histogram from the UPDATED ids.
+# The split feature's bin row is pre-sliced host-side into its own [1, n]
+# (frontier: [K, n]) operand: dynamic sublane indexing of the u8 block is
+# not safely supported on Mosaic, and a row-selecting index map over the
+# [F, n] array needs an (F-misaligned) [1, rb] block that Mosaic rejects
+# (sublane dim must be 8-divisible or whole) — the slice is one row of HBM
+# traffic per call, noise next to the pass itself.  leaf_id is
 # an aliased input/output: blocks outside the interval are never written and
 # keep their values; the route update is idempotent (rows moved to new_leaf
 # stop matching leaf), so out-of-range grid-step remapping to the last
@@ -779,10 +782,11 @@ def null_route() -> jax.Array:
     return (jnp.zeros(_ROUTE_WORDS, jnp.int32).at[0].set(-1))
 
 
-def _route_block_ids(sref, o: int, frow_ref, lid, packed4: bool):
+def _route_block_ids(sref, o: int, frow, lid, packed4: bool):
     """[1, rb] updated leaf ids from the route descriptor at scalar
-    offset ``o`` (all sref reads are static-offset SMEM scalars)."""
-    g = frow_ref[...].astype(jnp.int32)                 # [1, rb]
+    offset ``o`` (all sref reads are static-offset SMEM scalars);
+    ``frow`` is the split feature's [1, rb] bin-row block (a value)."""
+    g = frow.astype(jnp.int32)                          # [1, rb]
     if packed4:
         g = jnp.where(sref[o + 3] % 2 == 1, g >> 4, g & 15)
     thr, dl = sref[o + 4], sref[o + 5] == 1
@@ -815,8 +819,8 @@ def _kernel_segment_routed(sref, binsT_ref, w_ref, frow_ref, lid_ref,
 
     # 1) route this block — unconditional: skipped steps revisit an
     # in-range block and the update is idempotent
-    lid_out_ref[...] = _route_block_ids(sref, 3, frow_ref, lid_ref[...],
-                                        packed4)
+    lid_out_ref[...] = _route_block_ids(sref, 3, frow_ref[...],
+                                        lid_ref[...], packed4)
 
     # 2) accumulate the target's histogram from the UPDATED ids
     @pl.when(i < sref[1])
@@ -864,12 +868,11 @@ def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
     scalars = jnp.concatenate([
         jnp.stack([start_block, n_blocks, target_leaf]).astype(jnp.int32),
         route.astype(jnp.int32)])
+    # split feature's physical bin row (route[2]), as its own [1, n] operand
+    frow = lax.dynamic_slice(binsT, (route[2].astype(jnp.int32), 0), (1, n))
 
     def im_data(i, s):
         return (0, jnp.minimum(s[0] + i, max_blocks - 1))
-
-    def im_frow(i, s):
-        return (s[5], jnp.minimum(s[0] + i, max_blocks - 1))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -877,7 +880,7 @@ def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
         in_specs=[
             pl.BlockSpec((F, block_rows), im_data),
             pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
-            pl.BlockSpec((1, block_rows), im_frow),
+            pl.BlockSpec((1, block_rows), im_data),
             pl.BlockSpec((1, block_rows), im_data),
         ],
         out_specs=[
@@ -898,17 +901,16 @@ def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
         # alias indices include the scalar operand: input 4 is leaf_id
         input_output_aliases={4: 0},
         interpret=interpret,
-    )(scalars, binsT, w8, binsT, leaf_id.reshape(1, -1))
+    )(scalars, binsT, w8, frow, leaf_id.reshape(1, -1))
     return lid_out[0], hist.reshape(F_log, num_bins, NUM_CHANNELS)
 
 
-def _kernel_frontier_routed(sref, binsT_ref, w_ref, *rest, num_bins, K,
+def _kernel_frontier_routed(sref, binsT_ref, w_ref, frows_ref, lid_ref,
+                            lid_out_ref, out_ref, acc_ref, *, num_bins, K,
                             packed4):
-    # rest: (frow_0..frow_{K-1}, lid_ref, lid_out_ref, out_ref, acc_ref)
+    # frows_ref: [K, rb] — the K split features' bin-row blocks
     # sref: [2 + K + K*_ROUTE_WORDS + n_grid] =
     #   (n_blocks, pad, targets[K], routes[K*19], block_list[n_grid])
-    frows = rest[:K]
-    lid_ref, lid_out_ref, out_ref, acc_ref = rest[K:]
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -919,9 +921,10 @@ def _kernel_frontier_routed(sref, binsT_ref, w_ref, *rest, num_bins, K,
     # routed leaf, so at most one route matches a row and application
     # order is irrelevant; invalid slots carry leaf == -1
     lid = lid_ref[...]
+    frows = frows_ref[...]
     for k in range(K):
-        lid = _route_block_ids(sref, 2 + K + k * _ROUTE_WORDS, frows[k],
-                               lid, packed4)
+        lid = _route_block_ids(sref, 2 + K + k * _ROUTE_WORDS,
+                               frows[k:k + 1], lid, packed4)
     lid_out_ref[...] = lid
 
     # 2) batched accumulate of the K targets from the UPDATED ids
@@ -957,9 +960,9 @@ def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
     target leaves in one pass over the union block list.
 
     ``routes`` is [K, _ROUTE_WORDS] i32 (invalid slots: null_route()).
-    Each split's feature row streams as its own [1, rb] input (K static
-    unrolled refs — Mosaic cannot index the u8 block's sublanes
-    dynamically).  Returns ``(leaf_id', [K, F, B, 8])``.
+    The K split features' bin rows are pre-sliced into one [K, n]
+    operand (see the fused-route header comment).  Returns
+    ``(leaf_id', [K, F, B, 8])``.
     """
     F, n = binsT.shape
     K = K or int(targets.shape[0])
@@ -977,17 +980,14 @@ def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
         targets.astype(jnp.int32), routes.astype(jnp.int32).reshape(-1),
         bl])
     blk_base = 2 + K + K * _ROUTE_WORDS
+    # the K split features' physical bin rows (routes[:, 2]), pre-sliced
+    # into one [K, n] operand (whole-sublane block: Mosaic-legal)
+    frows = jnp.take(binsT, routes[:, 2].astype(jnp.int32), axis=0,
+                     mode="clip")
 
     def im_data(i, s):
         idx = jnp.minimum(i, jnp.maximum(s[0] - 1, 0))
         return (0, jnp.minimum(s[blk_base + idx], max_blocks - 1))
-
-    def im_frow(k):
-        def im(i, s):
-            idx = jnp.minimum(i, jnp.maximum(s[0] - 1, 0))
-            return (s[2 + K + k * _ROUTE_WORDS + 2],
-                    jnp.minimum(s[blk_base + idx], max_blocks - 1))
-        return im
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -995,8 +995,9 @@ def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
         in_specs=[
             pl.BlockSpec((F, block_rows), im_data),
             pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
-        ] + [pl.BlockSpec((1, block_rows), im_frow(k)) for k in range(K)]
-        + [pl.BlockSpec((1, block_rows), im_data)],
+            pl.BlockSpec((K, block_rows), im_data),
+            pl.BlockSpec((1, block_rows), im_data),
+        ],
         out_specs=[
             pl.BlockSpec((1, block_rows), im_data),
             pl.BlockSpec((F_log * num_bins, K * NUM_CHANNELS),
@@ -1012,10 +1013,10 @@ def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
                    jax.ShapeDtypeStruct((F_log * num_bins,
                                          K * NUM_CHANNELS), jnp.float32)],
         grid_spec=grid_spec,
-        # inputs: scalars, binsT, w8, frow_0..frow_{K-1}, leaf_id
-        input_output_aliases={3 + K: 0},
+        # inputs: scalars, binsT, w8, frows, leaf_id
+        input_output_aliases={4: 0},
         interpret=interpret,
-    )(scalars, binsT, w8, *([binsT] * K), leaf_id.reshape(1, -1))
+    )(scalars, binsT, w8, frows, leaf_id.reshape(1, -1))
     return lid_out[0], hist.reshape(F_log, num_bins, K,
                                     NUM_CHANNELS).transpose(2, 0, 1, 3)
 
