@@ -785,26 +785,39 @@ def null_route() -> jax.Array:
 def _route_block_ids(sref, o: int, frow, lid, packed4: bool):
     """[1, rb] updated leaf ids from the route descriptor at scalar
     offset ``o`` (all sref reads are static-offset SMEM scalars);
-    ``frow`` is the split feature's [1, rb] bin-row block (a value)."""
+    ``frow`` is the split feature's [1, rb] bin-row block (a value).
+
+    All mask logic is i32 0/1 arithmetic and every select predicate is
+    a single fresh compare: Mosaic materializes composed bool vectors
+    (scalar-bool broadcasts, i1 & / ~ chains) through i8 and then fails
+    to compile the i8->i1 trunci ("Unsupported target bitwidth for
+    truncation", v5e)."""
     g = frow.astype(jnp.int32)                          # [1, rb]
     if packed4:
-        g = jnp.where(sref[o + 3] % 2 == 1, g >> 4, g & 15)
-    thr, dl = sref[o + 4], sref[o + 5] == 1
-    cat, mt = sref[o + 6] == 1, sref[o + 7]
+        par = sref[o + 3] % 2                           # 0/1 i32 scalar
+        g = par * (g >> 4) + (1 - par) * (g & 15)
+    thr, dl = sref[o + 4], sref[o + 5]                  # dl: 0/1 i32
+    cat, mt = sref[o + 6], sref[o + 7]                  # cat: 0/1 i32
     dbin, nbf, off = sref[o + 8], sref[o + 9], sref[o + 10]
-    in_range = (g >= off) & (g < off + nbf)
-    fcol = jnp.where(in_range, g - off, dbin)
-    is_missing = (((mt == _MISSING_ZERO) & (fcol == dbin))
-                  | ((mt == _MISSING_NAN) & (fcol == nbf - 1)))
-    num_left = jnp.where(is_missing, dl, fcol <= thr)
+    in_range = ((g >= off).astype(jnp.int32)
+                * (g < off + nbf).astype(jnp.int32))
+    fcol = jnp.where(in_range == 1, g - off, dbin)
+    miss_z = ((mt == _MISSING_ZERO).astype(jnp.int32)
+              * (fcol == dbin).astype(jnp.int32))
+    miss_n = ((mt == _MISSING_NAN).astype(jnp.int32)
+              * (fcol == nbf - 1).astype(jnp.int32))
+    is_missing = jnp.minimum(miss_z + miss_n, 1)
+    num_left = (is_missing * dl
+                + (1 - is_missing) * (fcol <= thr).astype(jnp.int32))
     idx = jnp.clip(fcol, 0, 255)
     # cat bitset membership: 8 unrolled word selects (no vector SMEM loads)
     word = jnp.zeros_like(g)
     for k in range(8):
         word = jnp.where(idx // 32 == k, sref[o + 11 + k], word)
-    cat_left = ((word >> (idx % 32)) & 1) == 1
-    go_left = jnp.where(cat, cat_left, num_left)
-    return jnp.where((lid == sref[o]) & ~go_left, sref[o + 1], lid)
+    cat_left = (word >> (idx % 32)) & 1
+    go_left = cat * cat_left + (1 - cat) * num_left
+    take = (lid == sref[o]).astype(jnp.int32) * (1 - go_left)
+    return jnp.where(take == 1, sref[o + 1], lid)
 
 
 def _kernel_segment_routed(sref, binsT_ref, w_ref, frow_ref, lid_ref,
@@ -1047,6 +1060,10 @@ def fused_route_available() -> bool:
         try:
             _FUSED_ROUTE_CHECK = _fused_route_self_check()
         except Exception:
+            import sys
+            import traceback
+            sys.stderr.write("fused-route self-check raised:\n"
+                             + traceback.format_exc()[-2000:] + "\n")
             _FUSED_ROUTE_CHECK = False
     return _FUSED_ROUTE_CHECK
 
@@ -1057,6 +1074,12 @@ def _fused_route_self_check() -> bool:
     out-of-window retention)."""
     import numpy as np
     rng = np.random.default_rng(7)
+
+    def _fail(leg):
+        import sys
+        sys.stderr.write(f"fused-route self-check FAILED leg: {leg}\n")
+        return False
+
     F, B, rb, nblk = 4, 16, 512, 6
     n = rb * nblk
     binsT = jnp.asarray(rng.integers(0, B, (F, n)), jnp.uint8)
@@ -1098,11 +1121,11 @@ def _fused_route_self_check() -> bool:
         win[rb:4 * rb] = True
         exp[(exp == 3) & ~go_left & win] = 9
         if not np.array_equal(np.asarray(lid2), exp):
-            return False
+            return _fail(f"segment lid (cat={cat})")
         ref = histogram_segment(binsT, w8, jnp.asarray(exp), jnp.int32(1),
                                 jnp.int32(3), jnp.int32(9), B, rb)
         if not np.allclose(np.asarray(hist), np.asarray(ref), atol=1e-5):
-            return False
+            return _fail(f"segment hist (cat={cat})")
     # packed4: the in-kernel route must unpack the split column by
     # nibble parity (both parities), on 4-bit bins
     bins4 = jnp.asarray(rng.integers(0, 15, (F, n)), jnp.uint8)
@@ -1125,13 +1148,13 @@ def _fused_route_self_check() -> bool:
         win[rb:4 * rb] = True
         exp4[(exp4 == 3) & (fcol > 7) & win] = 9
         if not np.array_equal(np.asarray(lid4), exp4):
-            return False
+            return _fail(f"packed4 lid (f={f})")
         ref4 = histogram_segment(packedT, w8, jnp.asarray(exp4),
                                  jnp.int32(1), jnp.int32(3), jnp.int32(9),
                                  16, rb, packed4=True)
         if not np.allclose(np.asarray(hist4), np.asarray(ref4),
                            atol=1e-5):
-            return False
+            return _fail(f"packed4 hist (f={f})")
 
     # EFB: group column carries feature at offset; out-of-range bins
     # reconstruct to the feature default
@@ -1154,7 +1177,7 @@ def _fused_route_self_check() -> bool:
     win[rb:4 * rb] = True
     exp5[(exp5 == 3) & (fcol > 2) & win] = 9
     if not np.array_equal(np.asarray(lid5), exp5):
-        return False
+        return _fail("efb lid")
 
     # frontier: one real route + one null slot
     K = 2
@@ -1170,11 +1193,35 @@ def _fused_route_self_check() -> bool:
     exp3 = np.asarray(lid).copy()
     exp3[(exp3 == 5) & (fcol > 4)] = 10
     if not np.array_equal(np.asarray(lid3), exp3):
-        return False
+        return _fail("frontier lid")
     ref3 = histogram_frontier(binsT, w8, jnp.asarray(exp3), bl,
                               jnp.int32(3), targets, B, rb)
-    return bool(np.allclose(np.asarray(hist3[0]), np.asarray(ref3[0]),
-                            atol=1e-5))
+    if not np.allclose(np.asarray(hist3[0]), np.asarray(ref3[0]),
+                       atol=1e-5):
+        return _fail("frontier hist")
+
+    # frontier + packed4: K routes over nibble-packed rows (both
+    # parities — frows are picked as col//2 and sliced per k in-kernel)
+    routes4 = jnp.stack([pack_route(3, 9, 1, 7, False, False,
+                                    jnp.zeros(8, jnp.uint32), _M4, True),
+                         pack_route(5, 10, 2, 7, False, False,
+                                    jnp.zeros(8, jnp.uint32), _M4, True)])
+    lid6, hist6 = histogram_frontier_routed(
+        packedT, w8, lid, bl, jnp.int32(3), jnp.asarray([9, 10], jnp.int32),
+        routes4, 16, rb, 2, packed4=True)
+    f1 = np.asarray(bins4[1]).astype(np.int64)
+    f2 = np.asarray(bins4[2]).astype(np.int64)
+    exp6 = np.asarray(lid).copy()
+    exp6[(exp6 == 3) & (f1 > 7)] = 9
+    exp6[(exp6 == 5) & (f2 > 7)] = 10
+    if not np.array_equal(np.asarray(lid6), exp6):
+        return _fail("frontier packed4 lid")
+    ref6 = histogram_frontier(packedT, w8, jnp.asarray(exp6), bl,
+                              jnp.int32(3), jnp.asarray([9, 10], jnp.int32),
+                              16, rb, packed4=True)
+    if not np.allclose(np.asarray(hist6), np.asarray(ref6), atol=1e-5):
+        return _fail("frontier packed4 hist")
+    return True
 
 
 def leaf_histogram_pallas(binsT: jax.Array, grad: jax.Array,

@@ -57,8 +57,11 @@ def run(n_rows: int, num_leaves: int, warmup: int, measure: int) -> None:
         booster.train_one_iter()
     jax.block_until_ready(booster.train_score)
     per_iter = (time.time() - t0) / measure
-    print(f"PROBE rows={n_rows} leaves={num_leaves} impl="
-          f"{'segment' if booster._use_segment else 'fused'} "
+    if booster._use_segment:
+        ran = "frontier" if impl == "frontier" else "segment"
+    else:
+        ran = "fused"
+    print(f"PROBE rows={n_rows} leaves={num_leaves} impl={ran} "
           f"warmup={t_warm:.1f}s per_iter={per_iter:.4f}s", flush=True)
     print("PROBE " + GLOBAL_TIMER.summary(), flush=True)
 
