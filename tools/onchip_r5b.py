@@ -1,0 +1,59 @@
+"""Round-5 phase B: post-fix on-chip measurements.
+
+Runs after tools/onchip_r5.py finishes.  The r5 plan's bench + defaults
+probes all measured with the fused-route gate auto-disabled (the kernels
+failed Mosaic compile on an i8->i1 trunci until commit 49a9b23); this
+phase re-measures with the i32-mask kernels:
+
+  1. self-checks (expect fused_route True now; logs the failing leg if
+     not)
+  2. strict + frontier defaults probes — clean A/B against the plan's
+     FUSED_ROUTE=0 rows (same code state otherwise)
+  3. bench.py re-run: the scoreboard with fused route + warm cache
+  4. a profiler trace of the frontier grower for the next attribution
+     round (what's left above the ~0.35 s/iter kernel floor)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from onchip import PY, REPO, chip_up, log, run_step, wait_for_chip  # noqa: E402
+
+
+def main():
+    if "--wait" in sys.argv:
+        if not wait_for_chip(max_wait_s=10 * 3600):
+            log("r5b probe: backend never came up; giving up")
+            sys.exit(3)
+    elif not chip_up():
+        log("r5b probe: backend DOWN; proceeding anyway")
+
+    probe = os.path.join(REPO, "tools", "perf_probe.py")
+    bench = os.path.join(REPO, "bench.py")
+
+    run_step("r5b self-checks", [PY, "-c", (
+        "from lightgbm_tpu.ops.pallas_histogram import "
+        "fused_route_available;"
+        "from lightgbm_tpu.ops.pallas_score import scorer_available;"
+        "print('fused_route', fused_route_available());"
+        "print('scorer', scorer_available())")], 1200)
+
+    run_step("r5b strict fused 10.5M", [PY, probe, "10500000,255,1,3"],
+             2400, {"LIGHTGBM_TPU_SEG_STATS": "1"})
+    run_step("r5b frontier fused 10.5M", [PY, probe, "10500000,255,1,3"],
+             2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
+                    "LIGHTGBM_TPU_IMPL": "frontier"})
+
+    run_step("r5b bench rerun", [PY, bench], 9000)
+
+    trace_dir = os.path.join(REPO, ".traces_r5b")
+    run_step("r5b frontier trace", [PY, probe, "10500000,255,1,2"], 2400,
+             {"LIGHTGBM_TPU_IMPL": "frontier",
+              "LIGHTGBM_TPU_PROFILE_DIR": trace_dir})
+
+    log("plan r5b complete")
+
+
+if __name__ == "__main__":
+    main()
