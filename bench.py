@@ -177,7 +177,11 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
         f"full_500_iter_incl_overheads={total_real:.1f}s "
         f"train_auc@{warmup + measure}it={auc:.4f}\n")
     sys.stderr.write("bench " + GLOBAL_TIMER.summary() + "\n")
-    from lightgbm_tpu.ops.pallas_histogram import fused_route_available
+    # what the grower ACTUALLY decided at build time (the env gate and
+    # vmem-fit veto make the bare self-check result misleading)
+    from lightgbm_tpu.ops.pallas_histogram import fused_route_decisions
+    fused_used = fused_route_decisions.get(
+        "frontier" if impl == "frontier" else "segment")
     print(RESULT_TAG + json.dumps(
         {"per_iter": per_iter, "rows": n_rows, "backend": backend,
          "impl": impl, "auc": round(auc, 5),
@@ -186,7 +190,7 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
          # of the same child shows the persistent-cache number)
          "bin_s": round(t_bin, 1), "warmup_s": round(t_warm, 1),
          "full_500_incl_overheads_s": round(total_real, 1),
-         "fused_route": bool(fused_route_available())}))
+         "fused_route": fused_used}))
 
 
 def run_tier(platform: str, rows: int, warmup: int, measure: int,

@@ -36,8 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.pallas_histogram import (_segment_buckets, frontier_width,
-                                    fused_route_available,
-                                    histogram_frontier,
+                                    fused_route_decisions,
+                                    fused_route_policy, histogram_frontier,
                                     histogram_frontier_routed, null_route,
                                     pack_channels, pack_route,
                                     segment_grid_size, unpack_hist)
@@ -78,11 +78,14 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
     # a ratio above 1 would gate out even the round-best leaf and hang
     # the growth loop; config validates, this clamp guards direct callers
     gain_ratio = min(max(float(gain_ratio), 0.0), 1.0)
-    # fused route+histogram: the K routes ride the batched histogram pass
-    # (grower_seg has the single-split analog; self-checked at build
-    # time).  Feature-parallel stripes keep the unfused pair — the
+    # fused route+histogram: OFF in auto for K > 1 (see
+    # fused_route_policy — the K=16 fusion measured slower on-chip);
+    # feature-parallel stripes always keep the unfused pair — the
     # histogram scans a column slice, the route needs the full matrix.
-    fused_route = fused_route_available() and comm.column_block is None
+    fused_route = (fused_route_policy(K, p.num_columns or 64, B, rb,
+                                      p.packed4)
+                   and comm.column_block is None)
+    fused_route_decisions["frontier"] = fused_route
 
     def _one_scan(st, hist, g, h, c, depth, fmeta, fmask, key, step,
                   lo, hi):

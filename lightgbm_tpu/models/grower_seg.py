@@ -38,7 +38,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.pallas_histogram import (NUM_CHANNELS, _segment_buckets,
-                                    bucket_index, fused_route_available,
+                                    bucket_index, fused_route_decisions,
+                                    fused_route_policy,
                                     histogram_segment,
                                     histogram_segment_routed, null_route,
                                     pack_channels, pack_route,
@@ -392,7 +393,10 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
     # Feature-parallel stripes (column_block) keep the unfused pair: the
     # histogram scans a column SLICE while the route needs the full
     # matrix (the winning split may live on another shard's stripe).
-    fused_route = fused_route_available() and comm.column_block is None
+    fused_route = (fused_route_policy(1, p.num_columns or 64, B, rb,
+                                      p.packed4)
+                   and comm.column_block is None)
+    fused_route_decisions["segment"] = fused_route
 
     def hist_leaf(st: _SegState, leaf, G_cols, fmeta=None):
         """Returns (hist [G,B,3], blocks scanned)."""

@@ -160,16 +160,18 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
 
     # LIGHTGBM_TPU_ONEHOT_DTYPE picks the compare dtype for the one-hot
     # build — the kernel's measured bound (~18 ms of the ~27 ms full-N
-    # pass at i32).  u8 (4 values/lane) FAILED to lower on v5e: Mosaic
-    # supports only 16/32-bit iota (ONCHIP_LOG.md).  bf16 packs 2
-    # values/lane with a legal 16-bit iota, and bins 0..255 are exact in
-    # bf16, so `bf16` may halve the compare cost; i32 is the default
-    # until the on-chip A/B lands.
+    # pass at i32).  v5e VERDICT (2026-08-01 on-chip): narrow compares
+    # are DEAD on this hardware — u8 iota doesn't lower, 16-bit iota is
+    # "not supported by hardware", and even with the i32-iota+downcast
+    # construction below both i16 and bf16 fail Mosaic compile with
+    # "Target does not support this comparison".  i32 is the default
+    # and the only mode known to compile on v5e; the narrow paths stay
+    # for backends whose VPU does support them.
     import os as _os
     _env = _os.environ.get("LIGHTGBM_TPU_ONEHOT_DTYPE", "")
     if _env == "u8":
-        # u8 iota fails to lower on Mosaic (16/32-bit iota only,
-        # ONCHIP_LOG round 4) — route to the working 2-values/lane mode
+        # no u8 iota on Mosaic and no u8 vector compare on v5e — route
+        # to i16 (itself v5e-dead but the nearest requested intent)
         # instead of crashing deep in kernel compilation
         from ..utils.log import log_warning
         log_warning("LIGHTGBM_TPU_ONEHOT_DTYPE=u8 does not lower on "
@@ -189,9 +191,22 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
                 bi = b.astype(jnp.int32)
                 b = jnp.stack([bi & 15, bi >> 4], axis=1).reshape(
                     2 * np_, chunk)
-            b = b.astype(cmp_dtype)
             nf = b.shape[0]
-            iota = lax.broadcasted_iota(cmp_dtype, (nf, B, chunk), 1)
+            # narrow compare dtypes: v5e has no 16-bit iota ("16-bit
+            # iota not supported by hardware") and no direct u8->bf16
+            # convert — build both sides from i32/f32 with supported
+            # single-step converts
+            iota32 = lax.broadcasted_iota(jnp.int32, (nf, B, chunk), 1)
+            if cmp_dtype == jnp.bfloat16:
+                b = b.astype(jnp.int32).astype(jnp.float32).astype(
+                    jnp.bfloat16)
+                iota = iota32.astype(jnp.float32).astype(jnp.bfloat16)
+            elif cmp_dtype == jnp.int16:
+                b = b.astype(jnp.int32).astype(jnp.int16)
+                iota = iota32.astype(jnp.int16)
+            else:
+                b = b.astype(cmp_dtype)
+                iota = iota32
             onehot = (b[:, None, :] == iota).astype(
                 jnp.bfloat16).reshape(nf * B, chunk)
             f0 = (2 * p0 if packed4 else p0)
@@ -913,6 +928,12 @@ def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
         grid_spec=grid_spec,
         # alias indices include the scalar operand: input 4 is leaf_id
         input_output_aliases={4: 0},
+        # the extra frow/lid streams push the double-buffered working
+        # set past Mosaic's 16 MB default scoped-vmem limit at
+        # production shapes (measured 17.14 MB, v5e); the chip has
+        # 128 MB
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(scalars, binsT, w8, frow, leaf_id.reshape(1, -1))
     return lid_out[0], hist.reshape(F_log, num_bins, NUM_CHANNELS)
@@ -1028,10 +1049,69 @@ def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
         grid_spec=grid_spec,
         # inputs: scalars, binsT, w8, frows, leaf_id
         input_output_aliases={4: 0},
+        # see histogram_segment_routed: the K frow rows + lid streams
+        # exceed the 16 MB default scoped-vmem limit at K=16 production
+        # shapes
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(scalars, binsT, w8, frows, leaf_id.reshape(1, -1))
     return lid_out[0], hist.reshape(F_log, num_bins, K,
                                     NUM_CHANNELS).transpose(2, 0, 1, 3)
+
+
+_FUSED_VMEM_LIMIT = 64 * 1024 * 1024  # compiler_params on the fused calls
+
+
+def fused_route_fits(F_phys: int, num_bins: int, K: int = 1,
+                     block_rows: int = 0, packed4: bool = False) -> bool:
+    """Whether the fused kernels' scoped-VMEM working set fits at this
+    shape.  The small-shape self-check can't see production-shape OOMs
+    (measured: K=16, F=28, rb=32768 needs 17.14 MB against Mosaic's
+    16 MB default — hence the 64 MB compiler_params), so the auto
+    policy consults this; the estimate is DELIBERATELY conservative
+    (~2x the plain double-buffered sum, calibrated so the measured
+    case lands near its real 17.14 MB) and LIGHTGBM_TPU_FUSED_ROUTE=1
+    bypasses it for A/Bs on shapes it vetoes."""
+    F_log = 2 * F_phys if packed4 else F_phys
+    if block_rows <= 0:
+        block_rows = pick_block_rows(F_log, num_bins)
+    streams = block_rows * (F_phys + K + 2 * NUM_CHANNELS + 8)
+    out = F_log * num_bins * K * NUM_CHANNELS * 4
+    est = 2 * (3 * streams + 3 * out)
+    return est <= int(0.9 * _FUSED_VMEM_LIMIT)
+
+
+# build-time decisions, keyed "segment"/"frontier" — benches read this to
+# report the kernel that actually ran (the env gate + fits veto make the
+# bare self-check result misleading)
+fused_route_decisions: dict = {}
+
+
+def fused_route_policy(K: int, F_log: int, num_bins: int,
+                       block_rows: int, packed4: bool) -> bool:
+    """The growers' single dispatch policy for the fused route+histogram
+    kernels.
+
+    env force (LIGHTGBM_TPU_FUSED_ROUTE=1) -> on wherever the kernels
+    lower (bypasses both the K policy and the vmem fit veto, for A/Bs);
+    =0 -> off.  Auto: K == 1 only — on-chip (v5e, 2026-08-01) the K=16
+    fused frontier measured 1.43 s/iter vs 1.02-1.04 unfused at the
+    HIGGS shape (the K serial in-block route updates plus K frow
+    streams cost more than the ONE union-pass windowed route they
+    replace) while the K=1 segment fusion won 1.28 vs 1.43 — plus the
+    self-check and the vmem fit estimate."""
+    import os
+    env = os.environ.get("LIGHTGBM_TPU_FUSED_ROUTE", "auto").lower()
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true"):
+        return fused_route_available()
+    if K > 1:
+        return False
+    F_phys = (F_log + 1) // 2 if packed4 else F_log
+    return (fused_route_available()
+            and fused_route_fits(F_phys, num_bins, K, block_rows, packed4))
 
 
 _FUSED_ROUTE_CHECK: bool | None = None

@@ -41,9 +41,12 @@ def main():
 
     run_step("r5b strict fused 10.5M", [PY, probe, "10500000,255,1,3"],
              2400, {"LIGHTGBM_TPU_SEG_STATS": "1"})
+    # frontier auto keeps the unfused pair (fused_route_policy: the
+    # K=16 fusion measured slower) — force it so this stays a real A/B
     run_step("r5b frontier fused 10.5M", [PY, probe, "10500000,255,1,3"],
              2400, {"LIGHTGBM_TPU_SEG_STATS": "1",
-                    "LIGHTGBM_TPU_IMPL": "frontier"})
+                    "LIGHTGBM_TPU_IMPL": "frontier",
+                    "LIGHTGBM_TPU_FUSED_ROUTE": "1"})
 
     run_step("r5b bench rerun", [PY, bench], 9000)
 

@@ -1,0 +1,42 @@
+"""Micro: full-N histogram_all pass cost vs LIGHTGBM_TPU_ONEHOT_DTYPE.
+
+One process per dtype (the env is read at kernel trace time and is not
+part of the jit cache key).  HIGGS shape: F=28, B=64, N=10.5M padded.
+Usage: LIGHTGBM_TPU_ONEHOT_DTYPE=i16 python tools/onehot_micro.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.pallas_histogram import (histogram_all, pack_channels,
+                                               pick_block_rows)
+
+F, B, N = 28, 64, 10_500_000
+rb = pick_block_rows(F, B)
+n = ((N + rb - 1) // rb) * rb
+rng = np.random.default_rng(0)
+binsT = jnp.asarray(rng.integers(0, B, (F, n)), jnp.uint8)
+w8 = pack_channels(jnp.asarray(rng.standard_normal(n), jnp.float32),
+                   jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32),
+                   jnp.ones(n, jnp.float32))
+
+t0 = time.time()
+out = histogram_all(binsT, w8, B, rb)
+jax.block_until_ready(out)
+warm = time.time() - t0
+reps = 20
+t0 = time.time()
+for _ in range(reps):
+    out = histogram_all(binsT, w8, B, rb)
+jax.block_until_ready(out)
+per = (time.time() - t0) / reps
+print(f"ONEHOT={os.environ.get('LIGHTGBM_TPU_ONEHOT_DTYPE', 'i32') or 'i32'}"
+      f" full-N pass: {per * 1e3:.2f} ms (warmup {warm:.1f}s, rb={rb})"
+      f" checksum={float(jnp.sum(out)):.3f}")
