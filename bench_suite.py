@@ -44,7 +44,9 @@ TIERS = {
                         ("cpu", 10_000, 1, 2, 900)],
     "multiclass_cat": [("tpu", 1_000_000, 2, 4, 2400),
                        ("cpu", 10_000, 1, 2, 900)],
-    "lambdarank_msltr": [("tpu", 2_270_000, 2, 4, 2700),
+    # 4200s: the cold lambdarank compile at 2.27M rows blew the usual
+    # 2700s budget (r5 on-chip log, 2026-08-01)
+    "lambdarank_msltr": [("tpu", 2_270_000, 2, 4, 4200),
                          ("cpu", 20_000, 1, 2, 900)],
     # the mesh is 8 VIRTUAL CPU devices sharing one host core, so this
     # config is a correctness/liveness gate (serial parity), not a
@@ -316,7 +318,25 @@ def main():
                  "quality_ok": False}
         results.append(r)
         print(json.dumps(r), flush=True)
-    with open(os.path.join(REPO, "BENCH_SUITE.json"), "w") as fh:
+    # subset runs merge into the existing artifact instead of clobbering
+    # the other configs' records
+    path = os.path.join(REPO, "BENCH_SUITE.json")
+    if set(configs) != set(TIERS):
+        def config_of(rec):
+            for name in TIERS:
+                if rec.get("metric", "").startswith(name):
+                    return name
+            return rec.get("metric", "")
+
+        try:
+            with open(path) as fh:
+                old = {config_of(r): r for r in json.load(fh)}
+        except (OSError, ValueError):
+            old = {}
+        for r in results:
+            old[config_of(r)] = r
+        results = list(old.values())
+    with open(path, "w") as fh:
         json.dump(results, fh, indent=1)
 
 

@@ -58,14 +58,15 @@ from .grower import (CommHooks, GrowerParams, TreeArrays,
 # tree; the amortized rule bounds scan waste at ~(1 + COMPACT_WASTE/2) x
 # ideal while the number of sorts stays <= total_scanned / (COMPACT_WASTE
 # x N).  Overridable via LIGHTGBM_TPU_COMPACT_WASTE (in N multiples).
-# Default from the round-4 on-chip sweeps at 10.5M rows (ONCHIP_LOG.md):
-# the full-payload sort measures ~190 ms in context — ~5x the in-jit
-# micro's estimate — so trading scan waste for fewer sorts wins:
-# strict per-iter 3.13 s (waste=1.0) / 2.30 (2.0) / 1.91 (3.0) / 1.45
-# (6.0); frontier 1.28 (3.0) / 1.12 (6.0).
+# Default from the on-chip sweeps at 10.5M rows (ONCHIP_LOG.md).  Round
+# 4 (waste=1..6): strict 3.13 / 2.30 / 1.91 / 1.45, frontier 1.28 (3.0)
+# / 1.12 (6.0) — the full-payload sort costs ~136-190 ms in context so
+# fewer sorts win.  Round 5 refined around the knee (frontier, stats
+# on): 6.0 -> 1.017 (2 sorts), 9.0 -> 0.929 (1 sort), 12.0 -> 0.985
+# (scan growth overtakes); strict likewise prefers ~10 (1.42 -> 1.26).
 import os as _os
 
-COMPACT_WASTE = float(_os.environ.get("LIGHTGBM_TPU_COMPACT_WASTE", "6.0"))
+COMPACT_WASTE = float(_os.environ.get("LIGHTGBM_TPU_COMPACT_WASTE", "9.0"))
 
 
 def seg_stats_enabled() -> bool:

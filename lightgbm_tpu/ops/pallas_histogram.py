@@ -933,7 +933,7 @@ def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
         # production shapes (measured 17.14 MB, v5e); the chip has
         # 128 MB
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=64 * 1024 * 1024),
+            vmem_limit_bytes=_FUSED_VMEM_LIMIT),
         interpret=interpret,
     )(scalars, binsT, w8, frow, leaf_id.reshape(1, -1))
     return lid_out[0], hist.reshape(F_log, num_bins, NUM_CHANNELS)
@@ -1053,7 +1053,7 @@ def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
         # exceed the 16 MB default scoped-vmem limit at K=16 production
         # shapes
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=64 * 1024 * 1024),
+            vmem_limit_bytes=_FUSED_VMEM_LIMIT),
         interpret=interpret,
     )(scalars, binsT, w8, frows, leaf_id.reshape(1, -1))
     return lid_out[0], hist.reshape(F_log, num_bins, K,
