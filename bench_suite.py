@@ -281,6 +281,7 @@ def run_config(config: str, probe_ok: bool) -> dict | None:
                 total = r["per_iter"] * TOTAL_ITERS_REF
                 ref = REF_500_ITERS_S.get(config)
                 out = {
+                    "config": config,
                     "metric": f"{config}_{r['rows']}r_500iter_train_time_"
                               f"{r['backend']}",
                     "value": round(total, 2),
@@ -314,8 +315,8 @@ def main():
     for config in configs:
         r = run_config(config, probe_ok)
         if r is None:
-            r = {"metric": f"{config}_failed", "value": -1.0, "unit": "s",
-                 "quality_ok": False}
+            r = {"config": config, "metric": f"{config}_failed",
+                 "value": -1.0, "unit": "s", "quality_ok": False}
         results.append(r)
         print(json.dumps(r), flush=True)
     # subset runs merge into the existing artifact instead of clobbering
@@ -323,10 +324,12 @@ def main():
     path = os.path.join(REPO, "BENCH_SUITE.json")
     if set(configs) != set(TIERS):
         def config_of(rec):
-            for name in TIERS:
-                if rec.get("metric", "").startswith(name):
-                    return name
-            return rec.get("metric", "")
+            if "config" in rec:
+                return rec["config"]
+            # pre-"config"-field artifacts: longest-prefix fallback
+            names = [n for n in TIERS
+                     if rec.get("metric", "").startswith(n)]
+            return max(names, key=len) if names else rec.get("metric", "")
 
         try:
             with open(path) as fh:

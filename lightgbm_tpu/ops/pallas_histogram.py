@@ -45,13 +45,17 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+import os as _os
+
 NUM_CHANNELS = 8
 DEFAULT_BLOCK_ROWS = 16384
 # inner sub-chunk of a row block: the one-hot [fblk*B, CHUNK] lives in
-# VMEM only for the duration of one matmul
-CHUNK = 512
+# VMEM only for the duration of one matmul.  Env-tunable (read at
+# import) for on-chip inner-loop sweeps: the build is ~5x off its VPU
+# bound and these two shape the materialized tile.
+CHUNK = int(_os.environ.get("LIGHTGBM_TPU_ONEHOT_CHUNK", "512"))
 # feature sub-block: keep fblk*B*CHUNK*2B (one-hot) around 2MB
-_FBLK_BIN_BUDGET = 2048
+_FBLK_BIN_BUDGET = int(_os.environ.get("LIGHTGBM_TPU_FBLK_BINS", "2048"))
 # VMEM working-set budget for auto block sizing (bytes, of ~16MB/core)
 _VMEM_BUDGET = 10 * 1024 * 1024
 
