@@ -279,21 +279,48 @@ def _unpermute(order, leaf_id):
     return lax.sort((order, leaf_id), num_keys=1)[1]
 
 
+# above this many sort operands, compact via argsort + matrix gathers:
+# XLA's variadic TPU sort compile time explodes with operand count
+# (measured on v5e 2026-08-01: 12 operands at 56k rows = 94 s compile;
+# the 39-operand sort a 136-feature dataset produces never finished
+# inside a 70-minute budget and took the whole lambdarank-suite tier
+# with it).  The gather path runs slower per sort (round-3 micro) but
+# compiles in seconds and compaction is ~1 sort/tree at the default
+# waste budget.
+_MAX_SORT_OPERANDS = 16
+
+
 def compact_state(st: _SegState, L: int, rb: int) -> _SegState:
     """Stable-sort the whole layout by leaf_id; leaves become contiguous
     segments and confinement intervals reset to them.  Shared by the
     strict and frontier growers (identical _SegState layout)."""
-    operands = ((st.leaf_id,)
-                + tuple(_pack_bins_words(st.binsT))
-                + tuple(_pack_w8_words(st.w8))
-                + (st.order,))
-    sorted_ops = lax.sort(operands, num_keys=1, is_stable=True)
-    lid = sorted_ops[0]
     W = st.binsT.shape[0] // 4
-    binsT = _unpack_bins_words(jnp.stack(sorted_ops[1:1 + W]),
-                               st.binsT.dtype)
-    w8 = _unpack_w8_words(jnp.stack(sorted_ops[1 + W:1 + W + 3]))
-    order = sorted_ops[1 + W + 3]
+    if W + 5 <= _MAX_SORT_OPERANDS:
+        operands = ((st.leaf_id,)
+                    + tuple(_pack_bins_words(st.binsT))
+                    + tuple(_pack_w8_words(st.w8))
+                    + (st.order,))
+        sorted_ops = lax.sort(operands, num_keys=1, is_stable=True)
+        lid = sorted_ops[0]
+        binsT = _unpack_bins_words(jnp.stack(sorted_ops[1:1 + W]),
+                                   st.binsT.dtype)
+        w8 = _unpack_w8_words(jnp.stack(sorted_ops[1 + W:1 + W + 3]))
+        order = sorted_ops[1 + W + 3]
+    else:
+        # wide-feature path: 2-operand stable sort for the permutation,
+        # then one gather per array (columns move as whole vectors)
+        n = st.leaf_id.shape[0]
+        lid, perm = lax.sort(
+            (st.leaf_id, jnp.arange(n, dtype=jnp.int32)),
+            num_keys=1, is_stable=True)
+        binsT = jnp.take(st.binsT, perm, axis=1)
+        # channels 6-7 are structurally zero (pack_channels) — move only
+        # the live ones, refill the rest (same trim the sort path makes)
+        w8 = jnp.concatenate(
+            [jnp.take(st.w8[:6], perm, axis=1),
+             jnp.zeros((st.w8.shape[0] - 6, st.w8.shape[1]),
+                       st.w8.dtype)])
+        order = jnp.take(st.order, perm)
     leaves = jnp.arange(L, dtype=jnp.int32)
     starts = jnp.searchsorted(lid, leaves, side="left").astype(jnp.int32)
     ends = jnp.searchsorted(lid, leaves, side="right").astype(jnp.int32)

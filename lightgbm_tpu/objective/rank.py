@@ -21,6 +21,7 @@ from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from ..utils.dcg import DCGCalculator
@@ -112,46 +113,57 @@ class LambdarankNDCG(ObjectiveFunction):
                 idx[row, :cnt] = np.arange(boundaries[q], boundaries[q + 1])
             # fixed chunk size keeping the [C, P, P] transient under ~64MB
             chunk = max(1, (1 << 24) // (P * P))
+            # right-size C: same chunk count, minimal phantom padding
+            nC_min = -(-len(qs) // min(chunk, len(qs)))
+            C = -(-len(qs) // nC_min)
+            # pad the query count to a multiple of C and reshape to
+            # [n_chunks, C, P]: get_gradients lax.scans over the leading
+            # axis, so the traced graph holds ONE pairwise body per
+            # bucket no matter how many queries there are.  (The old
+            # Python chunk loop inlined a [C, P, P] body PER CHUNK —
+            # ~19 of them at 2.27M rows — and the remote Mosaic/XLA
+            # compile of that graph blew every timeout on v5e,
+            # 2026-08-01.)
+            pad_q = (-len(qs)) % C
+            if pad_q:
+                idx = np.concatenate(
+                    [idx, np.full((pad_q, P), -1, np.int64)])
+            labels = np.where(idx >= 0,
+                              self.label_np[np.maximum(idx, 0)],
+                              0).astype(np.int32)
+            inv_q = np.concatenate(
+                [inv[qs], np.zeros(pad_q)]).astype(np.float32)
+            nC = idx.shape[0] // C
             self.buckets.append({
-                "P": P, "chunk": chunk,
-                "idx": jnp.asarray(np.where(idx < 0, 0, idx)),
-                "mask": jnp.asarray(idx >= 0),
-                "labels": jnp.asarray(
-                    np.where(idx >= 0, self.label_np[np.maximum(idx, 0)], 0)
-                    .astype(np.int32)),
-                "inv_max_dcg": jnp.asarray(inv[qs].astype(np.float32)),
+                "P": P,
+                "idx": jnp.asarray(np.where(idx < 0, 0, idx)
+                                   .astype(np.int32).reshape(nC, C, P)),
+                "mask": jnp.asarray((idx >= 0).reshape(nC, C, P)),
+                "labels": jnp.asarray(labels.reshape(nC, C, P)),
+                "inv_max_dcg": jnp.asarray(inv_q.reshape(nC, C)),
             })
         self.gains = jnp.asarray(self.calc.label_gain.astype(np.float32))
 
     def get_gradients(self, score):
         grad = jnp.zeros_like(score)
         hess = jnp.zeros_like(score)
-        for b in self.buckets:
-            nq = b["idx"].shape[0]
-            C = min(b["chunk"], nq)
-            for start in range(0, nq, C):
-                end = min(start + C, nq)
-                sl = slice(start, end)
-                idx = b["idx"][sl]
-                msk = b["mask"][sl]
-                pad_q = C - (end - start)
-                if pad_q:
-                    idx = jnp.pad(idx, ((0, pad_q), (0, 0)))
-                    msk = jnp.pad(msk, ((0, pad_q), (0, 0)))
-                lam, hes = _chunk_lambdas(
-                    score[idx],
-                    jnp.pad(b["labels"][sl], ((0, pad_q), (0, 0)))
-                    if pad_q else b["labels"][sl],
-                    msk,
-                    jnp.pad(b["inv_max_dcg"][sl], (0, pad_q))
-                    if pad_q else b["inv_max_dcg"][sl],
-                    self.gains, sigmoid=self.sigmoid, norm=self.norm)
-                flat_idx = idx.reshape(-1)
+        for b in self.buckets:   # bounded: one body per P bucket
+            def body(carry, chunk):
+                g, h = carry
+                idx, msk, lab, invd = chunk
+                lam, hes = _chunk_lambdas(score[idx], lab, msk, invd,
+                                          self.gains,
+                                          sigmoid=self.sigmoid,
+                                          norm=self.norm)
+                flat = idx.reshape(-1)
                 keep = msk.reshape(-1)
-                grad = grad.at[flat_idx].add(
-                    jnp.where(keep, lam.reshape(-1), 0.0))
-                hess = hess.at[flat_idx].add(
-                    jnp.where(keep, hes.reshape(-1), 0.0))
+                g = g.at[flat].add(jnp.where(keep, lam.reshape(-1), 0.0))
+                h = h.at[flat].add(jnp.where(keep, hes.reshape(-1), 0.0))
+                return (g, h), None
+
+            (grad, hess), _ = lax.scan(
+                body, (grad, hess),
+                (b["idx"], b["mask"], b["labels"], b["inv_max_dcg"]))
         if self.weights is not None:
             grad = grad * self.weights
             hess = hess * self.weights

@@ -8,6 +8,13 @@ from lightgbm_tpu.config import Config
 from lightgbm_tpu.core.parser import load_file_to_dataset
 
 
+def _timed(fn, *args):
+    import time
+    t0 = time.perf_counter()
+    fn(*args)
+    return time.perf_counter() - t0
+
+
 def _write_csv(path, y, X, extra_cols=()):
     cols = [y] + list(extra_cols) + [X[:, j] for j in range(X.shape[1])]
     np.savetxt(path, np.column_stack(cols), delimiter=",", fmt="%.6f")
@@ -218,14 +225,14 @@ def test_native_libsvm_tokenizer_parity(tmp_path):
     assert ds.num_data == expected.shape[0]
 
     # throughput: the native pass must beat the interpreter loop by >=5x
-    # on a larger buffer (conservative: measured ~30-60x)
+    # on a larger buffer (conservative: measured ~30-60x).  Best-of-3 on
+    # both sides: single-shot wall-clock flaked under a loaded host
+    # (2026-08-01, suite alongside an on-chip bench).
     big = (text * 10).encode()
-    t0 = time.perf_counter()
-    parse_libsvm_native(big)
-    t_native = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    parser._parse_libsvm(big.decode().splitlines())
-    t_python = time.perf_counter() - t0
+    big_lines = big.decode().splitlines()
+    t_native = min(_timed(parse_libsvm_native, big) for _ in range(3))
+    t_python = min(_timed(parser._parse_libsvm, big_lines)
+                   for _ in range(3))
     assert t_native * 5 < t_python, (t_native, t_python)
 
 
