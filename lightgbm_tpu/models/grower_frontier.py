@@ -45,9 +45,8 @@ from ..ops.split import (NEG_INF, FeatureMeta, best_split,
                          expand_group_hist)
 from .grower import (GrowerParams, _node_feature_mask, mono_handoff)
 from .grower_seg import (COMPACT_WASTE, _COMPACT_MUT, _SegState,
-                         _unpermute, compact_state, cond_narrow,
-                         fresh_state, route_split_windowed,
-                         stripe_histogram)
+                         _unpermute, apply_route, compact_state,
+                         cond_narrow, fresh_state, stripe_histogram)
 
 
 
@@ -86,6 +85,8 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                                       p.packed4)
                    and comm.column_block is None)
     fused_route_decisions["frontier"] = fused_route
+    from ..ops.pallas_histogram import route_kernel_available
+    route_kernel = route_kernel_available()
 
     def _one_scan(st, hist, g, h, c, depth, fmeta, fmask, key, step,
                   lo, hi):
@@ -195,9 +196,10 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                 # routing confined to the parent's inherited block
                 # interval (grower_seg.route_split_windowed); the fused
                 # path routes inside the batched histogram kernel instead
-                leaf_id = route_split_windowed(
+                leaf_id = apply_route(
                     st.binsT, st.leaf_id, fmeta, p.packed4, rb,
-                    f, t, dl, cat, bitset, leaf, new_leaf, lo, hi - lo)
+                    f, t, dl, cat, bitset, leaf, new_leaf, lo, hi - lo,
+                    route_kernel)
                 st = st._replace(leaf_id=leaf_id)
 
             Gl, Hl, Cl = bf[1], bf[2], bf[3]

@@ -43,6 +43,7 @@ from ..ops.pallas_histogram import (NUM_CHANNELS, _segment_buckets,
                                     histogram_segment,
                                     histogram_segment_routed, null_route,
                                     pack_channels, pack_route,
+                                    route_kernel_available, route_window,
                                     segment_grid_size, unpack_hist,
                                     unpack_nibble)
 from ..ops.split import (NEG_INF, FeatureMeta, best_split, expand_group_hist,
@@ -251,6 +252,22 @@ def route_split_windowed(binsT, leaf_id, fmeta, packed4, rb,
     return lax.switch(idx, [make_branch(b) for b in buckets], leaf_id)
 
 
+def apply_route(binsT, leaf_id, fmeta, packed4, rb, f, t, dl, cat,
+                bitset, leaf, new_leaf, lo, n_blk, use_kernel: bool):
+    """One split's confined leaf_id update, through the aliased pallas
+    window kernel when available (writes only the window's blocks; the
+    XLA switch path below materializes a full-N leaf_id per call —
+    measured 0.18 s/iter of conditional copies at the HIGGS shape) or
+    the XLA windowed path otherwise."""
+    if use_kernel:
+        route = pack_route(leaf, new_leaf, f, t, dl, cat, bitset, fmeta,
+                           packed4)
+        return route_window(binsT, leaf_id, lo, n_blk, route, rb,
+                            packed4=packed4)
+    return route_split_windowed(binsT, leaf_id, fmeta, packed4, rb, f, t,
+                                dl, cat, bitset, leaf, new_leaf, lo, n_blk)
+
+
 def stripe_histogram(binsT, start, ncols, kernel_fn, feat_axis: int):
     """Feature-parallel stripe scatter shared by the strict and frontier
     growers: histogram a column SLICE of the bin matrix, then place the
@@ -425,6 +442,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                                       p.packed4)
                    and comm.column_block is None)
     fused_route_decisions["segment"] = fused_route
+    route_kernel = route_kernel_available()
 
     def hist_leaf(st: _SegState, leaf, G_cols, fmeta=None):
         """Returns (hist [G,B,3], blocks scanned)."""
@@ -595,9 +613,10 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                                                   None, fmeta)
                 blk = hi - lo
             else:
-                leaf_id = route_split_windowed(
+                leaf_id = apply_route(
                     st.binsT, st.leaf_id, fmeta, p.packed4, rb,
-                    f, t, dl, cat, bitset, leaf, new_leaf, lo, hi - lo)
+                    f, t, dl, cat, bitset, leaf, new_leaf, lo, hi - lo,
+                    route_kernel)
 
             st = st._replace(
                 leaf_id=leaf_id,

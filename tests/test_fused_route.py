@@ -78,3 +78,42 @@ def test_fused_matches_unfused_packed4(rng, monkeypatch):
     fused = _train(X, y, "segment", True, monkeypatch, **kw)
     assert fused.grower_params.packed4
     _assert_identical(unfused, fused, X)
+
+
+def test_route_kernel_matches_xla_route(monkeypatch, rng):
+    """route_window (aliased pallas window kernel) must reproduce the
+    XLA windowed route bit-for-bit through a trained model: same trees,
+    same predictions (LIGHTGBM_TPU_ROUTE_KERNEL=1 forces the kernel on
+    the CPU interpret path; auto only engages on a real accelerator)."""
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    code = """
+import numpy as np, lightgbm_tpu as lgb, os
+rng = np.random.RandomState(3)
+X = rng.normal(size=(4000, 8)); y = (X[:,0] - 0.5*X[:,1] > 0).astype(float)
+params = {"objective": "binary", "verbose": -1, "num_leaves": 15,
+          "tpu_histogram_backend": "pallas",
+          "tpu_tree_impl": os.environ["IMPL"]}
+bst = lgb.train(params, lgb.Dataset(X, y, params=params), 4)
+np.save(os.environ["OUT"], bst.predict(X))
+"""
+    import os
+    preds = {}
+    for impl in ("segment", "frontier"):
+        for tag, rk in (("xla", "0"), ("kernel", "1")):
+            out = f"/tmp/route_ab_{impl}_{tag}.npy"
+            # DYN_GRID pinned on: =0 would silently veto the forced
+            # kernel leg and both legs would compare the XLA path
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PALLAS_AXON_POOL_IPS="",
+                       LIGHTGBM_TPU_DYN_GRID="1",
+                       LIGHTGBM_TPU_ROUTE_KERNEL=rk, IMPL=impl, OUT=out)
+            r = subprocess.run([sys.executable, "-c", code], env=env,
+                               capture_output=True, text=True)
+            assert r.returncode == 0, r.stderr[-500:]
+            preds[(impl, tag)] = np.load(out)
+        d = np.abs(preds[(impl, "xla")] - preds[(impl, "kernel")]).max()
+        assert d == 0.0, (impl, d)

@@ -453,7 +453,8 @@ def cmd_trace(argv):
          + rng.normal(size=N) * 0.5 > 0).astype(np.float64)
     cfg = Config(objective="binary", num_leaves=L, max_bin=63,
                  learning_rate=0.1, min_sum_hessian_in_leaf=100.0,
-                 verbosity=-1)
+                 verbosity=-1,
+                 tpu_tree_impl=os.environ.get("LIGHTGBM_TPU_IMPL", "auto"))
     ds = TpuDataset.from_numpy(X, y, config=cfg)
     obj = create_objective(cfg)
     obj.init(ds.metadata, ds.num_data)
