@@ -1224,12 +1224,15 @@ def _route_kernel_self_check() -> bool:
     class _M:
         feat_group = None
         feat_offset = None
-        missing_type = jnp.asarray([1, 2, 0, 0], jnp.int32)
+        missing_type = jnp.asarray([1, 2, 2, 0], jnp.int32)
         default_bin = jnp.asarray([3, 0, 0, 0], jnp.int32)
         num_bin = jnp.full((4,), B, jnp.int32)
 
-    for f, cat in ((0, False), (1, True)):
-        route = pack_route(3, 9, f, B // 2, True, cat, bitset, _M, False)
+    # f=2 exercises the numeric MISSING_NAN branch (bin B-1 routed by
+    # default_left, here False); the categorical case ignores missing
+    for f, cat, dl in ((0, False, True), (1, True, True),
+                       (2, False, False)):
+        route = pack_route(3, 9, f, B // 2, dl, cat, bitset, _M, False)
         lid2 = route_window(binsT, lid, jnp.int32(1), jnp.int32(3),
                             route, rb)
         fcol = np.asarray(binsT[f]).astype(np.int64)
@@ -1240,7 +1243,7 @@ def _route_kernel_self_check() -> bool:
             w = np.asarray(bitset)[np.clip(fcol, 0, 255) // 32]
             go_left = (w >> (np.clip(fcol, 0, 255) % 32)) & 1 > 0
         else:
-            go_left = np.where(miss, True, fcol <= B // 2)
+            go_left = np.where(miss, dl, fcol <= B // 2)
         exp = np.asarray(lid).copy()
         win = np.zeros(n, bool)
         win[rb:4 * rb] = True
@@ -1313,12 +1316,15 @@ def _fused_route_self_check() -> bool:
     class _M:  # minimal FeatureMeta-alike for pack_route
         feat_group = None
         feat_offset = None
-        missing_type = jnp.asarray([1, 2, 0, 0], jnp.int32)
+        missing_type = jnp.asarray([1, 2, 2, 0], jnp.int32)
         default_bin = jnp.asarray([3, 0, 0, 0], jnp.int32)
         num_bin = jnp.full((4,), B, jnp.int32)
 
-    for f, cat in ((0, False), (1, True)):
-        route = pack_route(3, 9, f, B // 2, True, cat, bitset, _M, False)
+    # f=2 exercises the numeric MISSING_NAN branch (bin B-1 routed by
+    # default_left, here False); the categorical case ignores missing
+    for f, cat, dl in ((0, False, True), (1, True, True),
+                       (2, False, False)):
+        route = pack_route(3, 9, f, B // 2, dl, cat, bitset, _M, False)
         lid2, hist = histogram_segment_routed(
             binsT, w8, lid, jnp.int32(1), jnp.int32(3), jnp.int32(9),
             route, B, rb)
@@ -1331,7 +1337,7 @@ def _fused_route_self_check() -> bool:
             w = np.asarray(bitset)[np.clip(fcol, 0, 255) // 32]
             go_left = (w >> (np.clip(fcol, 0, 255) % 32)) & 1 > 0
         else:
-            go_left = np.where(miss, True, fcol <= B // 2)
+            go_left = np.where(miss, dl, fcol <= B // 2)
         exp = np.asarray(lid).copy()
         win = np.zeros(n, bool)
         win[rb:4 * rb] = True

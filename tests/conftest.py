@@ -7,6 +7,17 @@ data-/feature-parallel learners run their real collective paths in-process.
 
 import os
 
+# Hermetic env: the perf knobs (LIGHTGBM_TPU_*) change traced shapes,
+# dispatch policies and module-level defaults at import time; a knob
+# leaked from a concurrently-running bench/probe (the driver runs them
+# side by side) must not reconfigure the test suite.  Tests that WANT a
+# knob set it explicitly via monkeypatch after import.  Test-control
+# gates (not perf knobs) are kept.
+_KEEP = {"LIGHTGBM_TPU_SKIP_CAPI"}
+for _k in [k for k in os.environ
+           if k.startswith("LIGHTGBM_TPU_") and k not in _KEEP]:
+    del os.environ[_k]
+
 # Must happen before the first backend init.  The axon sitecustomize imports
 # jax at interpreter start with JAX_PLATFORMS=axon already captured, so the
 # env var alone is not enough — override through jax.config instead.

@@ -37,10 +37,16 @@ def run(n_rows: int, num_leaves: int, warmup: int, measure: int) -> None:
     # LIGHTGBM_TPU_FRONTIER_K overrides the frontier batch width (wide-K
     # + huge COMPACT_WASTE approximates sort-free level-ish growth)
     frontier_k = int(os.environ.get("LIGHTGBM_TPU_FRONTIER_K", "0"))
+    # LIGHTGBM_TPU_GAIN_RATIO overrides tpu_frontier_gain_ratio (per-round
+    # batching width: lower ratio = fewer/fuller rounds = less per-round
+    # while-carry copy traffic, at some best-first-ordering cost)
+    gain_ratio = os.environ.get("LIGHTGBM_TPU_GAIN_RATIO")
     cfg = Config(objective="binary", num_leaves=num_leaves, max_bin=63,
                  learning_rate=0.1, min_sum_hessian_in_leaf=100.0,
                  verbosity=-1, tpu_tree_impl=impl, tpu_row_chunk=row_chunk,
-                 tpu_frontier_width=frontier_k)
+                 tpu_frontier_width=frontier_k,
+                 **({"tpu_frontier_gain_ratio": float(gain_ratio)}
+                    if gain_ratio is not None else {}))
     ds = TpuDataset.from_numpy(X, y, config=cfg)
     obj = create_objective(cfg)
     obj.init(ds.metadata, ds.num_data)
@@ -61,8 +67,22 @@ def run(n_rows: int, num_leaves: int, warmup: int, measure: int) -> None:
         ran = "frontier" if impl == "frontier" else "segment"
     else:
         ran = "fused"
+    s = np.asarray(booster.train_score).ravel()[:n_rows]
+    order = np.argsort(s, kind="stable")
+    ranks = np.empty(n_rows)
+    ranks[order] = np.arange(1, n_rows + 1)
+    # midranks for ties (bench.py's correction: few distinct leaf-value
+    # sums early on make naive ranks row-order-dependent)
+    uniq, inv, cnt = np.unique(s, return_inverse=True, return_counts=True)
+    rank_sum = np.zeros(len(uniq))
+    np.add.at(rank_sum, inv, ranks)
+    ranks = (rank_sum / cnt)[inv]
+    n_pos = int((y > 0.5).sum())
+    auc = ((ranks[y > 0.5].sum() - n_pos * (n_pos + 1) / 2)
+           / (n_pos * (n_rows - n_pos)))
     print(f"PROBE rows={n_rows} leaves={num_leaves} impl={ran} "
-          f"warmup={t_warm:.1f}s per_iter={per_iter:.4f}s", flush=True)
+          f"warmup={t_warm:.1f}s per_iter={per_iter:.4f}s "
+          f"train_auc@{warmup + measure}it={auc:.5f}", flush=True)
     print("PROBE " + GLOBAL_TIMER.summary(), flush=True)
 
 
