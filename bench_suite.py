@@ -142,6 +142,19 @@ def _ndcg_at_10(pred, y, group):
     return total / max(nq, 1)
 
 
+def _impl_label(bst, requested: str) -> str:
+    """bench.py:142-151's labeling contract: report the grower that
+    ACTUALLY ran, and mark a pinned impl that fell back to fused so the
+    scoreboard never attributes fused numbers to it."""
+    req = str(requested).strip().lower()
+    if getattr(bst.gbdt, "_use_segment", False):
+        return "frontier" if req == "frontier" else "segment"
+    label = "fused"
+    if req not in ("auto", "fused"):
+        label += f" (requested {req})"
+    return label
+
+
 def run_child(config: str, platform: str, n_rows: int, warmup: int,
               measure: int) -> None:
     import jax
@@ -161,7 +174,11 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
     X, y, extra = gen(rng, n_rows)
     params = {"learning_rate": 0.1, "num_leaves": 255, "max_bin": 63,
               "min_sum_hessian_in_leaf": 100.0, "verbose": -1,
-              "objective": "regression"}
+              "objective": "regression",
+              # same A/B hook as bench.py: LIGHTGBM_TPU_IMPL pins the
+              # grower for impl comparisons (auto otherwise)
+              "tpu_tree_impl": os.environ.get("LIGHTGBM_TPU_IMPL",
+                                              "auto")}
     params.update(extra.get("params", {}))
     if config == "goss_regression":
         params["boosting"] = "goss"
@@ -238,8 +255,7 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
         "per_iter": round(per_iter, 5), "setup_s": round(t_setup, 2),
         "warmup_s": round(t_warm, 2), "quality": quality,
         "quality_ok": bool(ok),
-        "impl": ("segment" if getattr(bst.gbdt, "_use_segment", False)
-                 else "fused"),
+        "impl": _impl_label(bst, params["tpu_tree_impl"]),
     }))
 
 
@@ -255,53 +271,81 @@ def _cpu_env():
     return env
 
 
+def _run_child_record(config: str, platform: str, rows: int, warmup: int,
+                      measure: int, timeout_s: float,
+                      env: dict) -> dict | None:
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           config, platform, str(rows), str(warmup), str(measure)]
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout_s,
+                              capture_output=True, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"suite: {config}/{platform}/{rows} timed "
+                         f"out ({timeout_s}s)\n")
+        return None
+    sys.stderr.write(proc.stderr.decode(errors="replace")[-2000:])
+    if proc.returncode != 0:
+        sys.stderr.write(
+            f"suite: {config}/{platform}/{rows} rc={proc.returncode}\n")
+        return None
+    for line in proc.stdout.decode(errors="replace").splitlines():
+        if line.startswith(RESULT_TAG):
+            return json.loads(line[len(RESULT_TAG):])
+    return None
+
+
 def run_config(config: str, probe_ok: bool) -> dict | None:
     for platform, rows, warmup, measure, timeout_s in TIERS[config]:
         if platform == "tpu" and not probe_ok:
             continue
         env = (_cpu_env() if platform.startswith("cpu")
                else dict(os.environ))
-        cmd = [sys.executable, os.path.abspath(__file__), "--child",
-               config, platform, str(rows), str(warmup), str(measure)]
-        try:
-            proc = subprocess.run(cmd, env=env, timeout=timeout_s,
-                                  capture_output=True, cwd=REPO)
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(f"suite: {config}/{platform}/{rows} timed "
-                             f"out ({timeout_s}s)\n")
+        r = _run_child_record(config, platform, rows, warmup, measure,
+                              timeout_s, env)
+        if r is None:
             continue
-        sys.stderr.write(proc.stderr.decode(errors="replace")[-2000:])
-        if proc.returncode != 0:
-            sys.stderr.write(
-                f"suite: {config}/{platform}/{rows} rc={proc.returncode}\n")
-            continue
-        for line in proc.stdout.decode(errors="replace").splitlines():
-            if line.startswith(RESULT_TAG):
-                r = json.loads(line[len(RESULT_TAG):])
-                total = r["per_iter"] * TOTAL_ITERS_REF
-                ref = REF_500_ITERS_S.get(config)
-                out = {
-                    "config": config,
-                    "metric": f"{config}_{r['rows']}r_500iter_train_time_"
-                              f"{r['backend']}",
-                    "value": round(total, 2),
-                    "unit": "s",
-                    "impl": r["impl"],
-                    "quality": r["quality"],
-                    "quality_ok": r["quality_ok"],
-                }
-                if ref is not None:
-                    scaled = ref * r["rows"] / REF_ROWS.get(config,
-                                                            r["rows"])
-                    out["vs_baseline"] = round(total / scaled, 3)
-                if r["backend"] == "cpu" and platform == "tpu":
-                    out["fallback"] = True
-                if platform == "cpu-mesh":
-                    out["virtual_mesh"] = True
-                if platform.startswith("cpu") and "tpu" in (
-                        t[0] for t in TIERS[config]):
-                    out["fallback"] = True
-                return out
+        # bench.py:216's promotion contract for the suite: a TPU tier
+        # whose auto impl resolved to segment also measures the frontier
+        # grower and keeps it when it is faster at held quality, so a
+        # default (env-free) run reproduces the scoreboard numbers
+        if (platform == "tpu" and r["backend"] == "tpu"
+                and r["impl"] == "segment"
+                and "LIGHTGBM_TPU_IMPL" not in os.environ):
+            env2 = dict(env)
+            env2["LIGHTGBM_TPU_IMPL"] = "frontier"
+            r2 = _run_child_record(config, platform, rows, warmup,
+                                   measure, timeout_s, env2)
+            if (r2 is not None and r2["impl"] == "frontier"
+                    and r2["quality_ok"]
+                    and r2["per_iter"] < r["per_iter"]):
+                sys.stderr.write(
+                    f"suite A/B [{config}]: frontier "
+                    f"{r2['per_iter']:.4f} beats segment "
+                    f"{r['per_iter']:.4f} s/iter at held quality\n")
+                r = r2
+        total = r["per_iter"] * TOTAL_ITERS_REF
+        ref = REF_500_ITERS_S.get(config)
+        out = {
+            "config": config,
+            "metric": f"{config}_{r['rows']}r_500iter_train_time_"
+                      f"{r['backend']}",
+            "value": round(total, 2),
+            "unit": "s",
+            "impl": r["impl"],
+            "quality": r["quality"],
+            "quality_ok": r["quality_ok"],
+        }
+        if ref is not None:
+            scaled = ref * r["rows"] / REF_ROWS.get(config, r["rows"])
+            out["vs_baseline"] = round(total / scaled, 3)
+        if r["backend"] == "cpu" and platform == "tpu":
+            out["fallback"] = True
+        if platform == "cpu-mesh":
+            out["virtual_mesh"] = True
+        if platform.startswith("cpu") and "tpu" in (
+                t[0] for t in TIERS[config]):
+            out["fallback"] = True
+        return out
     return None
 
 
