@@ -110,7 +110,9 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
     cfg = Config(objective="binary", num_leaves=NUM_LEAVES, max_bin=MAX_BIN,
                  learning_rate=0.1, min_sum_hessian_in_leaf=100.0,
                  verbosity=-1,
-                 tpu_tree_impl=os.environ.get("LIGHTGBM_TPU_IMPL", "auto"))
+                 tpu_tree_impl=os.environ.get("LIGHTGBM_TPU_IMPL", "auto"),
+                 tpu_boost_chunk=int(os.environ.get(
+                     "LIGHTGBM_TPU_BOOST_CHUNK", "0")))
     t0 = time.time()
     ds = TpuDataset.from_numpy(X, y, config=cfg)
     t_bin = time.time() - t0
@@ -121,9 +123,23 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
     booster = GBDT(cfg, ds, obj)
     t_setup = time.time() - t0
 
+    # chunked dispatch (tpu_boost_chunk, LIGHTGBM_TPU_BOOST_CHUNK): run
+    # several iterations per device program with one batched fetch at the
+    # chunk boundary; chunk=1 is the classic per-iteration pipeline
+    chunk = booster.boost_chunk_size()
+
+    def run_iters(n: int) -> None:
+        done = 0
+        while done < n:
+            step = min(chunk, n - done)
+            if step > 1:
+                booster.train_chunk(step)
+            else:
+                booster.train_one_iter()
+            done += step
+
     t0 = time.time()
-    for _ in range(warmup):
-        booster.train_one_iter()
+    run_iters(warmup)
     jax.block_until_ready(booster.train_score)
     t_warm = time.time() - t0
 
@@ -132,8 +148,7 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
     GLOBAL_TIMER.reset()   # phase summary covers only the measured window
     maybe_start_profile()
     t0 = time.time()
-    for _ in range(measure):
-        booster.train_one_iter()
+    run_iters(measure)
     jax.block_until_ready(booster.train_score)
     per_iter = (time.time() - t0) / measure
     maybe_stop_profile()
@@ -184,7 +199,7 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
         "frontier" if impl == "frontier" else "segment")
     print(RESULT_TAG + json.dumps(
         {"per_iter": per_iter, "rows": n_rows, "backend": backend,
-         "impl": impl, "auc": round(auc, 5),
+         "impl": impl, "auc": round(auc, 5), "chunk": chunk,
          # full-run accounting for the north-star math: a real 500-iter
          # run pays these once (t_warm is COLD here; a warm-cache rerun
          # of the same child shows the persistent-cache number)
@@ -194,10 +209,13 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
 
 
 def run_tier(platform: str, rows: int, warmup: int, measure: int,
-             timeout_s: float, impl_env: str | None = None):
+             timeout_s: float, impl_env: str | None = None,
+             chunk_env: str | None = None):
     env = _cpu_env() if platform == "cpu" else dict(os.environ)
     if impl_env is not None:
         env["LIGHTGBM_TPU_IMPL"] = impl_env
+    if chunk_env is not None:
+        env["LIGHTGBM_TPU_BOOST_CHUNK"] = chunk_env
     cmd = [sys.executable, os.path.abspath(__file__), "--child", platform,
            str(rows), str(warmup), str(measure)]
     proc = subprocess.run(cmd, env=env, timeout=timeout_s,
@@ -244,6 +262,41 @@ def maybe_ab_frontier(r, platform, rows, warmup, measure, timeout_s):
     return r
 
 
+def maybe_ab_chunked(r, platform, rows, warmup, measure, timeout_s):
+    """After a successful tier, also measure the chunked boosting loop
+    (tpu_boost_chunk: several iterations per device program, tree fetches
+    batched at the chunk boundary) and keep the faster result at equal
+    training quality.  The chunked and unchunked paths grow bit-identical
+    trees (same PRNG stream, same fused step), so the auc gate is a
+    safety net, not a tradeoff.  Skipped when the caller pinned a chunk
+    size via LIGHTGBM_TPU_BOOST_CHUNK or the tier already ran chunked."""
+    if os.environ.get("LIGHTGBM_TPU_BOOST_CHUNK") or r.get("chunk", 1) > 1:
+        return r
+    # whole number of chunks inside the measured window keeps per_iter
+    # comparable; the winning impl from the frontier A/B is pinned so
+    # both sides of THIS comparison run the same grower
+    chunk = max(2, min(8, measure))
+    impl_pin = os.environ.get("LIGHTGBM_TPU_IMPL")
+    if impl_pin is None and r.get("impl") in ("frontier", "segment"):
+        impl_pin = r["impl"]
+    try:
+        r2 = run_tier(platform, rows, warmup, measure, timeout_s,
+                      impl_env=impl_pin, chunk_env=str(chunk))
+    except Exception as e:  # noqa: BLE001 — A/B must not kill the bench
+        sys.stderr.write(f"bench: chunked A/B failed: "
+                         f"{type(e).__name__}: {str(e)[-300:]}\n")
+        return r
+    sys.stderr.write(
+        f"bench A/B: chunk=1 per_iter={r['per_iter']:.4f} "
+        f"auc={r.get('auc')} vs chunk={r2.get('chunk')} "
+        f"per_iter={r2['per_iter']:.4f} auc={r2.get('auc')}\n")
+    quality_ok = (r2.get("auc") is None or r.get("auc") is None
+                  or r2["auc"] >= r["auc"] - 0.002)
+    if quality_ok and r2["per_iter"] < r["per_iter"]:
+        return r2
+    return r
+
+
 def main():
     want_tpu = (not os.environ.get("BENCH_SKIP_TPU")) and probe_tpu()
     for platform, rows, warmup, measure, timeout_s in TIERS:
@@ -256,6 +309,7 @@ def main():
                              f"{type(e).__name__}: {str(e)[-400:]}\n")
             continue
         r = maybe_ab_frontier(r, platform, rows, warmup, measure, timeout_s)
+        r = maybe_ab_chunked(r, platform, rows, warmup, measure, timeout_s)
         total_500 = r["per_iter"] * TOTAL_ITERS_REF
         baseline = BASELINE_500_ITERS_S_10M5 * (r["rows"] / 10_500_000)
         sys.stderr.write(
@@ -269,6 +323,7 @@ def main():
             "unit": "s",
             "vs_baseline": round(total_500 / baseline, 3),
             "impl": r["impl"],
+            "chunk": r.get("chunk", 1),
             "train_auc": r.get("auc"),
             "warmup_s": r.get("warmup_s"),
             "full_500_incl_overheads_s": r.get(

@@ -175,10 +175,13 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
     params = {"learning_rate": 0.1, "num_leaves": 255, "max_bin": 63,
               "min_sum_hessian_in_leaf": 100.0, "verbose": -1,
               "objective": "regression",
-              # same A/B hook as bench.py: LIGHTGBM_TPU_IMPL pins the
-              # grower for impl comparisons (auto otherwise)
+              # same A/B hooks as bench.py: LIGHTGBM_TPU_IMPL pins the
+              # grower, LIGHTGBM_TPU_BOOST_CHUNK pins the chunk size
+              # (0 = auto; GOSS/mesh configs self-clamp to 1)
               "tpu_tree_impl": os.environ.get("LIGHTGBM_TPU_IMPL",
-                                              "auto")}
+                                              "auto"),
+              "tpu_boost_chunk": int(os.environ.get(
+                  "LIGHTGBM_TPU_BOOST_CHUNK", "0"))}
     params.update(extra.get("params", {}))
     if config == "goss_regression":
         params["boosting"] = "goss"
@@ -193,22 +196,31 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
     t0 = time.time()
     bst = lgb.Booster(params, ds)
     t_setup = time.time() - t0
+    chunk = bst.gbdt.boost_chunk_size()
+
+    def run_iters(n: int) -> None:
+        done = 0
+        while done < n:
+            step = min(chunk, n - done)
+            if step > 1:
+                bst.update_chunk(step)
+            else:
+                bst.update()
+            done += step
+
     t0 = time.time()
-    for _ in range(warmup):
-        bst.update()
+    run_iters(warmup)
     jax.block_until_ready(bst.gbdt.train_score)
     t_warm = time.time() - t0
     t0 = time.time()
-    for _ in range(measure):
-        bst.update()
+    run_iters(measure)
     jax.block_until_ready(bst.gbdt.train_score)
     per_iter = (time.time() - t0) / measure
 
     # quality gates are calibrated at a FIXED 25-iteration budget so the
     # same floor applies to every tier (timing above covers only the
     # measured window; a 2+4-iteration model is too early to gate on)
-    for _ in range(max(0, 25 - warmup - measure)):
-        bst.update()
+    run_iters(max(0, 25 - warmup - measure))
     pred = bst.predict(X[:200_000])
     quality: dict = {}
     ok = True
@@ -256,6 +268,7 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
         "warmup_s": round(t_warm, 2), "quality": quality,
         "quality_ok": bool(ok),
         "impl": _impl_label(bst, params["tpu_tree_impl"]),
+        "chunk": chunk,
     }))
 
 
@@ -332,6 +345,7 @@ def run_config(config: str, probe_ok: bool) -> dict | None:
             "value": round(total, 2),
             "unit": "s",
             "impl": r["impl"],
+            "chunk": r.get("chunk", 1),
             "quality": r["quality"],
             "quality_ok": r["quality_ok"],
         }

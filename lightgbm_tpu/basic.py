@@ -424,6 +424,13 @@ class Booster:
                                             np.asarray(hess))
         return self.gbdt.train_one_iter()
 
+    def update_chunk(self, chunk: int) -> bool:
+        """Run up to ``chunk`` boosting iterations as one on-device
+        program with tree fetches batched at the chunk boundary
+        (tpu_boost_chunk); falls back to a single iteration when the
+        configuration needs per-iteration host work."""
+        return self.gbdt.train_chunk(int(chunk))
+
     def rollback_one_iter(self) -> "Booster":
         self.gbdt.rollback_one_iter()
         return self

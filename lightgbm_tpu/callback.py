@@ -38,9 +38,19 @@ def _format_eval_result(value, show_stdv: bool = True) -> str:
 
 
 def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    last_seen = [-1]
+
     def _callback(env: CallbackEnv) -> None:
-        if (period > 0 and env.evaluation_result_list
-                and (env.iteration + 1) % period == 0):
+        if period <= 0 or not env.evaluation_result_list:
+            return
+        # fire when a period boundary was crossed since the previous call:
+        # identical to (iteration + 1) % period == 0 under per-iteration
+        # stepping, and never skips a boundary under chunked stepping,
+        # where env.iteration advances several rounds at a time
+        crossed = ((env.iteration + 1) // period
+                   > (last_seen[0] + 1) // period)
+        last_seen[0] = env.iteration
+        if crossed:
             result = "\t".join(
                 _format_eval_result(x, show_stdv)
                 for x in env.evaluation_result_list)

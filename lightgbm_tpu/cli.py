@@ -113,8 +113,21 @@ class Application:
 
         log_info(f"Started training for {cfg.num_iterations} iterations")
         start = time.perf_counter()
-        for it in range(cfg.num_iterations):
-            stop = booster.train_one_iter()
+        # Chunked stepping (tpu_boost_chunk): the step is clamped so it
+        # never crosses a metric/snapshot boundary — chunk-granularity
+        # reporting keeps exactly the per-iteration schedule.
+        chunk = booster.boost_chunk_size()
+        freqs = [f for f in ((cfg.metric_freq if metric_names else 0),
+                             cfg.snapshot_freq) if f > 0]
+        done = 0
+        while done < cfg.num_iterations:
+            step = min(chunk, cfg.num_iterations - done)
+            for f in freqs:
+                step = min(step, f - done % f)
+            stop = (booster.train_chunk(step) if step > 1
+                    else booster.train_one_iter())
+            it = done + step - 1
+            done += step
             if (cfg.metric_freq > 0 and (it + 1) % cfg.metric_freq == 0
                     and metric_names):
                 if cfg.is_provide_training_metric:

@@ -181,6 +181,13 @@ _PARAMS: Dict[str, _P] = {
     # while-carry copies (0.766 -> 0.709 s/iter) at equal train AUC
     # (0.97110 vs 0.97102 @6it, within the bench A/B's 0.002 gate)
     "tpu_frontier_gain_ratio": _P(0.0),
+    # boosting iterations dispatched as ONE device program (lax.scan over
+    # the fused step), with tree fetches batched at the chunk boundary.
+    # 0 = auto (chunk on TPU when the run is chunk-eligible, 1 elsewhere);
+    # 1 disables chunking.  Auto-clamps to 1 when the iteration needs host
+    # interaction (bagging re-draws, feature_fraction sampling, DART/RF
+    # tree mutation, CEGB state, custom gradients, per-iter callbacks).
+    "tpu_boost_chunk": _P(0, ["boost_chunk"]),
     "tpu_double_precision": _P(False),     # accumulate histograms in f64-equivalent
 }
 

@@ -77,7 +77,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 continue
             vs.reference = train_set
             booster.add_valid(vs, name)
-    callbacks = list(callbacks or [])
+    user_callbacks = list(callbacks or [])
+    callbacks = list(user_callbacks)
     if verbose_eval is True:
         callbacks.append(callback_mod.print_evaluation())
     elif isinstance(verbose_eval, int) and verbose_eval > 0:
@@ -97,13 +98,38 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     train_in_valid = getattr(booster, "_train_in_valid", False)
 
-    for i in range(num_boost_round):
+    # Chunked boosting: several iterations per device dispatch, with eval,
+    # early stopping, and after-callbacks firing at CHUNK boundaries
+    # (env.iteration = the chunk's last iteration).  An explicit
+    # tpu_boost_chunk > 1 opts eval into that granularity; the auto
+    # setting (0) never changes a run's eval/callback cadence.  Any
+    # before-iteration callback (e.g. reset_parameter) or custom fobj
+    # interacts with the host every round and forces per-iteration
+    # stepping; bagging/DART/GOSS clamps live in GBDT.boost_chunk_size.
+    chunk = booster.gbdt.boost_chunk_size()
+    if chunk > 1:
+        has_eval = bool(booster._valid_names or train_in_valid)
+        user_after = [cb for cb in user_callbacks
+                      if not getattr(cb, "before_iteration", False)]
+        if callbacks_before or fobj is not None:
+            chunk = 1
+        elif (int(booster.gbdt.config.tpu_boost_chunk) == 0
+              and (has_eval or user_after)):
+            chunk = 1
+
+    i = 0
+    while i < num_boost_round:
+        step = min(chunk, num_boost_round - i)
         for cb in callbacks_before:
             cb(callback_mod.CallbackEnv(
                 model=booster, params=params, iteration=i,
                 begin_iteration=0, end_iteration=num_boost_round,
                 evaluation_result_list=None))
-        should_stop = booster.update(fobj=fobj)
+        if step > 1:
+            should_stop = booster.update_chunk(step)
+        else:
+            should_stop = booster.update(fobj=fobj)
+        it = i + step - 1
 
         evaluation_result_list = []
         if booster._valid_names or train_in_valid:
@@ -113,7 +139,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         try:
             for cb in callbacks_after:
                 cb(callback_mod.CallbackEnv(
-                    model=booster, params=params, iteration=i,
+                    model=booster, params=params, iteration=it,
                     begin_iteration=0, end_iteration=num_boost_round,
                     evaluation_result_list=evaluation_result_list))
         except callback_mod.EarlyStopException as e:
@@ -123,6 +149,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             break
         if should_stop:
             break
+        i += step
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.gbdt.current_iteration()
     return booster

@@ -15,7 +15,7 @@ scalars plus optional leaf renewal / validation-set prediction.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,9 +27,19 @@ from ..ops.split import FeatureMeta, SplitParams
 from ..utils.log import check, log_fatal, log_info, log_warning
 from ..utils.phase import GLOBAL_TIMER as _PHASES
 from .grower import (GrowerParams, _pack_tree_device, fetch_tree_arrays,
-                     make_grow_tree, unpack_tree_buffers)
+                     fetch_tree_chunk, make_grow_tree, unpack_tree_buffers)
 from .grower_seg import print_seg_stats, seg_stats_enabled
 from .tree import Tree
+
+
+class _PendingChunk(NamedTuple):
+    """A chunk of ``length`` dispatched-but-unfetched iterations: the
+    scan's stacked [T, C, len_ints]/[T, C, len_floats] device buffers,
+    materialized host-side in two transfers at the chunk boundary."""
+    ints_all: jax.Array
+    floats_all: jax.Array
+    shrinkage: float
+    length: int
 
 
 def _maybe_print_seg_stats(stats) -> None:
@@ -466,7 +476,10 @@ class GBDT:
         self._full_fmask = jnp.ones(train_set.num_used_features,
                                     dtype=jnp.float32)
         self._fused_fns = None
+        self._fused_core = None
         self._obj_arrs = None
+        self._chunk_fns: Dict[int, object] = {}
+        self._shr_dev: Dict[float, jax.Array] = {}
 
     def _replay_model_scores(self, dataset: TpuDataset) -> np.ndarray:
         """[C, N] f64 raw scores of the current model on ``dataset``: the
@@ -589,6 +602,15 @@ class GBDT:
     # jitted dispatch per tree) — subclasses whose bagging cannot run as
     # a device-side transform of the gradients opt out
     _fused_ok = True
+    # the chunked loop (train_chunk) additionally requires every
+    # per-iteration decision to live on device; subclasses whose _bagging
+    # transforms gradients with host-side dispatch each iteration (GOSS)
+    # opt out
+    _chunk_capable = True
+    # test seam: zero-arg context-manager factory wrapped around the chunk
+    # dispatch (tests install jax.transfer_guard("disallow") here to prove
+    # the chunk body never touches the host)
+    _chunk_guard = None
 
     def _build_fused_step(self):
         """One jitted call per (gradient pass, per-class tree).  Keeping the
@@ -628,14 +650,15 @@ class GBDT:
                 for k, v in saved.items():
                     setattr(obj, k, v)
 
-        @jax.jit
-        def fused_grad(score, arrs):
+        def grad_core(score, arrs):
             def run():
                 if C == 1:
                     g, h = obj.get_gradients(score[0])
                     return g[None], h[None]
                 return obj.get_gradients(score)
             return _with_arrs(run, arrs)
+
+        fused_grad = jax.jit(grad_core)
 
         # multiclass batched roots: all C class-trees' root histograms in
         # ONE kernel pass (C x fewer full-data scans per iteration; the
@@ -656,8 +679,7 @@ class GBDT:
             # chunk the classes when num_class exceeds the budget
             cap = channel_set_capacity(G_cols, self.num_bins, rb_)
 
-            @jax.jit
-            def fused_roots(grads, hesss, member, bins):
+            def roots_core(grads, hesss, member, bins):
                 if pad:
                     grads = jnp.pad(grads, ((0, 0), (0, pad)))
                     hesss = jnp.pad(hesss, ((0, 0), (0, pad)))
@@ -675,8 +697,10 @@ class GBDT:
                     outs.append(out)
                 out = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
                 return jax.vmap(unpack_hist)(out)[:, :G_cols]
+
+            fused_roots = jax.jit(roots_core)
         else:
-            fused_roots = None
+            fused_roots = roots_core = None
 
         # Resolve the scorer choice OUTSIDE the trace: the auto mode
         # runs a real on-device self-check (lowering + bit-exactness)
@@ -687,9 +711,8 @@ class GBDT:
         else:
             use_score_kernel = False
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def fused_step(score, grads, hesss, member, bins, fmeta, fmask,
-                       sub, shrinkage, k, roots=None):
+        def step_core(score, grads, hesss, member, bins, fmeta, fmask,
+                      sub, shrinkage, k, roots=None):
             g_k, h_k = grads[k], hesss[k]
             if pad:
                 g_k = jnp.pad(g_k, (0, pad))
@@ -713,7 +736,57 @@ class GBDT:
             ints_d, floats_d = _pack_tree_device(arrays)
             return score, ints_d, floats_d, tuple(stats)
 
+        fused_step = functools.partial(jax.jit,
+                                       donate_argnums=(0,))(step_core)
+
         self._fused_fns = (fused_grad, fused_step, fused_roots)
+        # un-jitted building blocks; the chunked loop retraces them inside
+        # its scan so a chunk body is op-for-op the per-iteration fused
+        # path (bit-identical trees at any chunk size)
+        self._fused_core = (grad_core, step_core, roots_core)
+
+    def _get_chunk_fn(self, T: int):
+        """One jitted program running ``T`` boosting iterations as a
+        lax.scan over the fused step, stacking each iteration's packed
+        tree buffers into [T, C, ...] on-device outputs.  The score and
+        PRNG-key carries are donated so no buffer copies accumulate
+        across chunks."""
+        fn = self._chunk_fns.get(T)
+        if fn is not None:
+            return fn
+        import functools
+        if self._fused_core is None:
+            self._build_fused_step()
+        grad_core, step_core, roots_core = self._fused_core
+        C = self.num_tree_per_iteration
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def chunk_run(score, key, member, bins, fmeta, fmask, shrinkage,
+                      arrs):
+            def body(carry, _):
+                score, key = carry
+                grads, hesss = grad_core(score, arrs)
+                roots = (roots_core(grads, hesss, member, bins)
+                         if roots_core is not None else None)
+                ints_l, floats_l = [], []
+                for k in range(C):
+                    # same key stream as the per-iteration paths, so the
+                    # same seed grows the same trees at any chunk size
+                    key, sub = jax.random.split(key)
+                    score, ints_d, floats_d, _ = step_core(
+                        score, grads, hesss, member, bins, fmeta, fmask,
+                        sub, shrinkage, jnp.int32(k), roots)
+                    ints_l.append(ints_d)
+                    floats_l.append(floats_d)
+                return ((score, key),
+                        (jnp.stack(ints_l), jnp.stack(floats_l)))
+
+            (score, key), (ints_all, floats_all) = jax.lax.scan(
+                body, (score, key), None, length=T)
+            return score, key, ints_all, floats_all
+
+        self._chunk_fns[T] = chunk_run
+        return chunk_run
 
     @property
     def models(self) -> List[Tree]:
@@ -725,40 +798,81 @@ class GBDT:
         self._models = list(value)
         self._pending = []
 
+    def _entry_iter_arrays(self, entry):
+        """Normalize one pending entry into per-iteration host pytrees:
+        [(iter_idx, [(TreeArrays, shrinkage)] * C)].  A chunk entry fetches
+        its stacked [T, C, ...] buffers here — two host transfers for the
+        WHOLE chunk (the async copy started at dispatch), then pure numpy
+        slicing."""
+        iter_idx, payload = entry
+        L = self.grower_params.num_leaves
+        if isinstance(payload, _PendingChunk):
+            chunk = fetch_tree_chunk(payload.ints_all, payload.floats_all,
+                                     L)
+            return [(iter_idx + t,
+                     [(arrays, payload.shrinkage) for arrays in per_class])
+                    for t, per_class in enumerate(chunk)]
+        return [(iter_idx,
+                 [(unpack_tree_buffers(np.asarray(ints_d),
+                                       np.asarray(floats_d), L), lr)
+                  for (ints_d, floats_d, lr) in payload])]
+
+    def _materialize_iter(self, pairs):
+        """One iteration's [(TreeArrays, shrinkage)] -> (trees, all_const);
+        constant outputs become Tree(1) placeholders."""
+        trees = []
+        all_const = True
+        for arrays, lr in pairs:
+            if int(arrays.num_leaves) <= 1:
+                trees.append(Tree(1))
+            else:
+                all_const = False
+                trees.append(Tree.from_grown(arrays, self.train_set, lr))
+        return trees, all_const
+
+    def _apply_valid_scores(self, trees) -> None:
+        """Fold freshly-materialized trees into the valid-set score
+        buffers.  The per-iteration async path never has valid sets
+        attached (train_one_iter routes eager then); this feeds the
+        chunked path, whose boundary flush must leave eval_valid
+        current."""
+        if not self.valid_sets:
+            return
+        infos = self.train_set.feature_infos()
+        for (vname, vset), vscore in zip(self.valid_sets,
+                                         self.valid_scores):
+            for k, tree in enumerate(trees):
+                if tree.num_leaves > 1:
+                    vscore[k] += tree.predict_binned(vset.binned, infos)
+
     def _flush_pending(self, keep_latest: int = 0) -> None:
         """Materialize in-flight trees (oldest first) into self._models.
 
         A fully-constant iteration means training stopped there: its trees
         and every later pending iteration's are discarded (their score
         deltas undone), matching the reference's drop of the all-constant
-        iteration (gbdt.cpp:543-551) — just detected one iteration late.
+        iteration (gbdt.cpp:543-551) — just detected one iteration (or
+        chunk) late.
         """
         while len(self._pending) > keep_latest:
-            iter_idx, items = self._pending.pop(0)
-            trees = []
-            all_const = True
-            for (ints_d, floats_d, lr) in items:
-                arrays = unpack_tree_buffers(
-                    np.asarray(ints_d), np.asarray(floats_d),
-                    self.grower_params.num_leaves)
-                if int(arrays.num_leaves) <= 1:
-                    trees.append(Tree(1))
-                else:
-                    all_const = False
-                    tree = Tree.from_arrays(arrays, self.train_set)
-                    tree.apply_shrinkage(lr)
-                    trees.append(tree)
-            if all_const:
-                self._undo_pending_scores([(iter_idx, trees)]
-                                          + self._materialize_rest())
-                self._pending = []
-                self._stop_flag = True
-                self.iter_ = iter_idx
-                log_warning("Stopped training because there are no more "
-                            "leaves that meet the split requirements")
-                return
-            self._models.extend(trees)
-            self._note_trees(trees)
+            per_iter = self._entry_iter_arrays(self._pending.pop(0))
+            for j, (iter_idx, pairs) in enumerate(per_iter):
+                trees, all_const = self._materialize_iter(pairs)
+                if all_const:
+                    rest = [(ii, self._materialize_iter(pp)[0])
+                            for ii, pp in per_iter[j + 1:]]
+                    self._undo_pending_scores([(iter_idx, trees)] + rest
+                                              + self._materialize_rest())
+                    self._pending = []
+                    self._stop_flag = True
+                    self.iter_ = iter_idx
+                    log_warning("Stopped training because there are no "
+                                "more leaves that meet the split "
+                                "requirements")
+                    return
+                self._models.extend(trees)
+                self._note_trees(trees)
+                self._apply_valid_scores(trees)
 
     def _note_trees(self, trees) -> None:
         """Record which features the model has split on, feeding the next
@@ -779,19 +893,9 @@ class GBDT:
 
     def _materialize_rest(self):
         out = []
-        for iter_idx, items in self._pending:
-            trees = []
-            for (ints_d, floats_d, lr) in items:
-                arrays = unpack_tree_buffers(
-                    np.asarray(ints_d), np.asarray(floats_d),
-                    self.grower_params.num_leaves)
-                if int(arrays.num_leaves) <= 1:
-                    trees.append(Tree(1))
-                else:
-                    tree = Tree.from_arrays(arrays, self.train_set)
-                    tree.apply_shrinkage(lr)
-                    trees.append(tree)
-            out.append((iter_idx, trees))
+        for entry in self._pending:
+            for iter_idx, pairs in self._entry_iter_arrays(entry):
+                out.append((iter_idx, self._materialize_iter(pairs)[0]))
         return out
 
     def _undo_pending_scores(self, iter_trees) -> None:
@@ -870,13 +974,7 @@ class GBDT:
                                           jnp.float32(self.shrinkage_rate)))
                     box[0] = self.train_score
                 ints_d, floats_d = _pack_tree_device(arrays)
-                for buf in (ints_d, floats_d):
-                    copy_async = getattr(buf, "copy_to_host_async", None)
-                    if copy_async is not None:
-                        try:
-                            copy_async()
-                        except Exception:
-                            pass
+                self._start_host_copy(ints_d, floats_d)
                 items.append((ints_d, floats_d, self.shrinkage_rate))
             self._pending.append((self.iter_, items))
             self.iter_ += 1
@@ -976,13 +1074,7 @@ class GBDT:
                     jnp.float32(self.shrinkage_rate), jnp.int32(k), *extra)
                 box[0] = self.train_score
             _maybe_print_seg_stats(stats_t)
-            for buf in (ints_d, floats_d):
-                copy_async = getattr(buf, "copy_to_host_async", None)
-                if copy_async is not None:
-                    try:
-                        copy_async()
-                    except Exception:
-                        pass
+            self._start_host_copy(ints_d, floats_d)
             items.append((ints_d, floats_d, self.shrinkage_rate))
         self._pending.append((self.iter_, items))
         self.iter_ += 1
@@ -990,6 +1082,99 @@ class GBDT:
             # CEGB coupled penalties need this iteration's splits noted
             # before the next grow call, so forgo the one-deep pipeline
             keep = 0 if self.grower_params.use_cegb_coupled else 1
+            self._flush_pending(keep_latest=keep)
+        return bool(self._stop_flag)
+
+    # ---------------------------------------------------------- chunked loop
+    @staticmethod
+    def _start_host_copy(*bufs) -> None:
+        """Kick off the device->host DMA early so the later blocking
+        np.asarray finds the bytes already on their way."""
+        for buf in bufs:
+            copy_async = getattr(buf, "copy_to_host_async", None)
+            if copy_async is not None:
+                try:
+                    copy_async()
+                except Exception:
+                    pass
+
+    def _chunk_ok(self) -> bool:
+        """Whether multiple iterations can run without host interaction
+        between them — the conditions under which tpu_boost_chunk
+        auto-clamps to 1."""
+        cfg = self.config
+        if not (self._async_trees and self._fused_ok
+                and self._chunk_capable and self.objective is not None):
+            return False
+        if self.objective.is_renew_tree_output:
+            return False        # leaf renewal runs host percentile fits
+        if getattr(self, "_mesh", None) is not None:
+            return False        # distributed learners keep per-iter dispatch
+        if cfg.feature_fraction < 1.0:
+            return False        # per-tree host RNG (GetUsedFeatures)
+        if cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0
+                                     or cfg.pos_bagging_fraction < 1.0
+                                     or cfg.neg_bagging_fraction < 1.0):
+            return False        # per-iteration host bagging re-draw
+        if (self.grower_params.use_cegb_coupled
+                or self.grower_params.use_cegb_lazy):
+            return False        # split bookkeeping feeds the next grow
+        if seg_stats_enabled():
+            return False        # per-iteration counter printing
+        return True
+
+    def boost_chunk_size(self) -> int:
+        """Resolved tpu_boost_chunk: an explicit value wins; auto (0)
+        chunks on the TPU backend — where every dispatch and fetch pays a
+        transport round-trip — and stays at 1 elsewhere.  Always 1 when
+        the run needs host interaction between iterations (_chunk_ok)."""
+        if self.train_set is None or not self._chunk_ok():
+            return 1
+        req = int(self.config.tpu_boost_chunk)
+        if req != 0:
+            return max(1, req)
+        return 16 if jax.default_backend() == "tpu" else 1
+
+    def train_chunk(self, chunk: int) -> bool:
+        """Run up to ``chunk`` boosting iterations as ONE device program
+        (lax.scan over the fused step), deferring every device->host tree
+        fetch to the chunk boundary, where it overlaps the next chunk's
+        device work.  Falls back to train_one_iter when the configuration
+        needs host interaction mid-chunk.  Returns True when training
+        stopped."""
+        T = int(chunk)
+        if self._stop_flag:
+            return True
+        if (T <= 1 or not self._chunk_ok()
+                or self.train_set.num_used_features == 0):
+            return self.train_one_iter()
+        self._boost_from_average()
+        fn = self._get_chunk_fn(T)
+        shr = self._shr_dev.get(self.shrinkage_rate)
+        if shr is None:
+            # device-resident constant: materialized OUTSIDE the guarded
+            # dispatch so the chunk body itself stays transfer-free
+            shr = jnp.float32(self.shrinkage_rate)
+            self._shr_dev[self.shrinkage_rate] = shr
+        args = (self.train_score, self._key, self.bag_weight, self.bins,
+                self.fmeta, self._full_fmask, shr, self._obj_arrs)
+        with _PHASES.phase("chunk") as box:
+            if self._chunk_guard is not None:
+                with self._chunk_guard():
+                    out = fn(*args)
+            else:
+                out = fn(*args)
+            self.train_score, self._key, ints_all, floats_all = out
+            box[0] = self.train_score
+        self._start_host_copy(ints_all, floats_all)
+        self._pending.append((self.iter_, _PendingChunk(
+            ints_all, floats_all, self.shrinkage_rate, T)))
+        self.iter_ += T
+        with _PHASES.phase("fetch"):
+            # valid-set scores update at materialization, and eval at the
+            # chunk boundary needs the chunk just dispatched — so forgo
+            # the one-chunk-deep pipeline when valid sets are attached
+            keep = 0 if self.valid_sets else 1
             self._flush_pending(keep_latest=keep)
         return bool(self._stop_flag)
 

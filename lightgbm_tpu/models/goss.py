@@ -54,6 +54,11 @@ class GOSS(GBDT):
     # so it rides the fused pipeline: gradient dispatch, one sampling
     # dispatch (skipped in warm-up), then the per-class fused grow+score
 
+    # the sampling dispatch runs between gradients and grow each
+    # iteration (with an iter_idx-dependent warm-up switch), which the
+    # single-program chunked loop does not replicate
+    _chunk_capable = False
+
     def __init__(self, config, train_set, objective=None):
         super().__init__(config, train_set, objective)
         check(config.top_rate + config.other_rate <= 1.0,

@@ -130,6 +130,19 @@ def fetch_tree_arrays(t: TreeArrays) -> TreeArrays:
                                t.leaf_value.shape[0])
 
 
+def fetch_tree_chunk(ints_all, floats_all, L: int) -> list:
+    """Batched inverse of _pack_tree_device over a whole boosting chunk:
+    stacked [T, C, len] device buffers -> [[TreeArrays] * C] * T host
+    pytrees.  The entire chunk crosses the device boundary in TWO
+    transfers; fetching tree-by-tree would pay 2*T*C round-trips."""
+    import numpy as np
+    ints_np = np.asarray(ints_all)
+    floats_np = np.asarray(floats_all)
+    return [[unpack_tree_buffers(ints_np[t, k], floats_np[t, k], L)
+             for k in range(ints_np.shape[1])]
+            for t in range(ints_np.shape[0])]
+
+
 def unpack_tree_buffers(ints, floats, L: int) -> TreeArrays:
     """Host-side inverse of _pack_tree_device."""
     import numpy as np

@@ -149,6 +149,15 @@ class Tree:
             t.decision_type[i] = dt
         return t
 
+    @classmethod
+    def from_grown(cls, arrays, dataset, shrinkage: float) -> "Tree":
+        """Finalize one freshly-grown tree: bin->value realization plus
+        learning-rate shrinkage — the materialization unit the boosting
+        fetch pipeline applies to every tree it pulls off the device."""
+        t = cls.from_arrays(arrays, dataset)
+        t.apply_shrinkage(shrinkage)
+        return t
+
     # ------------------------------------------------------------ prediction
     def _decide(self, fval: np.ndarray, nodes: np.ndarray) -> np.ndarray:
         """go-left decision for rows at internal ``nodes`` with raw values

@@ -45,6 +45,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases; accept
+# either so the fused kernels lower on both sides of the rename
+_TPUCompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 import os as _os
 
 NUM_CHANNELS = 8
@@ -936,7 +941,7 @@ def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
         # set past Mosaic's 16 MB default scoped-vmem limit at
         # production shapes (measured 17.14 MB, v5e); the chip has
         # 128 MB
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_TPUCompilerParams(
             vmem_limit_bytes=_FUSED_VMEM_LIMIT),
         interpret=interpret,
     )(scalars, binsT, w8, frow, leaf_id.reshape(1, -1))
@@ -1056,7 +1061,7 @@ def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
         # see histogram_segment_routed: the K frow rows + lid streams
         # exceed the 16 MB default scoped-vmem limit at K=16 production
         # shapes
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_TPUCompilerParams(
             vmem_limit_bytes=_FUSED_VMEM_LIMIT),
         interpret=interpret,
     )(scalars, binsT, w8, frows, leaf_id.reshape(1, -1))
@@ -1249,6 +1254,28 @@ def _route_kernel_self_check() -> bool:
         win[rb:4 * rb] = True
         exp[(exp == 3) & ~go_left & win] = 9
         if not np.array_equal(np.asarray(lid2), exp):
+            return False
+    # packed4: the in-kernel route must unpack the split column by
+    # nibble parity (both parities), on 4-bit bins
+    bins4 = jnp.asarray(rng.integers(0, 15, (F, n)), jnp.uint8)
+    packedT = jnp.asarray(pack_bins_4bit(bins4))
+
+    class _M4(_M):
+        num_bin = jnp.full((4,), 15, jnp.int32)
+        missing_type = jnp.zeros(4, jnp.int32)
+        default_bin = jnp.zeros(4, jnp.int32)
+
+    for f in (1, 2):   # odd = high nibble, even = low
+        route = pack_route(3, 9, f, 7, False, False,
+                           jnp.zeros(8, jnp.uint32), _M4, True)
+        lid4 = route_window(packedT, lid, jnp.int32(1), jnp.int32(3),
+                            route, rb, packed4=True)
+        fcol = np.asarray(bins4[f]).astype(np.int64)
+        exp4 = np.asarray(lid).copy()
+        win = np.zeros(n, bool)
+        win[rb:4 * rb] = True
+        exp4[(exp4 == 3) & (fcol > 7) & win] = 9
+        if not np.array_equal(np.asarray(lid4), exp4):
             return False
     return True
 

@@ -14,9 +14,15 @@ import os
 # knob set it explicitly via monkeypatch after import.  Test-control
 # gates (not perf knobs) are kept.
 _KEEP = {"LIGHTGBM_TPU_SKIP_CAPI"}
-for _k in [k for k in os.environ
-           if k.startswith("LIGHTGBM_TPU_") and k not in _KEEP]:
+_scrubbed = [k for k in os.environ
+             if k.startswith("LIGHTGBM_TPU_") and k not in _KEEP]
+for _k in _scrubbed:
     del os.environ[_k]
+if _scrubbed:
+    import sys as _sys
+    _sys.stderr.write(
+        "conftest: scrubbed env knobs: " + ", ".join(sorted(_scrubbed))
+        + "\n")
 
 # Must happen before the first backend init.  The axon sitecustomize imports
 # jax at interpreter start with JAX_PLATFORMS=axon already captured, so the

@@ -95,3 +95,42 @@ def test_ab_skips_when_measured_backend_is_cpu(monkeypatch):
                         lambda *a, **k: calls.append(1))
     assert bench.maybe_ab_frontier(base, "tpu", 100, 1, 2, 60) is base
     assert not calls
+
+
+def test_ab_chunked_picks_faster_and_pins_impl(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.delenv("LIGHTGBM_TPU_BOOST_CHUNK", raising=False)
+    monkeypatch.delenv("LIGHTGBM_TPU_IMPL", raising=False)
+    base = {"per_iter": 0.5, "rows": 100, "backend": "tpu",
+            "impl": "frontier", "auc": 0.900, "chunk": 1}
+    seen = {}
+
+    def fake_run_tier(*a, **k):
+        seen.update(k)
+        return {"per_iter": 0.3, "rows": 100, "backend": "tpu",
+                "impl": "frontier", "auc": 0.900, "chunk": 4}
+    monkeypatch.setattr(bench, "run_tier", fake_run_tier)
+    out = bench.maybe_ab_chunked(base, "tpu", 100, 2, 4, 60)
+    assert out["chunk"] == 4
+    # both sides of the comparison must run the same grower
+    assert seen["impl_env"] == "frontier"
+    assert seen["chunk_env"] == "4"
+
+
+def test_ab_chunked_skips_pinned_env_and_rejects_slower(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.delenv("LIGHTGBM_TPU_IMPL", raising=False)
+    base = {"per_iter": 0.5, "rows": 100, "backend": "cpu",
+            "impl": "fused-onehot", "auc": 0.9, "chunk": 1}
+    calls = []
+    monkeypatch.setenv("LIGHTGBM_TPU_BOOST_CHUNK", "4")
+    monkeypatch.setattr(bench, "run_tier",
+                        lambda *a, **k: calls.append(1))
+    assert bench.maybe_ab_chunked(base, "cpu", 100, 1, 2, 60) is base
+    assert not calls
+    monkeypatch.delenv("LIGHTGBM_TPU_BOOST_CHUNK")
+    monkeypatch.setattr(
+        bench, "run_tier",
+        lambda *a, **k: {"per_iter": 0.8, "rows": 100, "backend": "cpu",
+                         "impl": "fused-onehot", "auc": 0.9, "chunk": 2})
+    assert bench.maybe_ab_chunked(base, "cpu", 100, 1, 2, 60) is base

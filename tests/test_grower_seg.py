@@ -313,3 +313,44 @@ def test_segment_parity_wide_features_gather_compaction(rng):
     diff = np.abs(fused._raw_predict(X) - seg._raw_predict(X))
     assert np.mean(diff > 1e-3) < 0.25
     assert np.median(diff) < 1e-4
+
+
+def test_compact_state_sort_vs_gather_exact(rng, monkeypatch):
+    """Deterministic parity of compact_state's two implementations: the
+    multi-operand sort path and the argsort+gather path must produce the
+    IDENTICAL permuted layout on the same _SegState (both are stable
+    sorts on the same key, so even duplicate leaf_ids tie-break the same
+    way).  This closes the 25%-tolerance window the end-to-end
+    wide-feature test above has to allow for noise-feature gain ties —
+    the compaction itself is exact."""
+    import lightgbm_tpu.models.grower_seg as gs
+    import types
+
+    F4, n, L, rb = 8, 256, 8, 8
+    assert F4 // 4 + 5 <= gs._MAX_SORT_OPERANDS  # sort path engages
+    binsT = jnp.asarray(rng.randint(0, 64, size=(F4, n)), dtype=jnp.uint8)
+    # channels 0-5 live, 6-7 structurally zero (pack_channels layout —
+    # both compaction paths only carry the live ones)
+    w8 = jnp.zeros((8, n), dtype=jnp.bfloat16).at[:6].set(
+        jnp.asarray(rng.normal(size=(6, n)), dtype=jnp.bfloat16))
+    st = gs.fresh_state(
+        binsT, w8, n, L, G_cols=F4, B=64, F=F4, max_blocks=n // rb,
+        G0=1.0, H0=float(n), C0=float(n),
+        fmeta=types.SimpleNamespace(cegb_used0=None),
+        p=types.SimpleNamespace(use_cegb_coupled=False))
+    # scattered leaf assignment with duplicates and one empty leaf
+    lid = rng.randint(0, L, size=n)
+    lid[lid == L - 2] = 0  # leaf L-2 empty: exercises the empty-interval fixup
+    st = st._replace(leaf_id=jnp.asarray(lid, dtype=jnp.int32))
+
+    by_sort = gs.compact_state(st, L, rb)
+    monkeypatch.setattr(gs, "_MAX_SORT_OPERANDS", 0)  # force gather path
+    by_gather = gs.compact_state(st, L, rb)
+
+    for field in ("binsT", "w8", "order", "leaf_id", "leaf_lo", "leaf_hi"):
+        a = np.asarray(getattr(by_sort, field))
+        b = np.asarray(getattr(by_gather, field))
+        assert np.array_equal(a, b), f"compact_state paths differ on {field}"
+    # sanity: the layout really is leaf-sorted and a true permutation
+    assert np.all(np.diff(np.asarray(by_sort.leaf_id)) >= 0)
+    assert np.array_equal(np.sort(np.asarray(by_sort.order)), np.arange(n))

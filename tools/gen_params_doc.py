@@ -69,6 +69,16 @@ def generate() -> str:
         "  positive-gain leaf).  Lets rounds adapt between strict",
         "  best-first (one dominant leaf) and fully batched growth.",
         "- `tpu_row_chunk` — histogram kernel row-block size (0 = auto).",
+        "- `tpu_boost_chunk` — boosting iterations dispatched as ONE",
+        "  device program (`lax.scan` over the fused step) with all tree",
+        "  fetches batched at the chunk boundary.  `0` = auto (chunk on",
+        "  TPU when the run is chunk-eligible, per-iteration elsewhere);",
+        "  `1` disables chunking.  Auto-clamps to 1 whenever an iteration",
+        "  needs host interaction (bagging re-draws, feature sampling,",
+        "  DART/RF tree mutation, GOSS, CEGB state, custom gradients,",
+        "  per-iteration callbacks) and never changes a run's eval",
+        "  cadence; an explicit value > 1 opts eval and early stopping",
+        "  into chunk-boundary granularity.",
         "- `tpu_double_precision` — accumulate histograms in",
         "  f64-equivalent precision.",
         "",
