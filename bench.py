@@ -143,15 +143,15 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
     jax.block_until_ready(booster.train_score)
     t_warm = time.time() - t0
 
-    from lightgbm_tpu.utils.phase import (GLOBAL_TIMER, maybe_start_profile,
-                                          maybe_stop_profile)
+    from lightgbm_tpu.utils.phase import GLOBAL_TIMER, profile_session
+    from lightgbm_tpu.utils.telemetry import TELEMETRY
     GLOBAL_TIMER.reset()   # phase summary covers only the measured window
-    maybe_start_profile()
-    t0 = time.time()
-    run_iters(measure)
-    jax.block_until_ready(booster.train_score)
-    per_iter = (time.time() - t0) / measure
-    maybe_stop_profile()
+    TELEMETRY.reset()      # counters/timeline cover only the measured window
+    with profile_session():
+        t0 = time.time()
+        run_iters(measure)
+        jax.block_until_ready(booster.train_score)
+        per_iter = (time.time() - t0) / measure
 
     backend = jax.default_backend()
     # report the grower that ACTUALLY ran (a requested frontier/segment
@@ -205,7 +205,11 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
          # of the same child shows the persistent-cache number)
          "bin_s": round(t_bin, 1), "warmup_s": round(t_warm, 1),
          "full_500_incl_overheads_s": round(total_real, 1),
-         "fused_route": fused_used}))
+         "fused_route": fused_used,
+         # structured telemetry for the measured window (phases, fetch
+         # bytes, compile seconds, network counters) — cross-round
+         # tooling reads THIS, not the stderr phase line
+         "metrics": TELEMETRY.metrics_blob()}))
 
 
 def run_tier(platform: str, rows: int, warmup: int, measure: int,
@@ -316,6 +320,16 @@ def main():
             f"bench: extrapolated 500-iter {total_500:.1f}s vs row-scaled "
             f"baseline {baseline:.1f}s on {r['rows']} rows "
             f"({r['backend']}/{r['impl']})\n")
+        if r.get("metrics"):
+            # human-readable digest of the structured blob (top phases,
+            # transfer bytes, compile seconds) for the round log
+            try:
+                sys.path.insert(0, os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "tools"))
+                from trace_report import summarize
+                sys.stderr.write(summarize(r["metrics"]) + "\n")
+            except Exception as e:  # noqa: BLE001 — report must not kill
+                sys.stderr.write(f"bench: trace_report failed: {e}\n")
         out = {
             "metric": f"higgs_proxy_{r['rows']}r_500iter_train_time_"
                       f"{r['backend']}",
@@ -329,6 +343,7 @@ def main():
             "full_500_incl_overheads_s": r.get(
                 "full_500_incl_overheads_s"),
             "fused_route": r.get("fused_route"),
+            "metrics": r.get("metrics"),
         }
         if r["backend"] == "cpu":
             # outage fallback: a single-core XLA run — NOT a TPU

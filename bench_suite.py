@@ -212,10 +212,17 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
     run_iters(warmup)
     jax.block_until_ready(bst.gbdt.train_score)
     t_warm = time.time() - t0
+    from lightgbm_tpu.utils.phase import GLOBAL_TIMER
+    from lightgbm_tpu.utils.telemetry import TELEMETRY
+    GLOBAL_TIMER.reset()
+    TELEMETRY.reset()      # counters/timeline cover only the measured window
     t0 = time.time()
     run_iters(measure)
     jax.block_until_ready(bst.gbdt.train_score)
     per_iter = (time.time() - t0) / measure
+    # snapshot BEFORE the quality-gate extra iterations below so the
+    # blob matches the timed window
+    metrics_blob = TELEMETRY.metrics_blob()
 
     # quality gates are calibrated at a FIXED 25-iteration budget so the
     # same floor applies to every tier (timing above covers only the
@@ -269,6 +276,7 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
         "quality_ok": bool(ok),
         "impl": _impl_label(bst, params["tpu_tree_impl"]),
         "chunk": chunk,
+        "metrics": metrics_blob,
     }))
 
 

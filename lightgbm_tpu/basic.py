@@ -431,6 +431,14 @@ class Booster:
         configuration needs per-iteration host work."""
         return self.gbdt.train_chunk(int(chunk))
 
+    def get_stats(self) -> Dict:
+        """Training telemetry snapshot (utils/telemetry.py): phase
+        seconds, transfer/compile/network counters, gauges and the
+        per-iteration timeline.  ``engine.train`` attaches the same dict
+        as ``booster.train_stats`` at the end of training."""
+        from .utils.telemetry import TELEMETRY
+        return TELEMETRY.stats()
+
     def rollback_one_iter(self) -> "Booster":
         self.gbdt.rollback_one_iter()
         return self

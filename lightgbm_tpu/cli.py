@@ -119,35 +119,46 @@ class Application:
         chunk = booster.boost_chunk_size()
         freqs = [f for f in ((cfg.metric_freq if metric_names else 0),
                              cfg.snapshot_freq) if f > 0]
+        from .utils.phase import profile_session
+        from .utils.telemetry import TELEMETRY
         done = 0
-        while done < cfg.num_iterations:
-            step = min(chunk, cfg.num_iterations - done)
-            for f in freqs:
-                step = min(step, f - done % f)
-            stop = (booster.train_chunk(step) if step > 1
-                    else booster.train_one_iter())
-            it = done + step - 1
-            done += step
-            if (cfg.metric_freq > 0 and (it + 1) % cfg.metric_freq == 0
-                    and metric_names):
-                if cfg.is_provide_training_metric:
-                    for mname, val, _ in booster.eval_train():
-                        log_info(f"Iteration:{it + 1}, training {mname} : "
-                                 f"{val:g}")
-                for vi, vname in enumerate(names):
-                    for mname, val, _ in booster.eval_valid(vi):
-                        log_info(f"Iteration:{it + 1}, valid_{vi + 1} "
-                                 f"{mname} : {val:g}")
-            if (cfg.snapshot_freq > 0
-                    and (it + 1) % cfg.snapshot_freq == 0):
-                snap = f"{cfg.output_model}.snapshot_iter_{it + 1}"
-                self._save_model(booster, snap)
-                log_info(f"Saved snapshot to {snap}")
-            if stop:
-                break
-            log_info(f"{time.perf_counter() - start:.6f} seconds elapsed, "
-                     f"finished iteration {it + 1}")
+        # profiler window is exception-safe: a mid-training error must
+        # not leak an open jax profiler trace session
+        with profile_session():
+            while done < cfg.num_iterations:
+                step = min(chunk, cfg.num_iterations - done)
+                for f in freqs:
+                    step = min(step, f - done % f)
+                stop = (booster.train_chunk(step) if step > 1
+                        else booster.train_one_iter())
+                it = done + step - 1
+                done += step
+                if (cfg.metric_freq > 0 and (it + 1) % cfg.metric_freq == 0
+                        and metric_names):
+                    if cfg.is_provide_training_metric:
+                        for mname, val, _ in booster.eval_train():
+                            log_info(f"Iteration:{it + 1}, training "
+                                     f"{mname} : {val:g}")
+                    for vi, vname in enumerate(names):
+                        for mname, val, _ in booster.eval_valid(vi):
+                            log_info(f"Iteration:{it + 1}, valid_{vi + 1} "
+                                     f"{mname} : {val:g}")
+                if (cfg.snapshot_freq > 0
+                        and (it + 1) % cfg.snapshot_freq == 0):
+                    snap = f"{cfg.output_model}.snapshot_iter_{it + 1}"
+                    self._save_model(booster, snap)
+                    log_info(f"Saved snapshot to {snap}")
+                if stop:
+                    break
+                log_info(f"{time.perf_counter() - start:.6f} seconds "
+                         f"elapsed, finished iteration {it + 1}")
         self._save_model(booster, cfg.output_model)
+        if cfg.metrics_out:
+            import json
+            with open(cfg.metrics_out, "w") as fh:
+                json.dump(TELEMETRY.metrics_blob(), fh, indent=1)
+            log_info(f"Wrote training metrics to {cfg.metrics_out}")
+        TELEMETRY.maybe_export_trace()
         log_info(f"Finished training, saved model to {cfg.output_model}")
 
     def _save_model(self, booster, filename: str) -> None:

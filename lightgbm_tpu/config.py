@@ -189,6 +189,14 @@ _PARAMS: Dict[str, _P] = {
     # tree mutation, CEGB state, custom gradients, per-iter callbacks).
     "tpu_boost_chunk": _P(0, ["boost_chunk"]),
     "tpu_double_precision": _P(False),     # accumulate histograms in f64-equivalent
+    # telemetry (utils/telemetry.py): 0 = off, 1 = counters/gauges/
+    # timeline (default), 2 = + span ring buffer for Chrome trace export.
+    # Env LIGHTGBM_TPU_TELEMETRY overrides; LIGHTGBM_TPU_TRACE_JSON
+    # forces >= 2.
+    "telemetry_level": _P(1),
+    # CLI (task=train): write the versioned metrics JSON blob here after
+    # training ("" = don't)
+    "metrics_out": _P(""),
 }
 
 # alias -> canonical name

@@ -451,6 +451,9 @@ class TpuDataset:
         """The bin matrix as a device array (uploaded once, cached)."""
         import jax.numpy as jnp
         if self._device_binned is None:
+            from ..utils.telemetry import TELEMETRY
+            TELEMETRY.counter_add("transfer/h2d_bytes",
+                                  int(self.binned.nbytes))
             self._device_binned = jnp.asarray(self.binned)
         return self._device_binned
 
@@ -471,6 +474,8 @@ class TpuDataset:
             if packed4:
                 from ..ops.pallas_histogram import pack_bins_4bit
                 t = pack_bins_4bit(t)
+            from ..utils.telemetry import TELEMETRY
+            TELEMETRY.counter_add("transfer/h2d_bytes", int(t.nbytes))
             self._device_binned_T = jnp.asarray(t)
             self._device_binned_T_key = (row_multiple, packed4)
         return self._device_binned_T

@@ -117,41 +117,50 @@ def train(params: Dict[str, Any], train_set: Dataset,
               and (has_eval or user_after)):
             chunk = 1
 
-    i = 0
-    while i < num_boost_round:
-        step = min(chunk, num_boost_round - i)
-        for cb in callbacks_before:
-            cb(callback_mod.CallbackEnv(
-                model=booster, params=params, iteration=i,
-                begin_iteration=0, end_iteration=num_boost_round,
-                evaluation_result_list=None))
-        if step > 1:
-            should_stop = booster.update_chunk(step)
-        else:
-            should_stop = booster.update(fobj=fobj)
-        it = i + step - 1
-
-        evaluation_result_list = []
-        if booster._valid_names or train_in_valid:
-            if train_in_valid:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after:
+    # the profiler window is exception-safe (utils/phase.profile_session):
+    # a callback or device error mid-training must not leak an open jax
+    # profiler trace session
+    from .utils.phase import profile_session
+    from .utils.telemetry import TELEMETRY
+    with profile_session():
+        i = 0
+        while i < num_boost_round:
+            step = min(chunk, num_boost_round - i)
+            for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(
-                    model=booster, params=params, iteration=it,
+                    model=booster, params=params, iteration=i,
                     begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            for item in e.best_score:
-                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-            break
-        if should_stop:
-            break
-        i += step
+                    evaluation_result_list=None))
+            if step > 1:
+                should_stop = booster.update_chunk(step)
+            else:
+                should_stop = booster.update(fobj=fobj)
+            it = i + step - 1
+
+            evaluation_result_list = []
+            if booster._valid_names or train_in_valid:
+                if train_in_valid:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=it,
+                        begin_iteration=0, end_iteration=num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                for item in e.best_score:
+                    booster.best_score.setdefault(
+                        item[0], {})[item[1]] = item[2]
+                break
+            if should_stop:
+                break
+            i += step
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.gbdt.current_iteration()
+    booster.train_stats = TELEMETRY.stats()
+    TELEMETRY.maybe_export_trace()
     return booster
 
 

@@ -15,6 +15,7 @@ scalars plus optional leaf renewal / validation-set prediction.
 from __future__ import annotations
 
 import math
+import time
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -26,6 +27,7 @@ from ..core.dataset import TpuDataset
 from ..ops.split import FeatureMeta, SplitParams
 from ..utils.log import check, log_fatal, log_info, log_warning
 from ..utils.phase import GLOBAL_TIMER as _PHASES
+from ..utils.telemetry import TELEMETRY
 from .grower import (GrowerParams, _pack_tree_device, fetch_tree_arrays,
                      fetch_tree_chunk, make_grow_tree, unpack_tree_buffers)
 from .grower_seg import print_seg_stats, seg_stats_enabled
@@ -44,8 +46,16 @@ class _PendingChunk(NamedTuple):
 
 def _maybe_print_seg_stats(stats) -> None:
     """Render a grower's counter output when LIGHTGBM_TPU_SEG_STATS asks
-    for it (stats is () for growers that emit none, e.g. the fused one)."""
+    for it (stats is () for growers that emit none, e.g. the fused one).
+    The rows also feed the telemetry counters; fetching the stats vector
+    blocks on the device, so recording stays gated on the same env knob
+    that opts into per-iteration synchronization."""
     if stats and seg_stats_enabled():
+        rows = np.asarray(stats[0]).reshape(-1, 6)
+        TELEMETRY.counter_add("seg/scanned_blocks",
+                              int(rows[:, 0].sum()))
+        TELEMETRY.counter_add("seg/compactions", int(rows[:, 1].sum()))
+        TELEMETRY.counter_add("seg/grid_steps", int(rows[:, 2].sum()))
         print_seg_stats(stats[0])
 
 
@@ -58,10 +68,13 @@ def _auto_frontier_k(cfg, num_columns: int, num_bins: int) -> int:
     data-parallel frontier learners so they always grow the same-width
     frontier."""
     if cfg.tpu_frontier_width > 0:
+        TELEMETRY.gauge_set("grow/frontier_k", int(cfg.tpu_frontier_width))
         return cfg.tpu_frontier_width
     from ..ops.pallas_histogram import frontier_width
-    return min(frontier_width(num_columns, num_bins),
-               max(1, -(-max(2, cfg.num_leaves) // 16)))
+    k = min(frontier_width(num_columns, num_bins),
+            max(1, -(-max(2, cfg.num_leaves) // 16)))
+    TELEMETRY.gauge_set("grow/frontier_k", int(k))
+    return k
 
 
 def _round_up_pow2(x: int) -> int:
@@ -175,6 +188,12 @@ class GBDT:
                  objective=None):
         self.config = config
         self.objective = objective
+        # bind the config's telemetry level (env wins; see
+        # utils/telemetry.py) and hook jax compile/retrace/cache events
+        # before any tracing happens
+        TELEMETRY.set_config_level(getattr(config, "telemetry_level", 1))
+        if TELEMETRY.level >= 1:
+            TELEMETRY.install_jax_listeners()
         self.train_set: Optional[TpuDataset] = None
         self._models: List[Tree] = []           # flat: iter-major, class-minor
         # finished trees whose device->host transfer is still in flight:
@@ -812,10 +831,15 @@ class GBDT:
             return [(iter_idx + t,
                      [(arrays, payload.shrinkage) for arrays in per_class])
                     for t, per_class in enumerate(chunk)]
-        return [(iter_idx,
-                 [(unpack_tree_buffers(np.asarray(ints_d),
-                                       np.asarray(floats_d), L), lr)
-                  for (ints_d, floats_d, lr) in payload])]
+        pairs = []
+        for (ints_d, floats_d, lr) in payload:
+            ints_np, floats_np = np.asarray(ints_d), np.asarray(floats_d)
+            TELEMETRY.counter_add("transfer/fetch_calls")
+            TELEMETRY.counter_add("transfer/fetch_bytes",
+                                  int(ints_np.nbytes)
+                                  + int(floats_np.nbytes))
+            pairs.append((unpack_tree_buffers(ints_np, floats_np, L), lr))
+        return [(iter_idx, pairs)]
 
     def _materialize_iter(self, pairs):
         """One iteration's [(TreeArrays, shrinkage)] -> (trees, all_const);
@@ -982,6 +1006,7 @@ class GBDT:
             # its fetch overlaps the next iteration's device work
             with _PHASES.phase("fetch"):
                 self._flush_pending(keep_latest=1)
+            TELEMETRY.mark_iteration(self.iter_ - 1)
             if self._stop_flag:
                 return True
             return False
@@ -996,12 +1021,15 @@ class GBDT:
                 g_k = jnp.pad(g_k, (0, self._row_pad))
                 h_k = jnp.pad(h_k, (0, self._row_pad))
                 member = jnp.pad(member, (0, self._row_pad))
-            arrays, leaf_id, *stats = self._grow_fn(
-                self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
+            with _PHASES.phase("grow") as box:
+                arrays, leaf_id, *stats = self._grow_fn(
+                    self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
+                box[0] = leaf_id
             _maybe_print_seg_stats(stats)
             if self._row_pad:
                 leaf_id = leaf_id[: self.num_data]
-            arrays = fetch_tree_arrays(arrays)
+            with _PHASES.phase("fetch"):
+                arrays = fetch_tree_arrays(arrays)
             nl = int(arrays.num_leaves)
             if nl <= 1:
                 tree = Tree(1)
@@ -1039,6 +1067,7 @@ class GBDT:
             return True
         self._note_trees(self._models[-C:])
         self.iter_ += 1
+        TELEMETRY.mark_iteration(self.iter_ - 1)
         return False
 
     def _train_one_iter_fused(self) -> bool:
@@ -1066,6 +1095,7 @@ class GBDT:
             # identical key stream to the eager path, so the same seed
             # grows the same trees regardless of which path engages
             self._key, sub = jax.random.split(self._key)
+            t0_grow = time.perf_counter()
             with _PHASES.phase("grow") as box:
                 extra = () if roots is None else (roots,)
                 self.train_score, ints_d, floats_d, stats_t = fused_step(
@@ -1073,6 +1103,15 @@ class GBDT:
                     self.bins, self.fmeta, fmask, sub,
                     jnp.float32(self.shrinkage_rate), jnp.int32(k), *extra)
                 box[0] = self.train_score
+            # instrumented parallel growers run inside the jitted step,
+            # where their own wrapper is trace-time only; record the
+            # per-tree collective at this eager dispatch site instead
+            coll_kind = getattr(self._grow_fn, "_collective_kind", None)
+            if coll_kind is not None:
+                from ..parallel import network
+                network.record_collective(
+                    coll_kind, self._grow_fn._collective_bytes,
+                    time.perf_counter() - t0_grow)
             _maybe_print_seg_stats(stats_t)
             self._start_host_copy(ints_d, floats_d)
             items.append((ints_d, floats_d, self.shrinkage_rate))
@@ -1083,6 +1122,7 @@ class GBDT:
             # before the next grow call, so forgo the one-deep pipeline
             keep = 0 if self.grower_params.use_cegb_coupled else 1
             self._flush_pending(keep_latest=keep)
+        TELEMETRY.mark_iteration(self.iter_ - 1)
         return bool(self._stop_flag)
 
     # ---------------------------------------------------------- chunked loop
@@ -1176,6 +1216,8 @@ class GBDT:
             # the one-chunk-deep pipeline when valid sets are attached
             keep = 0 if self.valid_sets else 1
             self._flush_pending(keep_latest=keep)
+        TELEMETRY.gauge_set("boost/chunk_size", T)
+        TELEMETRY.mark_iteration(self.iter_ - 1, count=T)
         return bool(self._stop_flag)
 
     def refit(self, leaf_preds: np.ndarray) -> None:

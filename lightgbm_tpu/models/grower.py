@@ -122,12 +122,22 @@ def _pack_tree_device(t: TreeArrays):
     return ints, floats
 
 
+def _count_fetch(*bufs) -> None:
+    """Telemetry: one device->host tree fetch (however many transfers it
+    batches) with its total payload bytes."""
+    from ..utils.telemetry import TELEMETRY
+    TELEMETRY.counter_add("transfer/fetch_calls")
+    TELEMETRY.counter_add("transfer/fetch_bytes",
+                          sum(int(b.nbytes) for b in bufs))
+
+
 def fetch_tree_arrays(t: TreeArrays) -> TreeArrays:
     """Device TreeArrays -> host (numpy) TreeArrays via two transfers."""
     import numpy as np
     ints_d, floats_d = _pack_tree_device(t)
-    return unpack_tree_buffers(np.asarray(ints_d), np.asarray(floats_d),
-                               t.leaf_value.shape[0])
+    ints_np, floats_np = np.asarray(ints_d), np.asarray(floats_d)
+    _count_fetch(ints_np, floats_np)
+    return unpack_tree_buffers(ints_np, floats_np, t.leaf_value.shape[0])
 
 
 def fetch_tree_chunk(ints_all, floats_all, L: int) -> list:
@@ -138,6 +148,7 @@ def fetch_tree_chunk(ints_all, floats_all, L: int) -> list:
     import numpy as np
     ints_np = np.asarray(ints_all)
     floats_np = np.asarray(floats_all)
+    _count_fetch(ints_np, floats_np)
     return [[unpack_tree_buffers(ints_np[t, k], floats_np[t, k], L)
              for k in range(ints_np.shape[1])]
             for t in range(ints_np.shape[0])]

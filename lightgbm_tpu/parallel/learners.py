@@ -33,6 +33,8 @@ work here).
 
 from __future__ import annotations
 
+import functools
+import time
 from typing import Optional
 
 import jax
@@ -44,6 +46,35 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.grower import CommHooks, GrowerParams, make_grow_tree
 from ..ops.split import (NEG_INF, SplitInfo, SplitParams, expand_group_hist,
                          per_feature_gains)
+
+
+def _instrument_grower(grow_fn, kind: str, tree_bytes: int):
+    """Wrap a parallel grower so every tree records one collective
+    entry (parallel/network.py counters): the static per-tree wire-byte
+    estimate plus the host dispatch wall of the grow call (device
+    collectives execute asynchronously inside the jitted grower, so
+    dispatch wall is the honest host-side measure).
+
+    The fused boosting step closes over the grower INSIDE a jit, where
+    this Python wrapper only runs while tracing — recording there would
+    count one bogus trace-time entry per compile instead of one per
+    tree.  Tracing calls are skipped, and the kind/bytes tags are
+    exposed as attributes so the fused dispatch site (gbdt.py) can
+    record each eager step itself."""
+    from . import network
+
+    @functools.wraps(grow_fn)
+    def grow(*args, **kwargs):
+        if any(isinstance(a, jax.core.Tracer) for a in args):
+            return grow_fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = grow_fn(*args, **kwargs)
+        network.record_collective(kind, tree_bytes,
+                                  time.perf_counter() - t0)
+        return out
+    grow._collective_kind = kind
+    grow._collective_bytes = tree_bytes
+    return grow
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -127,11 +158,13 @@ def _balanced_stripes(column_bins, D: int):
 
 def _log_collective_estimate(mode: str, D: int, num_columns: int,
                              num_bins: int, num_leaves: int,
-                             top_k: int = 0):
+                             top_k: int = 0) -> int:
     """Static wire-byte estimate from mesh math (SURVEY §5: the TPU
     equivalent of the fork's Linkers byte counters, linkers.h:114-117).
     Ring allreduce moves ~2x the payload, reduce-scatter ~1x; the
-    SplitInfo merge is ~14 scalars all_gathered per leaf scan."""
+    SplitInfo merge is ~14 scalars all_gathered per leaf scan.  Returns
+    the per-tree byte total so the grower factories can feed the
+    runtime collective counters (network.record_collective)."""
     from ..utils.log import log_info
     hist_bytes = num_columns * num_bins * 3 * 4
     per_split = {
@@ -150,6 +183,7 @@ def _log_collective_estimate(mode: str, D: int, num_columns: int,
     log_info(f"collective estimate [{mode}, D={D}]: "
              f"{per_split + split_info} B/split, "
              f"{total / 1e6:.1f} MB/tree on the wire")
+    return int(total)
 
 
 def _make_voting_reduce(axis, sp, top_k: int):
@@ -288,9 +322,12 @@ def make_parallel_grower(num_bins: int, params: GrowerParams, mesh: Mesh,
     est_mode = mode.split("_")[0]
     if est_mode == "data" and (num_columns <= 0 or params.forced_plan):
         est_mode = "data_allreduce"        # the full-hist psum fallback
-    _log_collective_estimate(est_mode, D, num_columns or 0, num_bins,
-                             params.num_leaves, top_k)
-    return make_grow_tree(num_bins, params, comm=comm, wrap=wrap)
+    tree_bytes = _log_collective_estimate(est_mode, D, num_columns or 0,
+                                          num_bins, params.num_leaves,
+                                          top_k)
+    return _instrument_grower(
+        make_grow_tree(num_bins, params, comm=comm, wrap=wrap),
+        est_mode, tree_bytes)
 
 
 def _stripe_setup(mesh: Mesh, num_columns: int, feat_group):
@@ -362,10 +399,12 @@ def make_data_parallel_segment_grower(num_bins: int, params: GrowerParams,
     def wrap(grow):
         return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
 
-    _log_collective_estimate("data_segment", D, G, num_bins,
-                             params.num_leaves)
-    return make_grow_tree_segment(num_bins, params, block_rows, comm=comm,
-                                  wrap=wrap)
+    tree_bytes = _log_collective_estimate("data_segment", D, G, num_bins,
+                                          params.num_leaves)
+    return _instrument_grower(
+        make_grow_tree_segment(num_bins, params, block_rows, comm=comm,
+                               wrap=wrap),
+        "data_segment", tree_bytes)
 
 
 def make_data_parallel_frontier_grower(num_bins: int, params: GrowerParams,
@@ -411,11 +450,13 @@ def make_data_parallel_frontier_grower(num_bins: int, params: GrowerParams,
     def wrap(grow):
         return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
 
-    _log_collective_estimate("data_frontier", D, G, num_bins,
-                             params.num_leaves)
-    return make_grow_tree_frontier(num_bins, params, block_rows,
-                                   batch_k=batch_k, gain_ratio=gain_ratio,
-                                   comm=comm, wrap=wrap)
+    tree_bytes = _log_collective_estimate("data_frontier", D, G, num_bins,
+                                          params.num_leaves)
+    return _instrument_grower(
+        make_grow_tree_frontier(num_bins, params, block_rows,
+                                batch_k=batch_k, gain_ratio=gain_ratio,
+                                comm=comm, wrap=wrap),
+        "data_frontier", tree_bytes)
 
 
 def _feature_stripes(mesh: Mesh, num_columns: int, feat_group,
@@ -482,23 +523,27 @@ def make_feature_parallel_oleaf_grower(num_bins: int, params: GrowerParams,
     def wrap(grow):
         return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
 
-    _log_collective_estimate("feature", D, num_columns, num_bins,
-                             params.num_leaves)
+    tree_bytes = _log_collective_estimate("feature", D, num_columns,
+                                          num_bins, params.num_leaves)
     if impl == "frontier":
         comm = CommHooks(
             shard_feature_mask=shard_mask, column_block=column_block,
             merge_split_batch=lambda infos, gains: _merge_batch_by_gain(
                 infos, gains, axis))
-        return make_grow_tree_frontier(num_bins, params, block_rows,
-                                       batch_k=batch_k,
-                                       gain_ratio=gain_ratio, comm=comm,
-                                       wrap=wrap)
+        return _instrument_grower(
+            make_grow_tree_frontier(num_bins, params, block_rows,
+                                    batch_k=batch_k,
+                                    gain_ratio=gain_ratio, comm=comm,
+                                    wrap=wrap),
+            "feature", tree_bytes)
     comm = CommHooks(
         merge_split=lambda info, gain: _merge_split_by_gain(info, gain,
                                                             axis),
         shard_feature_mask=shard_mask, column_block=column_block)
-    return make_grow_tree_segment(num_bins, params, block_rows, comm=comm,
-                                  wrap=wrap)
+    return _instrument_grower(
+        make_grow_tree_segment(num_bins, params, block_rows, comm=comm,
+                               wrap=wrap),
+        "feature", tree_bytes)
 
 
 def _merge_batch_by_gain(infos, gains, axis):
@@ -539,8 +584,8 @@ def make_voting_parallel_oleaf_grower(num_bins: int, params: GrowerParams,
     def wrap(grow):
         return jax.jit(_shard_map(grow, mesh, in_specs, out_specs))
 
-    _log_collective_estimate("voting", D, G, num_bins, params.num_leaves,
-                             top_k)
+    tree_bytes = _log_collective_estimate("voting", D, G, num_bins,
+                                          params.num_leaves, top_k)
     if impl == "frontier":
         def reduce_batch(h, fmeta=None):
             # per-leaf elections over the [K, G, B, 3] round batch
@@ -552,14 +597,18 @@ def make_voting_parallel_oleaf_grower(num_bins: int, params: GrowerParams,
             reduce_hist_batch=reduce_batch,
             merge_split_batch=lambda infos, gains: (infos, gains),
             no_subtract=True)
-        return make_grow_tree_frontier(num_bins, params, block_rows,
-                                       batch_k=batch_k,
-                                       gain_ratio=gain_ratio, comm=comm,
-                                       wrap=wrap)
+        return _instrument_grower(
+            make_grow_tree_frontier(num_bins, params, block_rows,
+                                    batch_k=batch_k,
+                                    gain_ratio=gain_ratio, comm=comm,
+                                    wrap=wrap),
+            "voting", tree_bytes)
     comm = CommHooks(
         reduce_hist=reduce_voted,
         reduce_stats=lambda x: lax.psum(x, axis),
         no_subtract=True,
         uniform_scan=lambda b: lax.pmax(b, axis))
-    return make_grow_tree_segment(num_bins, params, block_rows, comm=comm,
-                                  wrap=wrap)
+    return _instrument_grower(
+        make_grow_tree_segment(num_bins, params, block_rows, comm=comm,
+                               wrap=wrap),
+        "voting", tree_bytes)

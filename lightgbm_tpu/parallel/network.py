@@ -16,7 +16,9 @@ the TPU equivalent of the reference's machine list + socket handshake
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+import threading
+import time
+from typing import Callable, Dict, Optional
 
 import jax
 import numpy as np
@@ -27,6 +29,72 @@ _mesh: Optional["jax.sharding.Mesh"] = None
 _injected: Optional[dict] = None
 
 MACHINES_AXIS = "machines"
+
+# ---------------------------------------------------------------------------
+# Per-collective counters: calls, payload bytes, wall seconds — the TPU
+# equivalent of the reference Linkers byte/time counters (linkers.h:114-117).
+# For XLA collectives launched from jitted growers the bytes are the static
+# mesh-math estimate and the seconds are the HOST DISPATCH wall of the
+# enclosing grow call (device execution is asynchronous); for host-side
+# collectives (allgather_obj) both are measured for real.
+_coll_lock = threading.Lock()
+_collectives: Dict[str, Dict[str, float]] = {}
+_coll_writer: Optional[int] = None
+_coll_race_warned = False
+
+
+def record_collective(kind: str, nbytes: float = 0,
+                      seconds: float = 0.0, calls: int = 1) -> None:
+    """Accumulate one collective's stats under ``kind``.  Thread-safe,
+    with the reference Network's single-writer check relaxed to a
+    warning (include/LightGBM/network.h keeps all collectives on one
+    thread; here a second writer is flagged, not fatal)."""
+    global _coll_writer, _coll_race_warned
+    from ..utils.telemetry import TELEMETRY
+    if TELEMETRY.level < 1:
+        return
+    with _coll_lock:
+        ident = threading.get_ident()
+        if _coll_writer is None:
+            _coll_writer = ident
+        elif _coll_writer != ident and not _coll_race_warned:
+            _coll_race_warned = True
+            log_warning("network collectives recorded from multiple "
+                        "threads; the reference keeps Network "
+                        "single-threaded — counters stay consistent but "
+                        "per-kind attribution may interleave")
+        st = _collectives.setdefault(
+            kind, {"calls": 0, "bytes": 0, "seconds": 0.0})
+        st["calls"] += int(calls)
+        st["bytes"] += int(nbytes)
+        st["seconds"] += float(seconds)
+
+
+def collective_stats() -> Dict[str, Dict[str, float]]:
+    """{kind: {calls, bytes, seconds}} copy (rounded for JSON)."""
+    with _coll_lock:
+        return {k: {"calls": int(v["calls"]), "bytes": int(v["bytes"]),
+                    "seconds": round(v["seconds"], 6)}
+                for k, v in _collectives.items()}
+
+
+def collective_summary() -> str:
+    """One-line rendering for the phase summary; empty when no
+    collective ran."""
+    stats = collective_stats()
+    if not stats:
+        return ""
+    parts = [f"{k}={v['calls']}x/{v['bytes'] / 1e6:.1f}MB/"
+             f"{v['seconds']:.3f}s" for k, v in sorted(stats.items())]
+    return "net " + " ".join(parts)
+
+
+def reset_collective_stats() -> None:
+    global _coll_writer, _coll_race_warned
+    with _coll_lock:
+        _collectives.clear()
+        _coll_writer = None
+        _coll_race_warned = False
 
 
 def init(num_machines: int = 0) -> "jax.sharding.Mesh":
@@ -149,8 +217,12 @@ def allgather_obj(obj):
     for real multi-process meshes, else identity."""
     import pickle
     blob = pickle.dumps(obj)
+    t0 = time.perf_counter()
     if _injected is not None:
-        return [pickle.loads(b) for b in _injected["allgather"](blob)]
+        out = [pickle.loads(b) for b in _injected["allgather"](blob)]
+        record_collective("allgather_obj", len(blob),
+                          time.perf_counter() - t0)
+        return out
     if jax.process_count() == 1:
         return [obj]
     from jax.experimental import multihost_utils
@@ -161,8 +233,10 @@ def allgather_obj(obj):
     pad = np.zeros(maxn, np.uint8)
     pad[: arr.size] = arr
     gathered = multihost_utils.process_allgather(pad)
-    return [pickle.loads(gathered[i, : int(sizes[i])].tobytes())
-            for i in range(gathered.shape[0])]
+    out = [pickle.loads(gathered[i, : int(sizes[i])].tobytes())
+           for i in range(gathered.shape[0])]
+    record_collective("allgather_obj", maxn, time.perf_counter() - t0)
+    return out
 
 
 def dispose() -> None:

@@ -7,6 +7,9 @@ byte/time counters (src/network/linkers.h:114-117).  This module is the
 TPU build's equivalent of the TIMETAG accumulators: named phases
 accumulate wall-clock across iterations and are printed on demand
 (bench.py prints them every run; ``Log`` prints at verbosity>=debug).
+Each finished phase is also recorded as a span in the telemetry
+registry (utils/telemetry.py), which adds counters, a per-iteration
+timeline and Chrome trace export on top.
 
 Because device work is dispatched asynchronously, a phase's wall time
 normally measures only host-side dispatch.  Set
@@ -19,10 +22,11 @@ alternative).
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 
 def _sync_enabled() -> bool:
@@ -30,9 +34,12 @@ def _sync_enabled() -> bool:
 
 
 class PhaseTimer:
-    """Accumulates (count, seconds) per named phase."""
+    """Accumulates (count, seconds) per named phase.  Thread-safe: the
+    accumulators are guarded by a lock (phases themselves may overlap
+    freely across threads; each contributes its own wall window)."""
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.seconds: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
 
@@ -50,21 +57,41 @@ class PhaseTimer:
             if sync and box[0] is not None:
                 import jax
                 jax.block_until_ready(box[0])
-            self.seconds[name] += time.perf_counter() - t0
-            self.counts[name] += 1
+            dur = time.perf_counter() - t0
+            with self._lock:
+                self.seconds[name] += dur
+                self.counts[name] += 1
+            from .telemetry import TELEMETRY
+            TELEMETRY.record_span(name, t0, dur)
 
     def reset(self) -> None:
-        self.seconds.clear()
-        self.counts.clear()
+        with self._lock:
+            self.seconds.clear()
+            self.counts.clear()
+
+    def snapshot(self) -> Dict[str, Tuple[float, int]]:
+        """Consistent {name: (seconds, count)} copy."""
+        with self._lock:
+            return {name: (sec, self.counts[name])
+                    for name, sec in self.seconds.items()}
 
     def summary(self) -> str:
-        total = sum(self.seconds.values())
+        snap = self.snapshot()
+        total = sum(sec for sec, _ in snap.values())
         parts = []
-        for name, sec in sorted(self.seconds.items(), key=lambda kv: -kv[1]):
-            n = self.counts[name]
+        for name, (sec, n) in sorted(snap.items(), key=lambda kv: -kv[1][0]):
             parts.append(f"{name}={sec:.3f}s/{n}")
         mode = "sync" if _sync_enabled() else "dispatch"
-        return f"phases[{mode}] total={total:.3f}s " + " ".join(parts)
+        out = f"phases[{mode}] total={total:.3f}s " + " ".join(parts)
+        # append the network collective counters (linkers.h:114-117
+        # equivalent) when the parallel machinery has been used
+        import sys
+        net = sys.modules.get("lightgbm_tpu.parallel.network")
+        if net is not None and hasattr(net, "collective_summary"):
+            net_line = net.collective_summary()
+            if net_line:
+                out += " | " + net_line
+        return out
 
 
 # process-global timer used by GBDT unless one is injected
@@ -86,6 +113,20 @@ def maybe_start_profile() -> None:
 def maybe_stop_profile() -> None:
     global _profile_session
     if _profile_session is not None:
+        # clear the session marker FIRST: if stop_trace raises, a retry
+        # must not call it again on an already-broken session
+        _profile_session = None
         import jax
         jax.profiler.stop_trace()
-        _profile_session = None
+
+
+@contextmanager
+def profile_session():
+    """Exception-safe profiler window: an error mid-training must not
+    leak an open jax profiler trace session (which would poison every
+    later start_trace in the process)."""
+    maybe_start_profile()
+    try:
+        yield
+    finally:
+        maybe_stop_profile()

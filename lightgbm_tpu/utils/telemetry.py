@@ -1,0 +1,353 @@
+"""Process-global training telemetry: spans, counters, gauges, a
+per-iteration timeline, and Chrome trace-event export.
+
+The reference fork's defining additions over stock LightGBM are
+observability: easy_profiler trace blocks (src/main.cpp:13-39), TIMETAG
+per-phase accumulators (serial_tree_learner.cpp:20-47) and network
+byte/time counters (linkers.h:114-117).  This module is the TPU build's
+superset of all three, layered on top of the existing ``PhaseTimer``
+(utils/phase.py), which keeps its role as the per-phase accumulator and
+additionally feeds every finished phase into the span ring buffer here.
+
+Three telemetry levels gate the overhead:
+
+  * ``0`` — off.  Every record call is a single attribute compare.
+  * ``1`` — default.  Counters, gauges and the per-iteration timeline
+    accumulate; phase seconds keep accruing in ``PhaseTimer``.
+  * ``2`` — adds timestamped spans in a bounded ring buffer, exportable
+    as Chrome trace-event JSON (load in Perfetto / chrome://tracing).
+
+The effective level resolves lazily (env vars are read at refresh time,
+not import time, so the test harness's env scrubbing and monkeypatching
+behave): ``LIGHTGBM_TPU_TELEMETRY`` wins if set, else the
+``telemetry_level`` config parameter, else 1; a set
+``LIGHTGBM_TPU_TRACE_JSON=<path>`` forces the effective level to >= 2
+and exports the trace there at the end of training (plus an atexit
+backstop).
+
+Timing caveat: device work is dispatched asynchronously, so spans and
+phase seconds measure host-side dispatch unless
+``LIGHTGBM_TPU_SYNC_TIMERS=1`` (see utils/phase.py).  The ``mode`` field
+of ``stats()`` records which one a blob was collected under.
+
+Compile visibility comes from ``jax.monitoring`` listeners
+(install_jax_listeners): retrace counts/seconds, backend compile
+counts/seconds and compilation-cache hits/misses — cold-vs-warm cache
+behavior is measurable instead of inferred from wall-clock cliffs.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+METRICS_SCHEMA = "lightgbm_tpu.metrics/v1"
+SPAN_CAPACITY = 65536
+TIMELINE_CAPACITY = 8192
+
+# jax.monitoring event name -> (count counter, seconds counter)
+_JAX_DURATION_EVENTS = {
+    "/jax/core/compile/jaxpr_trace_duration":
+        ("compile/retraces", "compile/retrace_seconds"),
+    "/jax/core/compile/backend_compile_duration":
+        ("compile/backend_compiles", "compile/backend_compile_seconds"),
+}
+# jax.monitoring count-only event -> counter
+_JAX_COUNT_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "compile/cache_hits",
+    "/jax/compilation_cache/cache_misses": "compile/cache_misses",
+}
+
+
+class TelemetryRegistry:
+    """Thread-safe registry of counters, gauges, spans and the
+    per-iteration timeline.  One process-global instance (``TELEMETRY``)
+    exists; tests may construct private ones."""
+
+    def __init__(self, span_capacity: int = SPAN_CAPACITY) -> None:
+        self._lock = threading.RLock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
+        # (ts_us, dur_us, name, tid_label, args|None)
+        self._spans: deque = deque(maxlen=span_capacity)
+        self._spans_recorded = 0
+        self._timeline: deque = deque(maxlen=TIMELINE_CAPACITY)
+        self._iter_snapshot: Dict[str, float] = {}
+        self._epoch = time.perf_counter()
+        self._config_level: Optional[int] = None
+        self._jax_listeners_installed = False
+        # single-writer race check, analogous to the reference Network's
+        # single-thread CHECK: the first writer thread claims the stream;
+        # a second one is recorded (and warned about) once, not fatal
+        self._writer: Optional[int] = None
+        self._race_flagged = False
+        self._level = self._resolve_level()
+
+    # ------------------------------------------------------------- level
+    def _resolve_level(self) -> int:
+        env = os.environ.get("LIGHTGBM_TPU_TELEMETRY", "")
+        if env != "":
+            try:
+                lvl = int(env)
+            except ValueError:
+                lvl = 1
+        elif self._config_level is not None:
+            lvl = self._config_level
+        else:
+            lvl = 1
+        if os.environ.get("LIGHTGBM_TPU_TRACE_JSON"):
+            lvl = max(lvl, 2)
+        return max(0, min(2, lvl))
+
+    def refresh_level(self) -> int:
+        """Re-read env/config into the cached level (the hot-path gate is
+        one attribute compare; refresh happens at setup boundaries)."""
+        self._level = self._resolve_level()
+        return self._level
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    def set_config_level(self, level) -> None:
+        """Bind the ``telemetry_level`` config parameter (env wins)."""
+        try:
+            self._config_level = int(level)
+        except (TypeError, ValueError):
+            self._config_level = None
+        self.refresh_level()
+
+    # ----------------------------------------------------- writer check
+    def _note_writer(self) -> None:
+        ident = threading.get_ident()
+        if self._writer is None:
+            self._writer = ident
+        elif self._writer != ident and not self._race_flagged:
+            self._race_flagged = True
+            self._counters["telemetry/writer_races"] += 1
+            from .log import log_warning
+            log_warning("telemetry written from multiple threads; counts "
+                        "stay consistent (locked) but span/timeline "
+                        "ordering may interleave")
+
+    # -------------------------------------------------- counters/gauges
+    def counter_add(self, name: str, value: float = 1) -> None:
+        if self._level < 1:
+            return
+        with self._lock:
+            self._note_writer()
+            self._counters[name] += value
+
+    def gauge_set(self, name: str, value: float) -> None:
+        if self._level < 1:
+            return
+        with self._lock:
+            self._note_writer()
+            self._gauges[name] = value
+
+    # -------------------------------------------------------------- spans
+    def record_span(self, name: str, t0: float, dur: float,
+                    args: Optional[dict] = None,
+                    tid: Optional[str] = None) -> None:
+        """Record one finished span; ``t0`` is a time.perf_counter()
+        value, ``dur`` seconds.  No-op below level 2."""
+        if self._level < 2:
+            return
+        label = tid or threading.current_thread().name
+        with self._lock:
+            self._note_writer()
+            self._spans_recorded += 1
+            self._spans.append(((t0 - self._epoch) * 1e6, dur * 1e6,
+                                name, label, args or None))
+
+    @contextmanager
+    def span(self, name: str, **args):
+        """Context-managed span (host-side dispatch window; see module
+        docstring for the async caveat)."""
+        if self._level < 2:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record_span(name, t0, time.perf_counter() - t0,
+                             args or None)
+
+    # ----------------------------------------------------------- timeline
+    def mark_iteration(self, iteration: int, count: int = 1) -> None:
+        """Close one timeline entry: iteration index (the last iteration
+        when ``count`` > 1, i.e. a boosting chunk), the wall offset since
+        reset, and the counter deltas since the previous mark."""
+        if self._level < 1:
+            return
+        with self._lock:
+            self._note_writer()
+            deltas = {}
+            for k, v in self._counters.items():
+                d = v - self._iter_snapshot.get(k, 0)
+                if d:
+                    deltas[k] = round(d, 9) if isinstance(d, float) else d
+            self._iter_snapshot = dict(self._counters)
+            self._timeline.append(
+                {"iter": int(iteration), "count": int(count),
+                 "t": round(time.perf_counter() - self._epoch, 6),
+                 "counters": deltas})
+
+    # ------------------------------------------------------ jax.monitoring
+    def install_jax_listeners(self) -> None:
+        """Register jax.monitoring listeners for compile/retrace/cache
+        events.  Idempotent; jax offers no unregistration, so callbacks
+        stay bound to this (process-global) registry and self-gate on the
+        current level."""
+        if self._jax_listeners_installed:
+            return
+        self._jax_listeners_installed = True
+        try:
+            from jax import monitoring
+        except ImportError:      # pragma: no cover - jax is a hard dep
+            return
+
+        def on_event(event, **kw):
+            name = _JAX_COUNT_EVENTS.get(event)
+            if name is not None:
+                self.counter_add(name)
+
+        def on_duration(event, duration, **kw):
+            names = _JAX_DURATION_EVENTS.get(event)
+            if names is None:
+                return
+            self.counter_add(names[0])
+            self.counter_add(names[1], float(duration))
+            if self._level >= 2:
+                now = time.perf_counter()
+                self.record_span(event.rsplit("/", 1)[-1],
+                                 now - float(duration), float(duration),
+                                 tid="jax-compile")
+
+        monitoring.register_event_listener(on_event)
+        monitoring.register_event_duration_secs_listener(on_duration)
+
+    # ------------------------------------------------------------- output
+    def stats(self) -> Dict[str, Any]:
+        """Versioned stats dict: phases (from the global PhaseTimer),
+        counters, gauges, network collective counters, the per-iteration
+        timeline and span-buffer occupancy."""
+        import sys
+        from .phase import GLOBAL_TIMER, _sync_enabled
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timeline = list(self._timeline)
+            recorded = self._spans_recorded
+            kept = len(self._spans)
+            capacity = self._spans.maxlen
+        phases = {name: {"seconds": round(sec, 6), "count": cnt}
+                  for name, (sec, cnt) in GLOBAL_TIMER.snapshot().items()}
+        network: Dict[str, Any] = {}
+        net = sys.modules.get("lightgbm_tpu.parallel.network")
+        if net is not None and hasattr(net, "collective_stats"):
+            network = net.collective_stats()
+        return {
+            "version": 1,
+            "level": self._level,
+            "mode": "sync" if _sync_enabled() else "dispatch",
+            "phases": phases,
+            "counters": counters,
+            "gauges": gauges,
+            "network": network,
+            "timeline": timeline,
+            "spans": {"recorded": recorded, "kept": kept,
+                      "dropped": recorded - kept, "capacity": capacity},
+        }
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON (the ``{"traceEvents": [...]}`` object
+        form): one complete ("X") event per span, one counter ("C") event
+        per timeline counter delta, plus thread-name metadata."""
+        with self._lock:
+            spans = list(self._spans)
+            timeline = list(self._timeline)
+        pid = os.getpid()
+        events = []
+        tids: Dict[str, int] = {}
+
+        def tid_of(label: str) -> int:
+            if label not in tids:
+                tids[label] = len(tids) + 1
+                events.append({"name": "thread_name", "ph": "M",
+                               "pid": pid, "tid": tids[label],
+                               "args": {"name": label}})
+            return tids[label]
+
+        for ts, dur, name, label, args in spans:
+            ev = {"name": name, "cat": "lightgbm_tpu", "ph": "X",
+                  "ts": round(ts, 3), "dur": round(dur, 3),
+                  "pid": pid, "tid": tid_of(label)}
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        for entry in timeline:
+            ts = entry["t"] * 1e6
+            for cname, delta in entry["counters"].items():
+                events.append({"name": cname, "ph": "C", "pid": pid,
+                               "tid": 0, "ts": round(ts, 3),
+                               "args": {"value": delta}})
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"schema": METRICS_SCHEMA}}
+
+    def export_trace(self, path: str) -> None:
+        try:
+            with open(path, "w") as fh:
+                json.dump(self.chrome_trace(), fh)
+        except OSError as e:
+            from .log import log_warning
+            log_warning(f"could not write trace JSON to {path}: {e}")
+
+    def maybe_export_trace(self) -> None:
+        """Write the Chrome trace to ``LIGHTGBM_TPU_TRACE_JSON`` if set.
+        Called at the end of training and (backstop) at process exit."""
+        path = os.environ.get("LIGHTGBM_TPU_TRACE_JSON")
+        if path:
+            self.export_trace(path)
+
+    def metrics_blob(self) -> Dict[str, Any]:
+        """The versioned JSON blob written by the CLI ``metrics_out=``
+        parameter and embedded in bench results."""
+        blob = {"schema": METRICS_SCHEMA}
+        blob.update(self.stats())
+        return blob
+
+    # -------------------------------------------------------------- reset
+    def reset(self) -> None:
+        """Clear all recorded data (not the config level or installed
+        listeners) and re-zero the time base; also resets the network
+        collective counters so a measurement window starts clean."""
+        import sys
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._spans.clear()
+            self._spans_recorded = 0
+            self._timeline.clear()
+            self._iter_snapshot = {}
+            self._epoch = time.perf_counter()
+            self._writer = None
+            self._race_flagged = False
+        net = sys.modules.get("lightgbm_tpu.parallel.network")
+        if net is not None and hasattr(net, "reset_collective_stats"):
+            net.reset_collective_stats()
+        self.refresh_level()
+
+
+TELEMETRY = TelemetryRegistry()
+
+# an exception that unwinds past the training loop must not lose an
+# almost-complete trace: export whatever was recorded at process exit
+atexit.register(TELEMETRY.maybe_export_trace)

@@ -1,0 +1,305 @@
+"""Training telemetry subsystem (utils/telemetry.py).
+
+Covers the four ISSUE acceptance surfaces: the Chrome trace export
+schema (valid trace-event JSON with the required span names), exact
+fetch-byte counters for a deterministic 2-chunk run, compaction
+counters under LIGHTGBM_TPU_SEG_STATS, and the ``telemetry_level=0``
+off switch (no spans, no counters, no timeline).  Plus the registry's
+thread-safety / single-writer check (the reference Network keeps all
+collectives on one thread; here a second writer is flagged, not
+fatal), the parallel/network.py collective counters, the CLI
+``metrics_out=`` path and the tools/trace_report.py digest.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import Application
+from lightgbm_tpu.parallel import network
+from lightgbm_tpu.utils.phase import GLOBAL_TIMER
+from lightgbm_tpu.utils.telemetry import (METRICS_SCHEMA, TELEMETRY,
+                                          TelemetryRegistry)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import trace_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    """TELEMETRY is process-global: start every test from a clean window
+    (reset also clears the network counters and re-reads the level)."""
+    GLOBAL_TIMER.reset()
+    TELEMETRY.reset()
+    yield
+    GLOBAL_TIMER.reset()
+    TELEMETRY.reset()
+
+
+def make_binary(rng, n=500, f=5):
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _params(**kw):
+    p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+         "min_data_in_leaf": 5, "verbose": -1}
+    p.update(kw)
+    return p
+
+
+# ---------------------------------------------------------------- trace
+
+
+def test_trace_export_schema(rng, tmp_path, monkeypatch):
+    trace_path = tmp_path / "trace.json"
+    monkeypatch.setenv("LIGHTGBM_TPU_TRACE_JSON", str(trace_path))
+    TELEMETRY.refresh_level()
+    assert TELEMETRY.level >= 2, "TRACE_JSON must force span recording"
+
+    X, y = make_binary(rng)
+    bst = lgb.train(_params(), lgb.Dataset(X, y), num_boost_round=3)
+
+    assert trace_path.exists(), "engine.train must export the trace"
+    blob = json.loads(trace_path.read_text())
+    events = blob["traceEvents"]
+    assert isinstance(events, list) and events
+    assert blob["otherData"]["schema"] == METRICS_SCHEMA
+
+    span_names = set()
+    for ev in events:
+        assert {"name", "ph", "pid", "tid"} <= set(ev)
+        assert ev["ph"] in ("X", "C", "M")
+        if ev["ph"] == "X":        # complete event: microsecond ts + dur
+            assert {"ts", "dur", "cat"} <= set(ev)
+            assert isinstance(ev["tid"], int)
+            assert ev["dur"] >= 0
+            span_names.add(ev["name"])
+    assert {"boost", "grow", "fetch"} <= span_names
+
+    # the same data is reachable through the stats API
+    stats = bst.get_stats()
+    assert stats["version"] == 1
+    assert stats["level"] >= 2
+    assert stats["spans"]["recorded"] > 0
+    assert stats["spans"]["dropped"] == 0
+    assert bst.train_stats["counters"] == stats["counters"]
+
+
+# ------------------------------------------------------------- counters
+
+
+def test_fetch_counters_exact_for_two_chunk_run(rng):
+    """4 iterations at tpu_boost_chunk=2 -> exactly 2 chunk fetches, and
+    the byte count matches the packed tree-buffer layout: for L leaves
+    (n = L-1 internal nodes) the int32 block is 1+14n+2L words and the
+    float32 block 4n+3L words (models/grower.py pack layout)."""
+    L = 7
+    X, y = make_binary(rng, n=600)
+    bst = lgb.train(_params(num_leaves=L, tpu_boost_chunk=2),
+                    lgb.Dataset(X, y), num_boost_round=4)
+    stats = bst.get_stats()
+    c = stats["counters"]
+    assert c["transfer/fetch_calls"] == 2
+
+    n = L - 1
+    per_tree = (1 + 14 * n + 2 * L) * 4 + (4 * n + 3 * L) * 4
+    assert c["transfer/fetch_bytes"] == 4 * per_tree
+    assert c["transfer/h2d_bytes"] > 0
+
+    assert stats["gauges"]["boost/chunk_size"] == 2
+    timeline = stats["timeline"]
+    assert sum(e["count"] for e in timeline) == 4
+    # every timeline entry carries the counter deltas for its window
+    assert any("transfer/fetch_bytes" in e["counters"] for e in timeline)
+
+
+def test_compaction_counters_under_seg_stats(rng, monkeypatch):
+    """LIGHTGBM_TPU_SEG_STATS opts into fetching the segment grower's
+    device counters; the training shape crosses the compaction
+    milestones (test_grower_seg.py) so at least one compaction lands in
+    seg/compactions."""
+    monkeypatch.setenv("LIGHTGBM_TPU_SEG_STATS", "1")
+    X, y = make_binary(rng, n=800, f=8)
+    bst = lgb.train(_params(num_leaves=15, tpu_tree_impl="segment",
+                            tpu_histogram_backend="pallas"),
+                    lgb.Dataset(X, y), num_boost_round=3)
+    c = bst.get_stats()["counters"]
+    assert c.get("seg/compactions", 0) >= 1
+    assert c.get("seg/scanned_blocks", 0) > 0
+
+
+def test_level0_adds_nothing(rng):
+    X, y = make_binary(rng)
+    bst = lgb.train(_params(telemetry_level=0), lgb.Dataset(X, y),
+                    num_boost_round=2)
+    stats = bst.get_stats()
+    assert stats["level"] == 0
+    assert stats["counters"] == {}
+    assert stats["gauges"] == {}
+    assert stats["timeline"] == []
+    assert stats["spans"]["recorded"] == 0
+
+
+def test_compile_listeners_count_retraces(rng):
+    X, y = make_binary(rng)
+    bst = lgb.train(_params(), lgb.Dataset(X, y), num_boost_round=2)
+    c = bst.get_stats()["counters"]
+    # a cold 2-iteration run traces and compiles at least once
+    assert c.get("compile/retraces", 0) >= 1
+    assert c.get("compile/retrace_seconds", 0) > 0
+    assert c.get("compile/backend_compiles", 0) >= 1
+
+
+# ------------------------------------------------------- thread safety
+
+
+def test_registry_thread_safety_and_writer_check(monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_TELEMETRY", "2")
+    reg = TelemetryRegistry(span_capacity=64)
+    nthreads, per = 8, 400
+
+    def work():
+        for _ in range(per):
+            reg.counter_add("t/hits")
+            with reg.span("t_span"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    stats = reg.stats()
+    assert stats["counters"]["t/hits"] == nthreads * per
+    # single-writer check: the second thread is flagged exactly once
+    assert stats["counters"]["telemetry/writer_races"] == 1
+    # ring buffer: all spans counted, only the last `capacity` kept
+    assert stats["spans"]["recorded"] == nthreads * per
+    assert stats["spans"]["kept"] == 64
+    assert stats["spans"]["dropped"] == nthreads * per - 64
+
+
+# -------------------------------------------------------------- network
+
+
+def test_network_allgather_obj_counters():
+    def fake_allgather(blob):
+        return [blob, blob]
+
+    network.init_with_functions(lambda *a: None, fake_allgather,
+                                rank=0, num_machines=2)
+    try:
+        out = network.allgather_obj({"mapper": 7})
+    finally:
+        network.dispose()
+    assert out == [{"mapper": 7}, {"mapper": 7}]
+
+    st = network.collective_stats()
+    assert st["allgather_obj"]["calls"] == 1
+    assert st["allgather_obj"]["bytes"] > 0
+    assert st["allgather_obj"]["seconds"] >= 0.0
+
+    # rendered into the phase summary line and the stats blob
+    assert "allgather_obj=1x" in network.collective_summary()
+    assert "allgather_obj=1x" in GLOBAL_TIMER.summary()
+    assert TELEMETRY.stats()["network"]["allgather_obj"]["calls"] == 1
+
+    network.reset_collective_stats()
+    assert network.collective_stats() == {}
+    assert network.collective_summary() == ""
+
+
+def test_network_single_writer_check():
+    network.record_collective("main_kind", 10, 0.001)
+
+    def other():
+        network.record_collective("other_kind", 20, 0.002)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    st = network.collective_stats()
+    assert st["main_kind"]["calls"] == 1
+    assert st["other_kind"]["calls"] == 1   # consistent despite the race
+    assert network._coll_race_warned
+
+
+def test_network_disabled_at_level0(monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_TELEMETRY", "0")
+    TELEMETRY.refresh_level()
+    network.record_collective("nope", 100, 1.0)
+    assert network.collective_stats() == {}
+
+
+def test_parallel_learner_records_collectives(rng):
+    X, y = make_binary(rng, n=1000, f=8)
+    bst = lgb.train(_params(num_leaves=15, tree_learner="data"),
+                    lgb.Dataset(X, y), num_boost_round=3)
+    net = bst.get_stats()["network"]
+    assert net, "data-parallel training must record collectives"
+    assert sum(v["calls"] for v in net.values()) >= 3   # one per tree
+    assert sum(v["bytes"] for v in net.values()) > 0    # mesh-math estimate
+
+
+# ------------------------------------------------------------- surfaces
+
+
+def test_cli_metrics_out(tmp_path, rng):
+    X, y = make_binary(rng, n=300)
+    train = tmp_path / "train.csv"
+    np.savetxt(train, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+    model = tmp_path / "model.txt"
+    metrics = tmp_path / "metrics.json"
+    Application([
+        "task=train", f"data={train}", "objective=binary",
+        "num_trees=2", "num_leaves=7", f"output_model={model}",
+        f"metrics_out={metrics}", "verbosity=-1",
+    ]).run()
+    assert metrics.exists()
+    blob = json.loads(metrics.read_text())
+    assert blob["schema"] == METRICS_SCHEMA
+    assert blob["version"] == 1
+    assert blob["phases"], "the CLI run must have recorded phases"
+    assert blob["counters"]["transfer/fetch_calls"] >= 1
+
+
+def test_trace_report_summarize(rng, tmp_path, capsys):
+    X, y = make_binary(rng)
+    bst = lgb.train(_params(), lgb.Dataset(X, y), num_boost_round=2)
+    blob = bst.get_stats()
+
+    text = trace_report.summarize(blob)
+    assert "telemetry summary" in text
+    assert "phases" in text
+    assert "transfers:" in text
+
+    # also accepts a bench record wrapping the blob under "metrics"
+    record = tmp_path / "bench_record.json"
+    record.write_text(json.dumps({"wall": 1.0, "metrics": blob}))
+    assert trace_report.main([str(record)]) == 0
+    assert "telemetry summary" in capsys.readouterr().out
+
+
+def test_profile_session_is_exception_safe(monkeypatch, tmp_path):
+    """An exception inside the profiler window must still stop the
+    trace (a leaked session poisons every later start_trace)."""
+    from lightgbm_tpu.utils import phase
+
+    started, stopped = [], []
+    monkeypatch.setattr(phase, "maybe_start_profile",
+                        lambda: started.append(1))
+    monkeypatch.setattr(phase, "maybe_stop_profile",
+                        lambda: stopped.append(1))
+    with pytest.raises(RuntimeError):
+        with phase.profile_session():
+            raise RuntimeError("boom")
+    assert started == [1] and stopped == [1]

@@ -81,6 +81,15 @@ def generate() -> str:
         "  into chunk-boundary granularity.",
         "- `tpu_double_precision` — accumulate histograms in",
         "  f64-equivalent precision.",
+        "- `telemetry_level` — training telemetry (see",
+        "  docs/OBSERVABILITY.md): `0` off, `1` (default) counters +",
+        "  gauges + per-iteration timeline, `2` adds spans for Chrome",
+        "  trace export.  The `LIGHTGBM_TPU_TELEMETRY` env var overrides;",
+        "  a set `LIGHTGBM_TPU_TRACE_JSON=<path>` forces level >= 2 and",
+        "  writes the trace there.",
+        "- `metrics_out` — CLI training only: write the versioned",
+        "  telemetry JSON blob (schema `lightgbm_tpu.metrics/v1`) to this",
+        "  path after training.",
         "",
     ]
     return "\n".join(lines)

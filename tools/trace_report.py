@@ -1,0 +1,115 @@
+"""Summarize a lightgbm_tpu metrics JSON blob for perf rounds.
+
+Input: a metrics dict as produced by ``TELEMETRY.metrics_blob()`` /
+``Booster.get_stats()`` — the blob the CLI writes for ``metrics_out=``,
+``bench.py`` embeds under ``"metrics"``, and ``engine.train`` attaches
+as ``booster.train_stats``.
+
+Usage:
+  python tools/trace_report.py metrics.json          # a raw blob
+  python tools/trace_report.py BENCH_r05.json        # a bench record
+                                                     # (reads .metrics)
+
+Prints top phases, transfer bytes, compile counters/seconds, network
+collective counters and the iteration count — the digest VERDICT /
+PERF_NOTES rounds quote instead of regex-parsing stderr tails.
+"""
+
+import json
+import sys
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GB"
+
+
+def summarize(stats: dict, top: int = 6) -> str:
+    """Multi-line human-readable digest of one metrics blob."""
+    lines = []
+    mode = stats.get("mode", "?")
+    lines.append(f"telemetry summary [level={stats.get('level')} "
+                 f"mode={mode}]")
+
+    phases = stats.get("phases") or {}
+    if phases:
+        total = sum(p.get("seconds", 0.0) for p in phases.values())
+        ranked = sorted(phases.items(),
+                        key=lambda kv: -kv[1].get("seconds", 0.0))[:top]
+        parts = [f"{name}={p['seconds']:.3f}s/{p.get('count', 0)}"
+                 for name, p in ranked]
+        lines.append(f"  phases ({mode}) total={total:.3f}s: "
+                     + " ".join(parts))
+
+    counters = stats.get("counters") or {}
+    fetch_b = counters.get("transfer/fetch_bytes", 0)
+    fetch_n = counters.get("transfer/fetch_calls", 0)
+    h2d_b = counters.get("transfer/h2d_bytes", 0)
+    if fetch_n or h2d_b:
+        lines.append(f"  transfers: d2h {_fmt_bytes(fetch_b)} in "
+                     f"{int(fetch_n)} fetches, h2d {_fmt_bytes(h2d_b)}")
+    compiles = {k: v for k, v in counters.items()
+                if k.startswith("compile/")}
+    if compiles:
+        lines.append(
+            "  compile: "
+            f"{int(compiles.get('compile/backend_compiles', 0))} backend "
+            f"compiles ({compiles.get('compile/backend_compile_seconds', 0.0):.2f}s), "
+            f"{int(compiles.get('compile/retraces', 0))} retraces "
+            f"({compiles.get('compile/retrace_seconds', 0.0):.2f}s), "
+            f"cache {int(compiles.get('compile/cache_hits', 0))} hits / "
+            f"{int(compiles.get('compile/cache_misses', 0))} misses")
+    seg = {k: v for k, v in counters.items() if k.startswith("seg/")}
+    if seg:
+        lines.append(f"  segment grower: "
+                     f"{int(seg.get('seg/scanned_blocks', 0))} blocks "
+                     f"scanned, {int(seg.get('seg/compactions', 0))} "
+                     f"compactions")
+
+    network = stats.get("network") or {}
+    if network:
+        parts = [f"{k}={v['calls']}x/{_fmt_bytes(v['bytes'])}/"
+                 f"{v['seconds']:.3f}s"
+                 for k, v in sorted(network.items())]
+        lines.append("  network: " + " ".join(parts))
+
+    gauges = stats.get("gauges") or {}
+    if gauges:
+        parts = [f"{k}={v:g}" for k, v in sorted(gauges.items())]
+        lines.append("  gauges: " + " ".join(parts))
+
+    timeline = stats.get("timeline") or []
+    if timeline:
+        iters = sum(e.get("count", 1) for e in timeline)
+        span = timeline[-1]["t"] - (timeline[0]["t"]
+                                    if len(timeline) > 1 else 0.0)
+        lines.append(f"  timeline: {iters} iterations in "
+                     f"{len(timeline)} marks over {span:.3f}s")
+
+    spans = stats.get("spans") or {}
+    if spans.get("recorded"):
+        lines.append(f"  spans: {spans['recorded']} recorded, "
+                     f"{spans.get('dropped', 0)} dropped "
+                     f"(capacity {spans.get('capacity')})")
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    if len(argv) != 1:
+        print(__doc__)
+        return 2
+    with open(argv[0]) as fh:
+        blob = json.load(fh)
+    # accept a bench record wrapping the blob under "metrics"
+    if "phases" not in blob and isinstance(blob.get("metrics"), dict):
+        blob = blob["metrics"]
+    print(summarize(blob))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
