@@ -147,7 +147,7 @@ def run_tier_child(platform: str, n_rows: int, warmup: int,
     from lightgbm_tpu.utils.telemetry import TELEMETRY
     GLOBAL_TIMER.reset()   # phase summary covers only the measured window
     TELEMETRY.reset()      # counters/timeline cover only the measured window
-    with profile_session():
+    with profile_session(), TELEMETRY.memory_session():
         t0 = time.time()
         run_iters(measure)
         jax.block_until_ready(booster.train_score)

@@ -217,8 +217,11 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
     GLOBAL_TIMER.reset()
     TELEMETRY.reset()      # counters/timeline cover only the measured window
     t0 = time.time()
-    run_iters(measure)
-    jax.block_until_ready(bst.gbdt.train_score)
+    # memory_session brackets the window with HBM gauge samples (no-op on
+    # backends without memory_stats) and owns the optional sampler thread
+    with TELEMETRY.memory_session():
+        run_iters(measure)
+        jax.block_until_ready(bst.gbdt.train_score)
     per_iter = (time.time() - t0) / measure
     # snapshot BEFORE the quality-gate extra iterations below so the
     # blob matches the timed window
@@ -356,6 +359,9 @@ def run_config(config: str, probe_ok: bool) -> dict | None:
             "chunk": r.get("chunk", 1),
             "quality": r["quality"],
             "quality_ok": r["quality_ok"],
+            # the measured window's v2 telemetry blob (phases, transfer
+            # bytes, memory/cost envelope) rides along with every record
+            "metrics": r.get("metrics"),
         }
         if ref is not None:
             scaled = ref * r["rows"] / REF_ROWS.get(config, r["rows"])
@@ -369,6 +375,33 @@ def run_config(config: str, probe_ok: bool) -> dict | None:
             out["fallback"] = True
         return out
     return None
+
+
+def _append_trajectory(results: list) -> None:
+    """One digest line per run appended to BENCH_TRAJECTORY.jsonl — the
+    machine-readable perf trajectory across PRs (wall, peak HBM, est.
+    FLOPs).  Null-tolerant: v1 blobs / CPU backends leave the memory and
+    cost fields as null rather than breaking the append."""
+    path = os.path.join(REPO, "BENCH_TRAJECTORY.jsonl")
+    with open(path, "a") as fh:
+        for r in results:
+            m = r.get("metrics") or {}
+            mem = m.get("memory") or {}
+            cost = m.get("cost") or {}
+            fh.write(json.dumps({
+                "ts": round(time.time(), 3),
+                "config": r.get("config"),
+                "metric": r.get("metric"),
+                "value": r.get("value"),
+                "unit": r.get("unit"),
+                "impl": r.get("impl"),
+                "chunk": r.get("chunk"),
+                "quality_ok": r.get("quality_ok"),
+                "peak_hbm_bytes": mem.get("peak_bytes_in_use"),
+                "hbm_limit_bytes": mem.get("bytes_limit"),
+                "est_flops": cost.get("flops_total"),
+                "est_flops_per_s": cost.get("est_flops_per_s"),
+            }) + "\n")
 
 
 def main():
@@ -385,6 +418,7 @@ def main():
                  "value": -1.0, "unit": "s", "quality_ok": False}
         results.append(r)
         print(json.dumps(r), flush=True)
+    _append_trajectory(results)
     # subset runs merge into the existing artifact instead of clobbering
     # the other configs' records
     path = os.path.join(REPO, "BENCH_SUITE.json")

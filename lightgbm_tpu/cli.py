@@ -124,7 +124,7 @@ class Application:
         done = 0
         # profiler window is exception-safe: a mid-training error must
         # not leak an open jax profiler trace session
-        with profile_session():
+        with profile_session(), TELEMETRY.memory_session():
             while done < cfg.num_iterations:
                 step = min(chunk, cfg.num_iterations - done)
                 for f in freqs:

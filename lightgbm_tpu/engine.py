@@ -122,7 +122,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # profiler trace session
     from .utils.phase import profile_session
     from .utils.telemetry import TELEMETRY
-    with profile_session():
+    # memory_session brackets the run with HBM gauge samples and owns the
+    # optional background sampler's lifetime (stopped even when a callback
+    # or device error raises out of the loop)
+    with profile_session(), TELEMETRY.memory_session():
         i = 0
         while i < num_boost_round:
             step = min(chunk, num_boost_round - i)

@@ -25,6 +25,7 @@ import numpy as np
 from ..config import Config
 from ..core.dataset import TpuDataset
 from ..ops.split import FeatureMeta, SplitParams
+from ..utils.jitcost import cost_jit
 from ..utils.log import check, log_fatal, log_info, log_warning
 from ..utils.phase import GLOBAL_TIMER as _PHASES
 from ..utils.telemetry import TELEMETRY
@@ -168,17 +169,19 @@ def build_feature_meta(dataset: TpuDataset, config=None,
     )
 
 
-@jax.jit
-def _add_tree_score(score, leaf_values, leaf_id):
+def _add_tree_score_core(score, leaf_values, leaf_id):
     return score + leaf_values[leaf_id]
 
 
-@jax.jit
-def _apply_tree_score(score, leaf_values, leaf_id, shrinkage):
+def _apply_tree_score_core(score, leaf_values, leaf_id, shrinkage):
     """Device-side score update straight from the grower's output — no host
     round-trip in the training loop (shrinkage folded in here; the stored
     model applies it at materialization)."""
     return score + shrinkage * leaf_values[leaf_id]
+
+
+_add_tree_score = cost_jit("score/add", jax.jit(_add_tree_score_core))
+_apply_tree_score = cost_jit("score/apply", jax.jit(_apply_tree_score_core))
 
 
 class GBDT:
@@ -677,7 +680,7 @@ class GBDT:
                 return obj.get_gradients(score)
             return _with_arrs(run, arrs)
 
-        fused_grad = jax.jit(grad_core)
+        fused_grad = cost_jit("boost/gradients", jax.jit(grad_core))
 
         # multiclass batched roots: all C class-trees' root histograms in
         # ONE kernel pass (C x fewer full-data scans per iteration; the
@@ -717,7 +720,7 @@ class GBDT:
                 out = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
                 return jax.vmap(unpack_hist)(out)[:, :G_cols]
 
-            fused_roots = jax.jit(roots_core)
+            fused_roots = cost_jit("grow/roots", jax.jit(roots_core))
         else:
             fused_roots = roots_core = None
 
@@ -755,8 +758,9 @@ class GBDT:
             ints_d, floats_d = _pack_tree_device(arrays)
             return score, ints_d, floats_d, tuple(stats)
 
-        fused_step = functools.partial(jax.jit,
-                                       donate_argnums=(0,))(step_core)
+        fused_step = cost_jit(
+            "grow/fused_step",
+            functools.partial(jax.jit, donate_argnums=(0,))(step_core))
 
         self._fused_fns = (fused_grad, fused_step, fused_roots)
         # un-jitted building blocks; the chunked loop retraces them inside
@@ -804,6 +808,7 @@ class GBDT:
                 body, (score, key), None, length=T)
             return score, key, ints_all, floats_all
 
+        chunk_run = cost_jit(f"boost/chunk[{T}]", chunk_run)
         self._chunk_fns[T] = chunk_run
         return chunk_run
 

@@ -691,4 +691,5 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
 
     if wrap is not None:
         return wrap(grow)
-    return jax.jit(grow)
+    from ..utils.jitcost import cost_jit
+    return cost_jit("grow/fused", jax.jit(grow))

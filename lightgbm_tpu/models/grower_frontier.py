@@ -447,4 +447,5 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
 
     if wrap is not None:
         return wrap(grow)
-    return jax.jit(grow)
+    from ..utils.jitcost import cost_jit
+    return cost_jit("grow/frontier", jax.jit(grow))

@@ -63,6 +63,7 @@ class PhaseTimer:
                 self.counts[name] += 1
             from .telemetry import TELEMETRY
             TELEMETRY.record_span(name, t0, dur)
+            TELEMETRY.sample_memory(name)
 
     def reset(self) -> None:
         with self._lock:

@@ -34,6 +34,21 @@ Compile visibility comes from ``jax.monitoring`` listeners
 (install_jax_listeners): retrace counts/seconds, backend compile
 counts/seconds and compilation-cache hits/misses — cold-vs-warm cache
 behavior is measurable instead of inferred from wall-clock cliffs.
+
+The v2 schema adds two DEVICE-side sections on top of the host view:
+
+  * ``memory`` — HBM gauges from ``device.memory_stats()`` (bytes in
+    use, peak, largest allocation, the device byte limit), sampled at
+    phase boundaries (utils/phase.py) and optionally by a low-rate
+    background thread (``LIGHTGBM_TPU_MEM_SAMPLE_MS``, off by default)
+    whose samples feed a ``mem/*`` counter track in the Chrome trace.
+    Backends whose ``memory_stats()`` returns ``None`` (CPU) cleanly
+    omit the section.  Reading allocator stats never syncs the device.
+  * ``cost`` — static XLA ``Compiled.cost_analysis()`` (flops, bytes
+    accessed, transcendentals) harvested once per compiled executable
+    at the jit seams (utils/jitcost.py), keyed by function label and
+    multiplied out by call counts, so ``stats()`` can report
+    estimated FLOPs/s and bytes/s for the measured window.
 """
 
 from __future__ import annotations
@@ -47,9 +62,10 @@ from collections import defaultdict, deque
 from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
-METRICS_SCHEMA = "lightgbm_tpu.metrics/v1"
+METRICS_SCHEMA = "lightgbm_tpu.metrics/v2"
 SPAN_CAPACITY = 65536
 TIMELINE_CAPACITY = 8192
+MEM_TRACK_CAPACITY = 16384
 
 # jax.monitoring event name -> (count counter, seconds counter)
 _JAX_DURATION_EVENTS = {
@@ -87,6 +103,24 @@ class TelemetryRegistry:
         # a second one is recorded (and warned about) once, not fatal
         self._writer: Optional[int] = None
         self._race_flagged = False
+        # ------ device memory (HBM) accounting ------
+        # tri-state support flag: None = unknown, False = backend has no
+        # memory_stats (CPU) — once False, sampling short-circuits
+        self._mem_supported: Optional[bool] = None
+        self._mem_device = None
+        self._mem_last: Optional[int] = None
+        self._mem_peak = 0
+        self._mem_largest = 0
+        self._mem_limit: Optional[int] = None
+        self._mem_phase: Dict[str, Dict[str, int]] = {}
+        # (t_offset_s, bytes_in_use) from the background sampler, for
+        # the Chrome-trace mem/* counter track
+        self._mem_track: deque = deque(maxlen=MEM_TRACK_CAPACITY)
+        self._mem_thread: Optional[threading.Thread] = None
+        self._mem_stop: Optional[threading.Event] = None
+        self._mem_interval_ms = 0.0
+        # ------ XLA cost analysis (per jit-seam label) ------
+        self._costs: Dict[str, Dict[str, float]] = {}
         self._level = self._resolve_level()
 
     # ------------------------------------------------------------- level
@@ -234,11 +268,194 @@ class TelemetryRegistry:
         monitoring.register_event_listener(on_event)
         monitoring.register_event_duration_secs_listener(on_duration)
 
+    # ------------------------------------------------------- device memory
+    def _device_memory_stats(self) -> Optional[Dict[str, Any]]:
+        """Raw ``memory_stats()`` of the default device, or ``None`` on
+        backends that do not report allocator stats (CPU).  The first
+        ``None`` latches ``_mem_supported = False`` so later samples are
+        a single attribute compare.  Reading allocator stats is a local
+        runtime query — it never blocks on in-flight device work."""
+        if self._mem_supported is False:
+            return None
+        try:
+            if self._mem_device is None:
+                import jax
+                self._mem_device = jax.local_devices()[0]
+            ms = self._mem_device.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            self._mem_supported = False
+            return None
+        self._mem_supported = True
+        return ms
+
+    def sample_memory(self, phase: Optional[str] = None) -> None:
+        """Fold one allocator snapshot into the memory gauges; ``phase``
+        attributes the bytes-in-use high-water mark to a named phase
+        (called at phase boundaries by utils/phase.py).  No-op below
+        level 1 or on backends without memory stats."""
+        if self._level < 1 or self._mem_supported is False:
+            return
+        ms = self._device_memory_stats()
+        if ms is None:
+            return
+        in_use = int(ms.get("bytes_in_use", 0))
+        peak = int(ms.get("peak_bytes_in_use", in_use))
+        # no _note_writer here: the background sampler is an EXPECTED
+        # second thread; gauges are simple maxes under the lock
+        with self._lock:
+            if "bytes_limit" in ms:
+                self._mem_limit = int(ms["bytes_limit"])
+            self._mem_largest = max(self._mem_largest,
+                                    int(ms.get("largest_alloc_size", 0)))
+            self._mem_last = in_use
+            self._mem_peak = max(self._mem_peak, peak, in_use)
+            if phase:
+                e = self._mem_phase.setdefault(
+                    phase, {"bytes_in_use_max": 0, "samples": 0})
+                e["bytes_in_use_max"] = max(e["bytes_in_use_max"], in_use)
+                e["samples"] += 1
+
+    def start_mem_sampler(self) -> None:
+        """Start the background HBM sampler thread when
+        ``LIGHTGBM_TPU_MEM_SAMPLE_MS`` requests one (off by default).
+        Idempotent; the thread is a daemon and additionally bounded by
+        stop_mem_sampler, so it can never outlive the training window
+        it was started for."""
+        if self._level < 1 or self._mem_thread is not None:
+            return
+        raw = os.environ.get("LIGHTGBM_TPU_MEM_SAMPLE_MS", "")
+        try:
+            interval_ms = float(raw)
+        except ValueError:
+            interval_ms = 0.0
+        if interval_ms <= 0:
+            return
+        stop = threading.Event()
+
+        def run() -> None:
+            while not stop.wait(interval_ms / 1000.0):
+                if self._mem_supported is False:
+                    return          # nothing to sample; exit quietly
+                self.sample_memory()
+                ms = self._mem_last
+                if ms is not None:
+                    with self._lock:
+                        self._mem_track.append(
+                            (time.perf_counter() - self._epoch, ms))
+
+        self._mem_stop = stop
+        self._mem_interval_ms = interval_ms
+        self._mem_thread = threading.Thread(target=run, name="mem-sampler",
+                                            daemon=True)
+        self._mem_thread.start()
+
+    def stop_mem_sampler(self) -> None:
+        """Stop and join the background sampler (idempotent)."""
+        t, stop = self._mem_thread, self._mem_stop
+        self._mem_thread = None
+        self._mem_stop = None
+        if stop is not None:
+            stop.set()
+        if t is not None:
+            t.join(timeout=5.0)
+
+    @contextmanager
+    def memory_session(self):
+        """Device-memory window around a training run: one boundary
+        sample on entry and exit, plus the opt-in background sampler —
+        exception-safe, so an error mid-training never leaks the
+        sampler thread."""
+        self.sample_memory("session")
+        self.start_mem_sampler()
+        try:
+            yield
+        finally:
+            self.stop_mem_sampler()
+            self.sample_memory("session")
+
+    def _memory_section(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            if self._mem_last is None:
+                return None
+            out: Dict[str, Any] = {
+                "bytes_in_use": self._mem_last,
+                "peak_bytes_in_use": self._mem_peak,
+                "largest_alloc": self._mem_largest,
+                "phases": {k: dict(v) for k, v in self._mem_phase.items()},
+            }
+            if self._mem_limit is not None:
+                out["bytes_limit"] = self._mem_limit
+            if self._mem_interval_ms > 0:
+                out["sampler"] = {"interval_ms": self._mem_interval_ms,
+                                  "samples": len(self._mem_track)}
+            return out
+
+    # --------------------------------------------------- XLA cost analysis
+    def record_cost(self, label: str, analysis: Dict[str, float]) -> None:
+        """Bind one compiled executable's static cost analysis to a jit
+        seam label (utils/jitcost.py harvests it once per compile).  The
+        per-call numbers become the increment applied by cost_call."""
+        if self._level < 1:
+            return
+        with self._lock:
+            e = self._costs.setdefault(label, {
+                "flops": 0.0, "bytes_accessed": 0.0,
+                "transcendentals": 0.0, "calls": 0, "compiles": 0,
+                "flops_total": 0.0, "bytes_total": 0.0})
+            e["flops"] = float(analysis.get("flops", 0.0))
+            e["bytes_accessed"] = float(analysis.get("bytes_accessed", 0.0))
+            e["transcendentals"] = float(
+                analysis.get("transcendentals", 0.0))
+            # executable working set (memory_analysis), when available
+            for k in ("temp_bytes", "argument_bytes", "output_bytes"):
+                if k in analysis:
+                    e[k] = float(analysis[k])
+            e["compiles"] += 1
+
+    def cost_call(self, label: str, count: int = 1) -> None:
+        """Count ``count`` dispatches of a cost-instrumented seam; the
+        running totals use the label's CURRENT per-call cost, so they
+        stay exact across recompiles at new shapes."""
+        if self._level < 1:
+            return
+        with self._lock:
+            e = self._costs.get(label)
+            if e is None:
+                return
+            e["calls"] += count
+            e["flops_total"] += e["flops"] * count
+            e["bytes_total"] += e["bytes_accessed"] * count
+
+    def _cost_section(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            if not self._costs:
+                return None
+            labels = {k: dict(v) for k, v in self._costs.items()}
+            elapsed = time.perf_counter() - self._epoch
+        flops_total = sum(e["flops_total"] for e in labels.values())
+        bytes_total = sum(e["bytes_total"] for e in labels.values())
+        out: Dict[str, Any] = {
+            "labels": labels,
+            "window_seconds": round(elapsed, 6),
+            "flops_total": flops_total,
+            "bytes_total": bytes_total,
+        }
+        if elapsed > 0:
+            out["est_flops_per_s"] = flops_total / elapsed
+            out["est_bytes_per_s"] = bytes_total / elapsed
+        return out
+
     # ------------------------------------------------------------- output
     def stats(self) -> Dict[str, Any]:
         """Versioned stats dict: phases (from the global PhaseTimer),
         counters, gauges, network collective counters, the per-iteration
-        timeline and span-buffer occupancy."""
+        timeline, span-buffer occupancy, and — when available — the
+        device-side ``memory`` (HBM gauges) and ``cost`` (XLA cost
+        analysis) sections.  ``memory`` is omitted on backends whose
+        ``memory_stats()`` returns None; ``cost`` is omitted when no
+        instrumented seam compiled in the window."""
         import sys
         from .phase import GLOBAL_TIMER, _sync_enabled
         with self._lock:
@@ -254,8 +471,8 @@ class TelemetryRegistry:
         net = sys.modules.get("lightgbm_tpu.parallel.network")
         if net is not None and hasattr(net, "collective_stats"):
             network = net.collective_stats()
-        return {
-            "version": 1,
+        out: Dict[str, Any] = {
+            "version": 2,
             "level": self._level,
             "mode": "sync" if _sync_enabled() else "dispatch",
             "phases": phases,
@@ -266,6 +483,13 @@ class TelemetryRegistry:
             "spans": {"recorded": recorded, "kept": kept,
                       "dropped": recorded - kept, "capacity": capacity},
         }
+        memory = self._memory_section()
+        if memory is not None:
+            out["memory"] = memory
+        cost = self._cost_section()
+        if cost is not None:
+            out["cost"] = cost
+        return out
 
     def chrome_trace(self) -> Dict[str, Any]:
         """Chrome trace-event JSON (the ``{"traceEvents": [...]}`` object
@@ -274,6 +498,7 @@ class TelemetryRegistry:
         with self._lock:
             spans = list(self._spans)
             timeline = list(self._timeline)
+            mem_track = list(self._mem_track)
         pid = os.getpid()
         events = []
         tids: Dict[str, int] = {}
@@ -299,6 +524,12 @@ class TelemetryRegistry:
                 events.append({"name": cname, "ph": "C", "pid": pid,
                                "tid": 0, "ts": round(ts, 3),
                                "args": {"value": delta}})
+        # background HBM samples as their own counter track
+        for t_off, in_use in mem_track:
+            events.append({"name": "mem/bytes_in_use", "ph": "C",
+                           "pid": pid, "tid": 0,
+                           "ts": round(t_off * 1e6, 3),
+                           "args": {"value": in_use}})
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"schema": METRICS_SCHEMA}}
 
@@ -330,6 +561,7 @@ class TelemetryRegistry:
         listeners) and re-zero the time base; also resets the network
         collective counters so a measurement window starts clean."""
         import sys
+        self.stop_mem_sampler()
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
@@ -340,6 +572,16 @@ class TelemetryRegistry:
             self._epoch = time.perf_counter()
             self._writer = None
             self._race_flagged = False
+            self._mem_supported = None
+            self._mem_device = None
+            self._mem_last = None
+            self._mem_peak = 0
+            self._mem_largest = 0
+            self._mem_limit = None
+            self._mem_phase = {}
+            self._mem_track.clear()
+            self._mem_interval_ms = 0.0
+            self._costs = {}
         net = sys.modules.get("lightgbm_tpu.parallel.network")
         if net is not None and hasattr(net, "reset_collective_stats"):
             net.reset_collective_stats()

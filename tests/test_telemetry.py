@@ -86,7 +86,7 @@ def test_trace_export_schema(rng, tmp_path, monkeypatch):
 
     # the same data is reachable through the stats API
     stats = bst.get_stats()
-    assert stats["version"] == 1
+    assert stats["version"] == 2
     assert stats["level"] >= 2
     assert stats["spans"]["recorded"] > 0
     assert stats["spans"]["dropped"] == 0
@@ -146,6 +146,9 @@ def test_level0_adds_nothing(rng):
     assert stats["gauges"] == {}
     assert stats["timeline"] == []
     assert stats["spans"]["recorded"] == 0
+    # v2 device-side sections record nothing at level 0 either
+    assert "memory" not in stats
+    assert "cost" not in stats
 
 
 def test_compile_listeners_count_retraces(rng):
@@ -267,8 +270,9 @@ def test_cli_metrics_out(tmp_path, rng):
     assert metrics.exists()
     blob = json.loads(metrics.read_text())
     assert blob["schema"] == METRICS_SCHEMA
-    assert blob["version"] == 1
+    assert blob["version"] == 2
     assert blob["phases"], "the CLI run must have recorded phases"
+    assert blob["cost"]["labels"], "CLI train must harvest seam costs"
     assert blob["counters"]["transfer/fetch_calls"] >= 1
 
 
@@ -287,6 +291,190 @@ def test_trace_report_summarize(rng, tmp_path, capsys):
     record.write_text(json.dumps({"wall": 1.0, "metrics": blob}))
     assert trace_report.main([str(record)]) == 0
     assert "telemetry summary" in capsys.readouterr().out
+
+
+# ------------------------------------------------- device-side (v2)
+
+
+_FAKE_MS = {"bytes_in_use": 1 << 20, "peak_bytes_in_use": 3 << 20,
+            "largest_alloc_size": 1 << 19, "bytes_limit": 1 << 30}
+
+
+def _fake_mem(monkeypatch, ms=None):
+    """Pretend the backend reports allocator stats (the CPU backend's
+    memory_stats() is None, so the real path can't be exercised here)."""
+    monkeypatch.setattr(TelemetryRegistry, "_device_memory_stats",
+                        lambda self: dict(ms or _FAKE_MS))
+
+
+def test_cost_section_populated_on_cpu(rng):
+    """The acceptance-criteria path: a plain CPU training run harvests
+    Compiled.cost_analysis() at the fused jit seams and multiplies it
+    out by dispatch counts."""
+    X, y = make_binary(rng)
+    bst = lgb.train(_params(), lgb.Dataset(X, y), num_boost_round=3)
+    stats = bst.get_stats()
+    assert stats["version"] == 2
+    cost = stats["cost"]
+    labels = cost["labels"]
+    assert "boost/gradients" in labels
+    assert "grow/fused_step" in labels
+    g = labels["boost/gradients"]
+    assert g["compiles"] >= 1
+    assert g["calls"] == 3                      # one dispatch per iter
+    assert g["flops"] > 0
+    assert g["flops_total"] == pytest.approx(g["flops"] * g["calls"])
+    assert cost["flops_total"] == pytest.approx(
+        sum(e["flops_total"] for e in labels.values()))
+    assert cost["window_seconds"] > 0
+    assert cost["est_flops_per_s"] > 0
+    # the digest renders the cost + utilization lines from the same blob
+    text = trace_report.summarize(stats)
+    assert "cost (" in text
+    assert "utilization:" in text
+
+
+def test_chunked_run_costs_the_scan(rng):
+    X, y = make_binary(rng, n=600)
+    bst = lgb.train(_params(tpu_boost_chunk=2), lgb.Dataset(X, y),
+                    num_boost_round=4)
+    labels = bst.get_stats()["cost"]["labels"]
+    assert labels["boost/chunk[2]"]["calls"] == 2
+    # the whole 2-iteration scan is one program: its per-call flops
+    # must dwarf a single gradient pass
+    assert (labels["boost/chunk[2]"]["flops"]
+            > labels.get("boost/gradients", {}).get("flops", 0))
+
+
+def test_memory_absent_on_cpu_without_warnings(rng):
+    """CPU memory_stats() is None -> the section is cleanly absent, no
+    warnings, and the probe latches off after the first miss."""
+    import warnings
+    X, y = make_binary(rng)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")          # any warning -> failure
+        bst = lgb.train(_params(), lgb.Dataset(X, y), num_boost_round=2)
+        stats = bst.get_stats()
+    assert "memory" not in stats
+    assert TELEMETRY._mem_supported is False    # latched: later samples
+    TELEMETRY.sample_memory("x")                # are one attribute check
+    assert "memory" not in TELEMETRY.stats()
+    assert "memory: n/a" in trace_report.summarize(stats)
+
+
+def test_memory_section_when_backend_reports(rng, monkeypatch):
+    _fake_mem(monkeypatch)
+    X, y = make_binary(rng)
+    bst = lgb.train(_params(), lgb.Dataset(X, y), num_boost_round=2)
+    mem = bst.get_stats()["memory"]
+    assert mem["bytes_in_use"] == _FAKE_MS["bytes_in_use"]
+    assert mem["peak_bytes_in_use"] == _FAKE_MS["peak_bytes_in_use"]
+    assert mem["largest_alloc"] == _FAKE_MS["largest_alloc_size"]
+    assert mem["bytes_limit"] == _FAKE_MS["bytes_limit"]
+    # phase boundaries attributed samples (engine wraps the loop in a
+    # memory_session; utils/phase.py samples at every phase exit)
+    assert mem["phases"]["session"]["samples"] >= 2
+    assert "grow" in mem["phases"]
+    assert "sampler" not in mem          # env knob off by default
+    text = trace_report.summarize(bst.get_stats())
+    assert "memory: peak 3.0MB" in text
+    assert "% peak" in text
+
+
+def test_mem_sampler_lifecycle(monkeypatch):
+    _fake_mem(monkeypatch)
+    monkeypatch.setenv("LIGHTGBM_TPU_MEM_SAMPLE_MS", "2")
+    import time as _time
+    with TELEMETRY.memory_session():
+        thread = TELEMETRY._mem_thread
+        assert thread is not None and thread.is_alive()
+        deadline = _time.time() + 5.0
+        while (not TELEMETRY._mem_track) and _time.time() < deadline:
+            _time.sleep(0.01)
+    # cleanly stopped and joined on exit
+    assert TELEMETRY._mem_thread is None
+    assert not thread.is_alive()
+    mem = TELEMETRY.stats()["memory"]
+    assert mem["sampler"]["interval_ms"] == 2.0
+    assert mem["sampler"]["samples"] >= 1
+    # the sampler feeds a counter track into the Chrome trace
+    trace = TELEMETRY.chrome_trace()
+    mem_events = [e for e in trace["traceEvents"]
+                  if e["name"] == "mem/bytes_in_use"]
+    assert mem_events and all(e["ph"] == "C" for e in mem_events)
+    assert mem_events[0]["args"]["value"] == _FAKE_MS["bytes_in_use"]
+
+
+def test_sampler_never_outlives_training_on_error(rng, monkeypatch):
+    """engine.train wraps the loop in memory_session(); a callback
+    exception must still stop and join the sampler thread."""
+    _fake_mem(monkeypatch)
+    monkeypatch.setenv("LIGHTGBM_TPU_MEM_SAMPLE_MS", "2")
+    X, y = make_binary(rng)
+
+    def boom(env):
+        raise RuntimeError("callback exploded")
+
+    with pytest.raises(RuntimeError, match="callback exploded"):
+        lgb.train(_params(), lgb.Dataset(X, y), num_boost_round=5,
+                  callbacks=[boom])
+    assert TELEMETRY._mem_thread is None
+    for t in threading.enumerate():
+        assert t.name != "mem-sampler"
+
+
+def test_sampler_noop_without_env(monkeypatch):
+    _fake_mem(monkeypatch)
+    with TELEMETRY.memory_session():
+        assert TELEMETRY._mem_thread is None
+
+
+def test_trace_report_handles_v1_blob():
+    """Older blobs lack network/timeline/memory/cost: every section must
+    render as n/a, never KeyError."""
+    v1 = {"version": 1, "level": 1, "mode": "dispatch",
+          "phases": {"grow": {"seconds": 1.5, "count": 3}},
+          "counters": {}, "gauges": {}, "timeline": [],
+          "spans": {"recorded": 0, "kept": 0, "dropped": 0,
+                    "capacity": 4096}}
+    text = trace_report.summarize(v1)
+    assert "memory: n/a" in text
+    assert "cost: n/a" in text
+    # a pathologically bare blob (no sections at all) still renders
+    bare = trace_report.summarize({})
+    assert "phases: n/a" in bare
+
+
+def test_trace_report_diff(tmp_path, capsys):
+    a = {"version": 2, "phases": {"grow": {"seconds": 1.0, "count": 4},
+                                  "boost": {"seconds": 0.5, "count": 4}},
+         "counters": {"transfer/fetch_bytes": 1000},
+         "memory": {"peak_bytes_in_use": 1 << 20, "bytes_in_use": 1000,
+                    "largest_alloc": 512},
+         "cost": {"flops_total": 100.0, "bytes_total": 10.0,
+                  "labels": {"grow/fused_step":
+                             {"calls": 4, "flops_total": 100.0}}}}
+    b = {"version": 2, "phases": {"grow": {"seconds": 0.8, "count": 4}},
+         "counters": {"transfer/fetch_bytes": 800},
+         "cost": {"flops_total": 100.0, "bytes_total": 10.0,
+                  "labels": {"grow/fused_step":
+                             {"calls": 4, "flops_total": 100.0}}}}
+    text = trace_report.diff(a, b)
+    assert "grow: 1.000s -> 0.800s" in text
+    assert "-20.0%" in text
+    assert "boost: 0.500s -> n/a" in text
+    assert "transfer/fetch_bytes: 1000 -> 800" in text
+    assert "peak_bytes_in_use: 1.0MB -> n/a" in text
+
+    # the CLI path: --diff a.json b.json
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    pa.write_text(json.dumps(a))
+    pb.write_text(json.dumps(b))
+    assert trace_report.main(["--diff", str(pa), str(pb)]) == 0
+    assert "metrics diff" in capsys.readouterr().out
+    # diffing against a v1 blob (no memory/cost) stays n/a-tolerant
+    assert "memory (bytes): n/a" in trace_report.diff(
+        {"version": 1}, {"version": 1})
 
 
 def test_profile_session_is_exception_safe(monkeypatch, tmp_path):

@@ -2,16 +2,21 @@
 
 Input: a metrics dict as produced by ``TELEMETRY.metrics_blob()`` /
 ``Booster.get_stats()`` — the blob the CLI writes for ``metrics_out=``,
-``bench.py`` embeds under ``"metrics"``, and ``engine.train`` attaches
-as ``booster.train_stats``.
+``bench.py`` / ``bench_suite.py`` embed under ``"metrics"``, and
+``engine.train`` attaches as ``booster.train_stats``.  Both the current
+``lightgbm_tpu.metrics/v2`` schema and older v1 blobs are accepted:
+every section is optional and renders as ``n/a`` when absent.
 
 Usage:
   python tools/trace_report.py metrics.json          # a raw blob
   python tools/trace_report.py BENCH_r05.json        # a bench record
                                                      # (reads .metrics)
+  python tools/trace_report.py --diff a.json b.json  # phase/counter/
+                                                     # memory/cost deltas
 
 Prints top phases, transfer bytes, compile counters/seconds, network
-collective counters and the iteration count — the digest VERDICT /
+collective counters, the iteration count, and (v2) the HBM memory
+envelope and XLA cost-analysis utilization digest — the digest VERDICT /
 PERF_NOTES rounds quote instead of regex-parsing stderr tails.
 """
 
@@ -28,22 +33,33 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f}GB"
 
 
+def _fmt_rate(n: float, unit: str) -> str:
+    n = float(n)
+    for prefix in ("", "K", "M", "G", "T"):
+        if abs(n) < 1000.0 or prefix == "T":
+            return f"{n:.2f}{prefix}{unit}"
+        n /= 1000.0
+    return f"{n:.2f}T{unit}"
+
+
 def summarize(stats: dict, top: int = 6) -> str:
     """Multi-line human-readable digest of one metrics blob."""
     lines = []
     mode = stats.get("mode", "?")
-    lines.append(f"telemetry summary [level={stats.get('level')} "
-                 f"mode={mode}]")
+    lines.append(f"telemetry summary [version={stats.get('version', 'n/a')} "
+                 f"level={stats.get('level', 'n/a')} mode={mode}]")
 
     phases = stats.get("phases") or {}
     if phases:
         total = sum(p.get("seconds", 0.0) for p in phases.values())
         ranked = sorted(phases.items(),
                         key=lambda kv: -kv[1].get("seconds", 0.0))[:top]
-        parts = [f"{name}={p['seconds']:.3f}s/{p.get('count', 0)}"
+        parts = [f"{name}={p.get('seconds', 0.0):.3f}s/{p.get('count', 0)}"
                  for name, p in ranked]
         lines.append(f"  phases ({mode}) total={total:.3f}s: "
                      + " ".join(parts))
+    else:
+        lines.append("  phases: n/a")
 
     counters = stats.get("counters") or {}
     fetch_b = counters.get("transfer/fetch_bytes", 0)
@@ -72,8 +88,9 @@ def summarize(stats: dict, top: int = 6) -> str:
 
     network = stats.get("network") or {}
     if network:
-        parts = [f"{k}={v['calls']}x/{_fmt_bytes(v['bytes'])}/"
-                 f"{v['seconds']:.3f}s"
+        parts = [f"{k}={v.get('calls', 0)}x/"
+                 f"{_fmt_bytes(v.get('bytes', 0))}/"
+                 f"{v.get('seconds', 0.0):.3f}s"
                  for k, v in sorted(network.items())]
         lines.append("  network: " + " ".join(parts))
 
@@ -85,8 +102,8 @@ def summarize(stats: dict, top: int = 6) -> str:
     timeline = stats.get("timeline") or []
     if timeline:
         iters = sum(e.get("count", 1) for e in timeline)
-        span = timeline[-1]["t"] - (timeline[0]["t"]
-                                    if len(timeline) > 1 else 0.0)
+        span = (timeline[-1].get("t", 0.0)
+                - (timeline[0].get("t", 0.0) if len(timeline) > 1 else 0.0))
         lines.append(f"  timeline: {iters} iterations in "
                      f"{len(timeline)} marks over {span:.3f}s")
 
@@ -95,19 +112,164 @@ def summarize(stats: dict, top: int = 6) -> str:
         lines.append(f"  spans: {spans['recorded']} recorded, "
                      f"{spans.get('dropped', 0)} dropped "
                      f"(capacity {spans.get('capacity')})")
+
+    lines.extend(_memory_lines(stats))
+    lines.extend(_cost_lines(stats))
+    lines.extend(_utilization_lines(stats))
     return "\n".join(lines)
 
 
-def main(argv) -> int:
-    if len(argv) != 1:
-        print(__doc__)
-        return 2
-    with open(argv[0]) as fh:
+def _memory_lines(stats: dict, top: int = 4) -> list:
+    mem = stats.get("memory")
+    if not mem:
+        return ["  memory: n/a (backend reports no memory stats, "
+                "or v1 blob)"]
+    peak = mem.get("peak_bytes_in_use", 0)
+    line = (f"  memory: peak {_fmt_bytes(peak)}, now "
+            f"{_fmt_bytes(mem.get('bytes_in_use', 0))}, largest alloc "
+            f"{_fmt_bytes(mem.get('largest_alloc', 0))}")
+    limit = mem.get("bytes_limit")
+    if limit:
+        line += (f", limit {_fmt_bytes(limit)} "
+                 f"({100.0 * peak / limit:.1f}% peak)")
+    out = [line]
+    phases = mem.get("phases") or {}
+    if phases:
+        ranked = sorted(phases.items(),
+                        key=lambda kv: -kv[1].get("bytes_in_use_max",
+                                                  0))[:top]
+        parts = [f"{name}<={_fmt_bytes(p.get('bytes_in_use_max', 0))}"
+                 f"/{p.get('samples', 0)}" for name, p in ranked]
+        out.append("  memory by phase (max in-use/samples): "
+                   + " ".join(parts))
+    sampler = mem.get("sampler")
+    if sampler:
+        out.append(f"  memory sampler: {sampler.get('samples', 0)} samples "
+                   f"@ {sampler.get('interval_ms', 0):g}ms")
+    return out
+
+
+def _cost_lines(stats: dict, top: int = 6) -> list:
+    cost = stats.get("cost")
+    if not cost:
+        return ["  cost: n/a (no compiled-seam cost analysis in blob)"]
+    labels = cost.get("labels") or {}
+    ranked = sorted(labels.items(),
+                    key=lambda kv: -kv[1].get("flops_total", 0.0))[:top]
+    out = [f"  cost ({len(labels)} seams, "
+           f"{cost.get('window_seconds', 0.0):.3f}s window): "
+           f"{_fmt_rate(cost.get('flops_total', 0.0), 'FLOP')} total, "
+           f"{_fmt_bytes(cost.get('bytes_total', 0.0))} accessed"]
+    for name, e in ranked:
+        out.append(
+            f"    {name}: {e.get('calls', 0)} calls x "
+            f"{_fmt_rate(e.get('flops', 0.0), 'FLOP')}/"
+            f"{_fmt_bytes(e.get('bytes_accessed', 0.0))} "
+            f"= {_fmt_rate(e.get('flops_total', 0.0), 'FLOP')} "
+            f"({e.get('compiles', 0)} compiles)")
+    return out
+
+
+def _utilization_lines(stats: dict) -> list:
+    cost = stats.get("cost") or {}
+    fps = cost.get("est_flops_per_s")
+    bps = cost.get("est_bytes_per_s")
+    if fps is None and bps is None:
+        return []
+    parts = []
+    if fps is not None:
+        parts.append(f"est {_fmt_rate(fps, 'FLOP/s')}")
+    if bps is not None:
+        parts.append(f"est {_fmt_rate(bps, 'B/s')} accessed")
+    mem = stats.get("memory") or {}
+    limit = mem.get("bytes_limit")
+    if limit:
+        parts.append(f"peak HBM {100.0 * mem.get('peak_bytes_in_use', 0) / limit:.1f}% of {_fmt_bytes(limit)}")
+    return ["  utilization: " + ", ".join(parts)
+            + "  (static XLA estimates over the wall window; an upper "
+            "bound on achieved rates)"]
+
+
+# ------------------------------------------------------------------ diff
+def _phase_map(stats: dict) -> dict:
+    return {k: v.get("seconds", 0.0)
+            for k, v in (stats.get("phases") or {}).items()}
+
+
+def _mem_scalars(stats: dict) -> dict:
+    mem = stats.get("memory") or {}
+    return {k: mem[k] for k in ("peak_bytes_in_use", "bytes_in_use",
+                                "largest_alloc") if k in mem}
+
+
+def _cost_scalars(stats: dict) -> dict:
+    cost = stats.get("cost") or {}
+    out = {k: cost[k] for k in ("flops_total", "bytes_total",
+                                "est_flops_per_s") if k in cost}
+    for name, e in (cost.get("labels") or {}).items():
+        out[f"{name}.calls"] = e.get("calls", 0)
+        out[f"{name}.flops_total"] = e.get("flops_total", 0.0)
+    return out
+
+
+def _diff_section(title: str, a: dict, b: dict, fmt) -> list:
+    keys = sorted(set(a) | set(b))
+    if not keys:
+        return [f"  {title}: n/a"]
+    out = [f"  {title}:"]
+    for k in keys:
+        va, vb = a.get(k), b.get(k)
+        if va is None:
+            out.append(f"    {k}: n/a -> {fmt(vb)}")
+        elif vb is None:
+            out.append(f"    {k}: {fmt(va)} -> n/a")
+        else:
+            delta = vb - va
+            if not delta and va == vb:
+                continue
+            pct = f" ({100.0 * delta / va:+.1f}%)" if va else ""
+            out.append(f"    {k}: {fmt(va)} -> {fmt(vb)} "
+                       f"[{'+' if delta >= 0 else ''}{fmt(delta)}{pct}]")
+    if len(out) == 1:
+        out.append("    (no change)")
+    return out
+
+
+def diff(a: dict, b: dict) -> str:
+    """Human-readable deltas between two metrics blobs (a -> b)."""
+    lines = [f"metrics diff [v{a.get('version', '?')} -> "
+             f"v{b.get('version', '?')}]"]
+    sec = lambda v: f"{v:.3f}s"
+    num = lambda v: f"{v:g}"
+    lines.extend(_diff_section("phases (seconds)", _phase_map(a),
+                               _phase_map(b), sec))
+    ca = {k: float(v) for k, v in (a.get("counters") or {}).items()}
+    cb = {k: float(v) for k, v in (b.get("counters") or {}).items()}
+    lines.extend(_diff_section("counters", ca, cb, num))
+    lines.extend(_diff_section("memory (bytes)", _mem_scalars(a),
+                               _mem_scalars(b), _fmt_bytes))
+    lines.extend(_diff_section("cost", _cost_scalars(a),
+                               _cost_scalars(b), num))
+    return "\n".join(lines)
+
+
+def _load(path: str) -> dict:
+    with open(path) as fh:
         blob = json.load(fh)
     # accept a bench record wrapping the blob under "metrics"
     if "phases" not in blob and isinstance(blob.get("metrics"), dict):
         blob = blob["metrics"]
-    print(summarize(blob))
+    return blob
+
+
+def main(argv) -> int:
+    if len(argv) == 3 and argv[0] == "--diff":
+        print(diff(_load(argv[1]), _load(argv[2])))
+        return 0
+    if len(argv) != 1 or argv[0].startswith("--"):
+        print(__doc__)
+        return 2
+    print(summarize(_load(argv[0])))
     return 0
 
 
