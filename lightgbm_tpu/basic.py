@@ -510,9 +510,12 @@ class Booster:
     # ---------------------------------------------------------------- model
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        from .utils.file_io import open_file
-        with open_file(filename, "w") as fh:
-            fh.write(self.model_to_string(num_iteration, start_iteration))
+        # atomic tmp + os.replace for local paths: a crash mid-write
+        # leaves the previous model (or nothing), never a torn file
+        from .utils.file_io import atomic_write_text
+        atomic_write_text(filename,
+                          self.model_to_string(num_iteration,
+                                               start_iteration))
         return self
 
     def model_to_string(self, num_iteration: Optional[int] = None,

@@ -59,6 +59,11 @@ class Application:
     def __init__(self, argv: List[str]):
         self.params = load_parameters(argv)
         self.config = Config.from_params(self.params)
+        # arm fault injection for the whole run (env wins over config);
+        # re-armed with counters reset when a boosting object binds the
+        # same config, so per-iteration specs replay deterministically
+        from .utils.faults import FAULTS
+        FAULTS.configure(getattr(self.config, "fault_injection", ""))
 
     def run(self) -> None:
         task = str(self.config.task).strip().lower()
@@ -98,7 +103,14 @@ class Application:
         if objective is not None:
             objective.init(train.metadata, train.num_data)
         booster = create_boosting(cfg, train, objective)
-        if cfg.input_model:
+        resume_snap = None
+        if cfg.resume:
+            from .utils.snapshots import find_latest_snapshot
+            resume_snap, _ = find_latest_snapshot(cfg.output_model)
+            if resume_snap is None:
+                log_warning("resume=true but no resumable snapshot next to "
+                            f"{cfg.output_model}; starting from scratch")
+        if cfg.input_model and resume_snap is None:
             from .basic import Booster as PyBooster
             from .models.serialization import load_trees_into
             init = PyBooster(model_file=cfg.input_model)
@@ -110,6 +122,9 @@ class Application:
             d = default_metric_for_objective(cfg.objective)
             metric_names = [d] if d else []
         booster.setup_metrics(metric_names)
+        done = 0
+        if resume_snap is not None:
+            done = self._resume(booster, resume_snap)
 
         log_info(f"Started training for {cfg.num_iterations} iterations")
         start = time.perf_counter()
@@ -119,52 +134,126 @@ class Application:
         chunk = booster.boost_chunk_size()
         freqs = [f for f in ((cfg.metric_freq if metric_names else 0),
                              cfg.snapshot_freq) if f > 0]
+        from .utils.faults import FAULTS
         from .utils.phase import profile_session
         from .utils.telemetry import TELEMETRY
-        done = 0
-        # profiler window is exception-safe: a mid-training error must
-        # not leak an open jax profiler trace session
-        with profile_session(), TELEMETRY.memory_session():
-            while done < cfg.num_iterations:
-                step = min(chunk, cfg.num_iterations - done)
-                for f in freqs:
-                    step = min(step, f - done % f)
-                stop = (booster.train_chunk(step) if step > 1
-                        else booster.train_one_iter())
-                it = done + step - 1
-                done += step
-                if (cfg.metric_freq > 0 and (it + 1) % cfg.metric_freq == 0
-                        and metric_names):
-                    if cfg.is_provide_training_metric:
-                        for mname, val, _ in booster.eval_train():
-                            log_info(f"Iteration:{it + 1}, training "
-                                     f"{mname} : {val:g}")
-                    for vi, vname in enumerate(names):
-                        for mname, val, _ in booster.eval_valid(vi):
-                            log_info(f"Iteration:{it + 1}, valid_{vi + 1} "
-                                     f"{mname} : {val:g}")
-                if (cfg.snapshot_freq > 0
-                        and (it + 1) % cfg.snapshot_freq == 0):
-                    snap = f"{cfg.output_model}.snapshot_iter_{it + 1}"
-                    self._save_model(booster, snap)
-                    log_info(f"Saved snapshot to {snap}")
-                if stop:
-                    break
-                log_info(f"{time.perf_counter() - start:.6f} seconds "
-                         f"elapsed, finished iteration {it + 1}")
+        failed = False
+        try:
+            # profiler window is exception-safe: a mid-training error must
+            # not leak an open jax profiler trace session
+            with profile_session(), TELEMETRY.memory_session():
+                while done < cfg.num_iterations:
+                    step = min(chunk, cfg.num_iterations - done)
+                    for f in freqs:
+                        step = min(step, f - done % f)
+                    stop = (booster.train_chunk(step) if step > 1
+                            else booster.train_one_iter())
+                    it = done + step - 1
+                    done += step
+                    if (cfg.metric_freq > 0
+                            and (it + 1) % cfg.metric_freq == 0
+                            and metric_names):
+                        if cfg.is_provide_training_metric:
+                            for mname, val, _ in booster.eval_train():
+                                log_info(f"Iteration:{it + 1}, training "
+                                         f"{mname} : {val:g}")
+                        for vi, vname in enumerate(names):
+                            for mname, val, _ in booster.eval_valid(vi):
+                                log_info(f"Iteration:{it + 1}, "
+                                         f"valid_{vi + 1} "
+                                         f"{mname} : {val:g}")
+                    if (cfg.snapshot_freq > 0
+                            and (it + 1) % cfg.snapshot_freq == 0):
+                        self._write_snapshot(booster, it + 1)
+                    FAULTS.maybe_raise("train/kill", n=it)
+                    if stop:
+                        break
+                    log_info(f"{time.perf_counter() - start:.6f} seconds "
+                             f"elapsed, finished iteration {it + 1}")
+        except BaseException:
+            failed = True
+            raise
+        finally:
+            # the run's observability and completed work survive a crash:
+            # salvage the trees that finished, then always flush the
+            # metrics blob and the Chrome trace
+            if failed:
+                self._salvage_partial(booster)
+            if cfg.metrics_out:
+                import json
+                try:
+                    with open(cfg.metrics_out, "w") as fh:
+                        json.dump(TELEMETRY.metrics_blob(), fh, indent=1)
+                    log_info(f"Wrote training metrics to {cfg.metrics_out}")
+                except OSError as e:
+                    log_warning(f"could not write {cfg.metrics_out}: {e}")
+            TELEMETRY.maybe_export_trace()
         self._save_model(booster, cfg.output_model)
-        if cfg.metrics_out:
-            import json
-            with open(cfg.metrics_out, "w") as fh:
-                json.dump(TELEMETRY.metrics_blob(), fh, indent=1)
-            log_info(f"Wrote training metrics to {cfg.metrics_out}")
-        TELEMETRY.maybe_export_trace()
         log_info(f"Finished training, saved model to {cfg.output_model}")
+
+    def _resume(self, booster, snapshot_file: str) -> int:
+        """Load the newest snapshot's trees + exact sidecar state; the
+        run continues from iteration N with the same key stream, scores
+        and bagging masks as if it had never stopped."""
+        from .basic import Booster as PyBooster
+        from .models.serialization import load_trees_into
+        from .utils.snapshots import restore_snapshot_state
+        from .utils.telemetry import TELEMETRY
+        init = PyBooster(model_file=snapshot_file)
+        load_trees_into(booster, init)
+        it = restore_snapshot_state(booster, snapshot_file)
+        TELEMETRY.fault_event("resume", site="snapshot/io", iteration=it,
+                              detail=os.path.basename(snapshot_file))
+        log_info(f"Resumed training from {snapshot_file} (iteration {it})")
+        return it
+
+    def _write_snapshot(self, booster, iteration: int) -> None:
+        """save_period snapshot + exact-state sidecar.  An IO failure
+        here is survivable: logged and counted, training continues —
+        losing one snapshot must not abort a long run."""
+        cfg = self.config
+        from .models.serialization import save_model_to_string
+        from .utils.faults import FAULTS
+        from .utils.snapshots import prune_snapshots, save_snapshot
+        from .utils.telemetry import TELEMETRY
+        snap = f"{cfg.output_model}.snapshot_iter_{iteration}"
+        try:
+            FAULTS.maybe_raise(
+                "snapshot/io",
+                lambda site: OSError(f"injected IO failure at {site}"))
+            save_snapshot(booster, snap,
+                          save_model_to_string(booster, self.config))
+            prune_snapshots(cfg.output_model, int(cfg.snapshot_keep))
+        except OSError as e:
+            log_warning(f"snapshot write at iteration {iteration} failed "
+                        f"({e}); training continues without it")
+            TELEMETRY.fault_event("snapshot_io", site="snapshot/io",
+                                  iteration=iteration, detail=str(e))
+            return
+        log_info(f"Saved snapshot to {snap}")
+
+    def _salvage_partial(self, booster) -> None:
+        """Crash path: keep whatever trees completed before the failure
+        so a run that dies at iteration 900/1000 does not cost the whole
+        model.  Best-effort — the original exception stays primary."""
+        partial = f"{self.config.output_model}.partial"
+        try:
+            self._save_model(booster, partial)
+        except Exception as e:
+            log_warning(f"could not salvage partial model: {e}")
+            return
+        from .utils.telemetry import TELEMETRY
+        done = int(booster.current_iteration())
+        TELEMETRY.fault_event("partial_save", iteration=done,
+                              detail=os.path.basename(partial))
+        log_warning(f"training aborted; salvaged {done}-iteration partial "
+                    f"model to {partial}")
 
     def _save_model(self, booster, filename: str) -> None:
         from .models.serialization import save_model_to_string
-        with open(filename, "w") as fh:
-            fh.write(save_model_to_string(booster, self.config))
+        from .utils.file_io import atomic_write_text
+        atomic_write_text(filename,
+                          save_model_to_string(booster, self.config))
 
     # ------------------------------------------------------------ prediction
     def predict(self) -> None:

@@ -197,7 +197,29 @@ _PARAMS: Dict[str, _P] = {
     # CLI (task=train): write the versioned metrics JSON blob here after
     # training ("" = don't)
     "metrics_out": _P(""),
+    # -- robustness (utils/faults.py, docs/ROBUSTNESS.md) --
+    # blocking finiteness check on the boosted scores at chunk
+    # boundaries (and per-iteration when chunking is off): a NaN/Inf
+    # rolls the ensemble back to the last good iteration and raises
+    # instead of silently shipping a poisoned model
+    "check_nonfinite": _P(True),
+    # CLI (task=train): discover the newest <output_model>.snapshot_iter_N
+    # (with its .state sidecar) and continue bit-exactly from iteration N
+    "resume": _P(False),
+    # keep only the newest K snapshots, deleting older ones after each
+    # successful snapshot write; 0 = keep all (reference save_period
+    # keeps all)
+    "snapshot_keep": _P(0),
+    # deterministic fault injection spec (same grammar as the
+    # LIGHTGBM_TPU_FAULTS env var, which wins per-site); "" = off
+    "fault_injection": _P(""),
 }
+
+# runtime-only knobs excluded from a saved model's ``parameters:``
+# section: they describe how THIS process ran, not what was learned, and
+# including them would make a resumed run's model differ byte-wise from
+# an uninterrupted one
+RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection"])
 
 # alias -> canonical name
 ALIAS_TABLE: Dict[str, str] = {}

@@ -125,43 +125,59 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # memory_session brackets the run with HBM gauge samples and owns the
     # optional background sampler's lifetime (stopped even when a callback
     # or device error raises out of the loop)
-    with profile_session(), TELEMETRY.memory_session():
-        i = 0
-        while i < num_boost_round:
-            step = min(chunk, num_boost_round - i)
-            for cb in callbacks_before:
-                cb(callback_mod.CallbackEnv(
-                    model=booster, params=params, iteration=i,
-                    begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=None))
-            if step > 1:
-                should_stop = booster.update_chunk(step)
-            else:
-                should_stop = booster.update(fobj=fobj)
-            it = i + step - 1
-
-            evaluation_result_list = []
-            if booster._valid_names or train_in_valid:
-                if train_in_valid:
-                    evaluation_result_list.extend(booster.eval_train(feval))
-                evaluation_result_list.extend(booster.eval_valid(feval))
-            try:
-                for cb in callbacks_after:
+    failed = False
+    try:
+        with profile_session(), TELEMETRY.memory_session():
+            i = 0
+            while i < num_boost_round:
+                step = min(chunk, num_boost_round - i)
+                for cb in callbacks_before:
                     cb(callback_mod.CallbackEnv(
-                        model=booster, params=params, iteration=it,
+                        model=booster, params=params, iteration=i,
                         begin_iteration=0, end_iteration=num_boost_round,
-                        evaluation_result_list=evaluation_result_list))
-            except callback_mod.EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
-                for item in e.best_score:
-                    booster.best_score.setdefault(
-                        item[0], {})[item[1]] = item[2]
-                break
-            if should_stop:
-                break
-            i += step
+                        evaluation_result_list=None))
+                if step > 1:
+                    should_stop = booster.update_chunk(step)
+                else:
+                    should_stop = booster.update(fobj=fobj)
+                it = i + step - 1
+
+                evaluation_result_list = []
+                if booster._valid_names or train_in_valid:
+                    if train_in_valid:
+                        evaluation_result_list.extend(
+                            booster.eval_train(feval))
+                    evaluation_result_list.extend(booster.eval_valid(feval))
+                try:
+                    for cb in callbacks_after:
+                        cb(callback_mod.CallbackEnv(
+                            model=booster, params=params, iteration=it,
+                            begin_iteration=0,
+                            end_iteration=num_boost_round,
+                            evaluation_result_list=evaluation_result_list))
+                except callback_mod.EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    for item in e.best_score:
+                        booster.best_score.setdefault(
+                            item[0], {})[item[1]] = item[2]
+                    break
+                if should_stop:
+                    break
+                i += step
+    except BaseException:
+        failed = True
+        raise
+    finally:
+        if failed:
+            # a raising callback or device error must still leave the run's
+            # telemetry on the returned/half-trained booster and flush the
+            # Chrome trace — the partial run is often the one worth debugging
+            booster.train_stats = TELEMETRY.stats()
+            TELEMETRY.maybe_export_trace()
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.gbdt.current_iteration()
+    # success path: snapshot AFTER the finalizing fetch above so the
+    # attached counters match a later stats() call exactly
     booster.train_stats = TELEMETRY.stats()
     TELEMETRY.maybe_export_trace()
     return booster

@@ -25,8 +25,10 @@ import numpy as np
 from ..config import Config
 from ..core.dataset import TpuDataset
 from ..ops.split import FeatureMeta, SplitParams
+from ..utils.faults import FAULTS, InjectedFault, oom_error
 from ..utils.jitcost import cost_jit
-from ..utils.log import check, log_fatal, log_info, log_warning
+from ..utils.log import (LightGBMError, check, log_fatal, log_info,
+                         log_warning)
 from ..utils.phase import GLOBAL_TIMER as _PHASES
 from ..utils.telemetry import TELEMETRY
 from .grower import (GrowerParams, _pack_tree_device, fetch_tree_arrays,
@@ -183,6 +185,23 @@ def _apply_tree_score_core(score, leaf_values, leaf_id, shrinkage):
 _add_tree_score = cost_jit("score/add", jax.jit(_add_tree_score_core))
 _apply_tree_score = cost_jit("score/apply", jax.jit(_apply_tree_score_core))
 
+# one-scalar finiteness reduce over the boosted scores (check_nonfinite
+# guardrail): the device does the whole reduction, the host fetches one
+# bool — run OUTSIDE any transfer guard wrapping the chunk dispatch
+_all_finite = jax.jit(lambda x: jnp.isfinite(x).all())
+
+
+def _is_oom_error(e: BaseException) -> bool:
+    """RESOURCE_EXHAUSTED-shaped device failures (real XlaRuntimeError
+    allocation failures and injected chunk/oom faults) that the chunked
+    loop may retry at a smaller chunk size."""
+    msg = str(e)
+    if ("RESOURCE_EXHAUSTED" not in msg
+            and "out of memory" not in msg.lower()):
+        return False
+    return (isinstance(e, InjectedFault)
+            or type(e).__name__ in ("XlaRuntimeError", "InternalError"))
+
 
 class GBDT:
     """Gradient Boosted Decision Trees (boosting='gbdt')."""
@@ -197,6 +216,10 @@ class GBDT:
         TELEMETRY.set_config_level(getattr(config, "telemetry_level", 1))
         if TELEMETRY.level >= 1:
             TELEMETRY.install_jax_listeners()
+        # arm fault injection for this run (env spec wins per-site) with
+        # fresh occurrence counters — same lifecycle as the telemetry
+        # level binding above
+        FAULTS.configure(getattr(config, "fault_injection", ""))
         self.train_set: Optional[TpuDataset] = None
         self._models: List[Tree] = []           # flat: iter-major, class-minor
         # finished trees whose device->host transfer is still in flight:
@@ -502,6 +525,10 @@ class GBDT:
         self._obj_arrs = None
         self._chunk_fns: Dict[int, object] = {}
         self._shr_dev: Dict[float, jax.Array] = {}
+        # OOM-degraded chunk-size ceiling (None = no ceiling): once a
+        # chunk dispatch hits RESOURCE_EXHAUSTED the cap halves and
+        # STICKS, so later chunks of the run skip the doomed sizes
+        self._chunk_cap: Optional[int] = None
 
     def _replay_model_scores(self, dataset: TpuDataset) -> np.ndarray:
         """[C, N] f64 raw scores of the current model on ``dataset``: the
@@ -938,13 +965,76 @@ class GBDT:
                     self.train_score = self.train_score.at[k].add(
                         -jnp.asarray(delta, dtype=jnp.float32))
 
+    # ----------------------------------------------------- fault guardrails
+    def _poison_scores(self) -> None:
+        """grad/nonfinite injection: NaN the score buffer, so the next
+        gradient pass (and everything downstream) goes non-finite the
+        same way a diverged objective would."""
+        self.train_score = self.train_score * jnp.float32(np.nan)
+
+    def _raise_nonfinite(self, first_iter: int, count: int) -> None:
+        obj = getattr(self.config, "objective", "?")
+        span = (f"iteration {first_iter}" if count <= 1 else
+                f"iterations {first_iter}..{first_iter + count - 1}")
+        raise LightGBMError(
+            f"Non-finite values in the boosted scores at {span} "
+            f"(objective={obj}); the ensemble was rolled back to the "
+            f"{first_iter} completed iteration(s) before it — check the "
+            f"learning_rate/objective "
+            f"for divergence, or set check_nonfinite=false to ship the "
+            f"model anyway")
+
+    def _guard_nonfinite(self, it: int) -> None:
+        """Per-iteration finiteness guardrail: on NaN/Inf scores, drop
+        the just-trained iteration and raise (check_nonfinite)."""
+        if not getattr(self.config, "check_nonfinite", True):
+            return
+        if bool(_all_finite(self.train_score)):
+            return
+        # settle the async pipeline first: a NaN iteration may grow an
+        # all-constant tree, which the flush already discards (lowering
+        # iter_ back to ``it``); only a materialized bad iteration needs
+        # the explicit rollback
+        self._flush_pending()
+        if self.iter_ > it:
+            self.rollback_one_iter()
+        TELEMETRY.fault_event("nonfinite_rollback", site="grad/nonfinite",
+                              iteration=it,
+                              detail="iteration dropped")
+        self._raise_nonfinite(it, 1)
+
+    def _guard_chunk_nonfinite(self, first_iter: int, t: int) -> None:
+        """Chunk-boundary guardrail, called BEFORE the chunk's pending
+        trees are enqueued: a non-finite score buffer discards the whole
+        failing chunk (its buffers never become trees), settles the
+        still-good in-flight chunk, and raises."""
+        if not getattr(self.config, "check_nonfinite", True):
+            return
+        if bool(_all_finite(self.train_score)):
+            return
+        self._flush_pending()        # older chunks are still good
+        TELEMETRY.fault_event("nonfinite_rollback", site="grad/nonfinite",
+                              iteration=first_iter,
+                              detail=f"chunk of {t} iterations dropped")
+        self._raise_nonfinite(first_iter, t)
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True if training should stop
         (no further splits possible), matching LGBM_BoosterUpdateOneIter
-        semantics."""
+        semantics.  Wraps the implementation with the check_nonfinite
+        guardrail (and its grad/nonfinite injection site)."""
         if self._stop_flag:
             return True
+        if FAULTS.check("grad/nonfinite", n=self.iter_):
+            self._poison_scores()
+        it = self.iter_
+        stop = self._train_one_iter_impl(grad, hess)
+        self._guard_nonfinite(it)
+        return stop
+
+    def _train_one_iter_impl(self, grad: Optional[np.ndarray] = None,
+                             hess: Optional[np.ndarray] = None) -> bool:
         self._boost_from_average()
         C = self.num_tree_per_iteration
         if self.train_set.num_used_features == 0:
@@ -1186,7 +1276,15 @@ class GBDT:
         fetch to the chunk boundary, where it overlaps the next chunk's
         device work.  Falls back to train_one_iter when the configuration
         needs host interaction mid-chunk.  Returns True when training
-        stopped."""
+        stopped.
+
+        Always trains exactly ``chunk`` iterations (the engine/CLI step
+        accounting assumes it) unless training stops: a chunk dispatch
+        that dies with RESOURCE_EXHAUSTED is retried at half the size,
+        down to per-iteration dispatch, and the degraded ceiling sticks
+        for the rest of the run (_chunk_cap).  Sub-chunk splitting is
+        bit-exact — the chunk body consumes the same PRNG key stream at
+        any chunk size."""
         T = int(chunk)
         if self._stop_flag:
             return True
@@ -1194,13 +1292,56 @@ class GBDT:
                 or self.train_set.num_used_features == 0):
             return self.train_one_iter()
         self._boost_from_average()
-        fn = self._get_chunk_fn(T)
+        done = 0
+        while done < T:
+            if self._stop_flag:
+                return True
+            cap = self._chunk_cap
+            t = T - done if cap is None else min(T - done, cap)
+            if t <= 1:
+                try:
+                    # per-iteration fallback still probes the OOM site:
+                    # a persistent allocator failure must reach the
+                    # actionable give-up error, not silently complete
+                    if FAULTS.enabled:
+                        FAULTS.maybe_raise("chunk/oom", oom_error)
+                    stop = self.train_one_iter()
+                except Exception as e:
+                    if not _is_oom_error(e):
+                        raise
+                    raise self._oom_exhausted(e)   # out of headroom
+                if stop:
+                    return True
+                done += 1
+                continue
+            try:
+                self._dispatch_chunk(t)
+            except Exception as e:
+                if not _is_oom_error(e):
+                    raise
+                self._degrade_chunk(t, e)
+                continue                           # retry at the new cap
+            done += t
+        return bool(self._stop_flag)
+
+    def _dispatch_chunk(self, t: int) -> None:
+        """Dispatch one fused chunk of ``t`` iterations and enqueue its
+        tree buffers.  Hosts the grad/nonfinite and chunk/oom injection
+        sites and the chunk-boundary finiteness guardrail."""
+        if FAULTS.enabled:
+            for i in range(self.iter_, self.iter_ + t):
+                if FAULTS.check("grad/nonfinite", n=i):
+                    self._poison_scores()
+                    break
+            FAULTS.maybe_raise("chunk/oom", oom_error)
+        fn = self._get_chunk_fn(t)
         shr = self._shr_dev.get(self.shrinkage_rate)
         if shr is None:
             # device-resident constant: materialized OUTSIDE the guarded
             # dispatch so the chunk body itself stays transfer-free
             shr = jnp.float32(self.shrinkage_rate)
             self._shr_dev[self.shrinkage_rate] = shr
+        first_iter = self.iter_
         args = (self.train_score, self._key, self.bag_weight, self.bins,
                 self.fmeta, self._full_fmask, shr, self._obj_arrs)
         with _PHASES.phase("chunk") as box:
@@ -1211,19 +1352,56 @@ class GBDT:
                 out = fn(*args)
             self.train_score, self._key, ints_all, floats_all = out
             box[0] = self.train_score
+        # before the chunk's buffers can become trees: a non-finite score
+        # discards them and raises (older pending chunks stay good)
+        self._guard_chunk_nonfinite(first_iter, t)
         self._start_host_copy(ints_all, floats_all)
         self._pending.append((self.iter_, _PendingChunk(
-            ints_all, floats_all, self.shrinkage_rate, T)))
-        self.iter_ += T
+            ints_all, floats_all, self.shrinkage_rate, t)))
+        self.iter_ += t
         with _PHASES.phase("fetch"):
             # valid-set scores update at materialization, and eval at the
             # chunk boundary needs the chunk just dispatched — so forgo
             # the one-chunk-deep pipeline when valid sets are attached
             keep = 0 if self.valid_sets else 1
             self._flush_pending(keep_latest=keep)
-        TELEMETRY.gauge_set("boost/chunk_size", T)
-        TELEMETRY.mark_iteration(self.iter_ - 1, count=T)
-        return bool(self._stop_flag)
+        TELEMETRY.gauge_set("boost/chunk_size", t)
+        TELEMETRY.mark_iteration(self.iter_ - 1, count=t)
+
+    def _degrade_chunk(self, t: int, err: BaseException) -> None:
+        """Halve the chunk-size ceiling after an OOM-shaped dispatch
+        failure, or give up (with the HBM picture) when retry is
+        impossible because the dispatch consumed its donated carries."""
+        for buf in (self.train_score, self._key):
+            deleted = getattr(buf, "is_deleted", None)
+            if deleted is not None and deleted():
+                # donate_argnums=(0, 1) handed the score/key buffers to
+                # the failed execution; there is no state left to retry
+                raise self._oom_exhausted(err)
+        self._chunk_cap = max(1, t // 2)
+        log_warning(f"chunk dispatch of {t} iterations failed with "
+                    f"RESOURCE_EXHAUSTED; retrying at chunk size "
+                    f"{self._chunk_cap} (ceiling sticks for this run)")
+        TELEMETRY.fault_event("oom_degrade", site="chunk/oom",
+                              iteration=self.iter_,
+                              detail=f"chunk {t} -> {self._chunk_cap}")
+
+    def _oom_exhausted(self, err: BaseException) -> LightGBMError:
+        """The actionable give-up error once even per-iteration dispatch
+        OOMs: names the iteration and the peak-HBM figure from the
+        telemetry memory section (PR 3) when the backend reports one."""
+        mem = TELEMETRY.stats().get("memory") or {}
+        peak, limit = mem.get("peak_bytes_in_use"), mem.get("bytes_limit")
+        if peak:
+            hbm = f"; peak HBM {peak / 1e9:.2f} GB"
+            if limit:
+                hbm += f" of {limit / 1e9:.2f} GB limit"
+        else:
+            hbm = "; peak HBM unavailable (backend reports no memory stats)"
+        return LightGBMError(
+            f"device out of memory at iteration {self.iter_} even at "
+            f"chunk size 1{hbm} — reduce num_leaves/max_bin or shard the "
+            f"data across more devices ({err})")
 
     def refit(self, leaf_preds: np.ndarray) -> None:
         """Refit leaf outputs on the current training data given per-row

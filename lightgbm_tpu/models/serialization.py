@@ -157,7 +157,13 @@ def save_model_to_string(gbdt, config: Config, num_iteration: int = -1,
     for v, name in pairs:
         body += f"{name}={v}\n"
     body += "\nparameters:\n"
+    from ..config import RUNTIME_ONLY_PARAMS, resolve_alias
     for k, v in (config.raw or {}).items():
+        # runtime-only knobs (resume, fault_injection) describe this
+        # process, not the model: a resume=true rerun must save a file
+        # byte-identical to the uninterrupted run's
+        if resolve_alias(k) in RUNTIME_ONLY_PARAMS:
+            continue
         body += f"[{k}: {v}]\n"
     body += "end of parameters\n"
     return body

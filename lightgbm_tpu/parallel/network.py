@@ -214,8 +214,32 @@ def allgather_obj(obj):
 
     Uses the injected allgather when tests fake a multi-machine run
     (init_with_functions), else jax.experimental.multihost_utils over DCN
-    for real multi-process meshes, else identity."""
+    for real multi-process meshes, else identity.
+
+    One transient failure is retried (recorded as a ``collective_retry``
+    fault event): host-level allgather runs over DCN during data loading,
+    where a single hiccup should not kill a long job.  A second failure
+    propagates — a dead link is not transient.  The retry path is
+    exercised deterministically via the ``collective/allgather`` fault
+    site."""
+    try:
+        return _allgather_obj_once(obj)
+    except LightGBMError:
+        raise                        # config/topology errors: not transient
+    except Exception as e:
+        from ..utils.telemetry import TELEMETRY
+        log_warning(f"allgather_obj failed ({type(e).__name__}: {e}); "
+                    "retrying once")
+        TELEMETRY.fault_event("collective_retry",
+                              site="collective/allgather", detail=str(e))
+        return _allgather_obj_once(obj)
+
+
+def _allgather_obj_once(obj):
     import pickle
+
+    from ..utils.faults import FAULTS
+    FAULTS.maybe_raise("collective/allgather")   # probed per attempt
     blob = pickle.dumps(obj)
     t0 = time.perf_counter()
     if _injected is not None:
@@ -240,6 +264,10 @@ def allgather_obj(obj):
 
 
 def dispose() -> None:
+    """Tear down the mesh/injection AND the collective counters —
+    back-to-back runs in one process (tests, notebooks) must not leak
+    the previous run's call/byte totals into the next stats() blob."""
     global _mesh, _injected
     _mesh = None
     _injected = None
+    reset_collective_stats()

@@ -1,0 +1,199 @@
+"""Deterministic fault injection for robustness testing.
+
+The reference LightGBM survives long runs with ``save_period``
+snapshots and socket retry; to *test* the equivalent recovery paths
+here (non-finite rollback, OOM-degrading chunk retry, snapshot resume)
+we need failures that fire at a chosen site and iteration,
+deterministically, from the environment — without littering the hot
+path with conditionals.
+
+Spec grammar (env ``LIGHTGBM_TPU_FAULTS`` or config
+``fault_injection``), comma-separated::
+
+    SITE[@START][xCOUNT]
+
+``SITE`` is a registered site name (``chunk/oom``, ``grad/nonfinite``,
+``snapshot/io``, ``train/kill``, ``collective/allgather``).  ``@START``
+is the 0-based occurrence (or explicit index, e.g. iteration) at which
+the fault starts firing; default 0.  ``xCOUNT`` is how many
+occurrences fire; default 1, ``x*`` means every occurrence from START
+on.  Examples::
+
+    chunk/oom                  # first chunk dispatch raises OOM once
+    grad/nonfinite@3           # poison scores at iteration 3
+    snapshot/io@1x2            # 2nd and 3rd snapshot writes fail
+    train/kill@4               # kill the CLI loop after iteration 4
+    chunk/oom@0x*              # every chunk dispatch OOMs (never heals)
+
+Mirroring telemetry level 0, a disabled registry costs one truthiness
+check per site probe (``if not self._sites: return False``).  Sites
+count occurrences per-site: each ``check(site)`` call without an
+explicit ``n=`` advances that site's occurrence counter, so ``@START``
+means "the START-th time this site is reached".  Callers that have a
+natural index (the boosting iteration) pass ``n=`` instead and the
+spec's ``@START`` compares against that index directly.
+
+The registry is process-global (``FAULTS``), configured from the env
+at import and re-configured (env spec + config spec merged, counters
+reset) whenever a training run binds its config — the same lifecycle
+as ``TELEMETRY.set_config_level``.
+"""
+
+import os
+import re
+import threading
+
+ENV_FAULTS = "LIGHTGBM_TPU_FAULTS"
+
+# sites the training stack probes; parse rejects unknown names so a
+# typo in the env fails loudly instead of silently injecting nothing
+KNOWN_SITES = frozenset([
+    "chunk/oom",         # chunk dispatch raises RESOURCE_EXHAUSTED
+    "grad/nonfinite",    # scores poisoned with NaN before the boost step
+    "snapshot/io",       # snapshot write raises OSError
+    "train/kill",        # CLI training loop dies between iterations
+    "collective/allgather",  # first attempt of allgather_obj fails
+])
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an injected fault site (never by real failures)."""
+
+    def __init__(self, site: str, message: str = ""):
+        self.site = site
+        super().__init__(message or f"injected fault at {site}")
+
+
+def oom_error(site: str) -> InjectedFault:
+    """An injected error shaped like an XLA allocation failure.
+
+    The message carries the ``RESOURCE_EXHAUSTED`` marker the chunk
+    retry path matches on, so injected and real OOMs take the same
+    recovery branch.
+    """
+    return InjectedFault(
+        site, f"RESOURCE_EXHAUSTED: injected device OOM at {site} "
+              "(fault injection)")
+
+
+_SPEC_RE = re.compile(r"^(?P<name>[^@]+?)(?:@(?P<start>\d+))?"
+                      r"(?:x(?P<count>\d+|\*))?$")
+
+
+class _Site:
+    __slots__ = ("name", "start", "count", "seen", "fired")
+
+    def __init__(self, name, start, count):
+        self.name = name
+        self.start = start          # first occurrence index that fires
+        self.count = count          # None = unlimited
+        self.seen = 0               # occurrences observed so far
+        self.fired = 0              # occurrences that fired
+
+    def hit(self, n):
+        """Advance and decide whether occurrence ``n`` fires."""
+        if n is None:
+            n = self.seen
+            self.seen += 1
+        if n < self.start:
+            return False
+        if self.count is not None and self.fired >= self.count:
+            return False
+        self.fired += 1
+        return True
+
+
+def parse_spec(spec: str) -> dict:
+    """Parse a fault spec string into {site: (start, count|None)}.
+
+    Raises ``ValueError`` on grammar errors or unknown site names.
+    """
+    out = {}
+    for tok in (spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        m = _SPEC_RE.match(tok)
+        if not m:
+            raise ValueError(f"bad fault spec token: {tok!r} "
+                             "(expected SITE[@START][xCOUNT])")
+        name = m.group("name")
+        if name not in KNOWN_SITES:
+            raise ValueError(
+                f"unknown fault site {name!r}; known sites: "
+                + ", ".join(sorted(KNOWN_SITES)))
+        start = int(m.group("start") or 0)
+        count = m.group("count")
+        count = None if count == "*" else int(count or 1)
+        out[name] = (start, count)
+    return out
+
+
+class FaultRegistry:
+    """Process-global registry of armed fault sites."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._sites = {}
+        self.configure()
+
+    # -------------------------------------------------- configuration
+    def configure(self, config_spec: str = "") -> None:
+        """(Re)arm from the env + an optional config spec.
+
+        The env spec wins on per-site conflicts (same precedence as
+        ``LIGHTGBM_TPU_TELEMETRY`` over ``telemetry_level``).  All
+        occurrence counters reset, so each training run replays its
+        faults deterministically.
+        """
+        merged = dict(parse_spec(config_spec))
+        merged.update(parse_spec(os.environ.get(ENV_FAULTS, "")))
+        with self._lock:
+            self._sites = {name: _Site(name, start, count)
+                           for name, (start, count) in merged.items()}
+
+    # ------------------------------------------------------- probing
+    @property
+    def enabled(self) -> bool:
+        """True when any site is armed (one truthiness check; lets hot
+        paths skip per-occurrence probing loops entirely)."""
+        return bool(self._sites)
+
+    def check(self, site: str, n=None) -> bool:
+        """True if ``site`` should fire on this occurrence.
+
+        ``n`` pins the occurrence index (e.g. the boosting iteration);
+        without it the site's own counter advances by one per call.
+        A firing is recorded into telemetry as an ``injected`` fault
+        event so recoveries are attributable in the metrics blob.
+        """
+        if not self._sites:
+            return False
+        with self._lock:
+            entry = self._sites.get(site)
+            if entry is None or not entry.hit(n):
+                return False
+        from .telemetry import TELEMETRY
+        TELEMETRY.fault_event("injected", site=site,
+                              detail=(f"n={n}" if n is not None
+                                      else f"occurrence={entry.seen - 1}"))
+        return True
+
+    def maybe_raise(self, site: str, exc_factory=None, n=None) -> None:
+        """Raise the site's fault if armed for this occurrence."""
+        if not self._sites:
+            return
+        if self.check(site, n=n):
+            raise (exc_factory(site) if exc_factory is not None
+                   else InjectedFault(site))
+
+    # ----------------------------------------------------- inspection
+    def armed(self) -> dict:
+        """{site: {"start", "count", "seen", "fired"}} for tests/docs."""
+        with self._lock:
+            return {s.name: {"start": s.start, "count": s.count,
+                             "seen": s.seen, "fired": s.fired}
+                    for s in self._sites.values()}
+
+
+FAULTS = FaultRegistry()

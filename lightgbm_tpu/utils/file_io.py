@@ -84,6 +84,37 @@ def open_file(path: str, mode: str = "r"):
     return opener(path, mode)
 
 
+def atomic_write_text(path: str, text: str) -> None:
+    """Write ``text`` to ``path`` so readers never see a torn file.
+
+    Local paths get the classic durable rename: write a sibling temp
+    file, flush + fsync, then ``os.replace`` onto the destination — a
+    crash mid-write leaves either the old file or nothing, never a
+    truncated model.  Scheme'd paths (``gs://`` ...) fall back to a
+    plain ``open_file`` write; object stores commit on close, so the
+    torn-file window does not exist there in the first place.
+    """
+    import os
+    if uri_scheme(path):
+        with open_file(path, "w") as fh:
+            fh.write(text)
+        return
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "w") as fh:
+            fh.write(text)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
 def exists(path: str) -> bool:
     """Existence probe that understands registered schemes (remote
     handlers are queried by opening; local paths use os.path).

@@ -1,0 +1,201 @@
+"""Resumable training snapshots: model file + exact-state sidecar.
+
+The reference CLI's ``save_period`` snapshots (config.h) are plain
+model files — enough to *continue* training, not to resume it
+bit-exactly: reloading a model text replays f64 per-tree deltas into
+the f32 score buffer (not the bytes the run actually held), and the
+PRNG key / bagging / feature-sampling RNG streams are not
+fast-forwarded.  Each snapshot here therefore pairs the model file
+(``<output_model>.snapshot_iter_N``) with a ``.state.npz`` sidecar
+holding the exact device/host training state at iteration N:
+
+  * the f32 ``train_score`` buffer and the JAX PRNG key, byte-for-byte
+  * the bagging mask and both host RNG (MT19937) states
+  * the per-valid-set score buffers
+
+Resume (cli.py, ``resume=true``) loads the trees through the existing
+``load_trees_into`` path, then overwrites the replayed approximate
+state with the sidecar's exact one — iterations N.. then proceed with
+the same key stream, scores and masks as an uninterrupted run, so the
+final model file is byte-identical.
+
+Every write is atomic (sibling tmp + ``os.replace``), the sidecar is
+written BEFORE the model text, and discovery requires BOTH files: a
+crash at any point mid-snapshot leaves the previous snapshot fully
+discoverable and never a torn file.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .file_io import atomic_write_text
+from .log import LightGBMError, log_warning
+
+STATE_SUFFIX = ".state.npz"
+STATE_VERSION = 1
+
+_SNAP_RE = re.compile(r"\.snapshot_iter_(\d+)$")
+
+
+def state_path(snapshot_file: str) -> str:
+    return snapshot_file + STATE_SUFFIX
+
+
+def _atomic_savez(path: str, **arrays) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **arrays)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _rng_state(rng: np.random.RandomState):
+    name, keys, pos, has_gauss, cached = rng.get_state()
+    if name != "MT19937":          # pragma: no cover - numpy default
+        raise LightGBMError(f"unsupported RNG {name} in snapshot state")
+    return (np.asarray(keys, dtype=np.uint32),
+            np.asarray([pos, has_gauss], dtype=np.int64),
+            np.asarray([cached], dtype=np.float64))
+
+
+def _set_rng_state(rng: np.random.RandomState, keys, meta, cached) -> None:
+    rng.set_state(("MT19937", np.asarray(keys, dtype=np.uint32),
+                   int(meta[0]), int(meta[1]), float(cached[0])))
+
+
+def save_snapshot(gbdt, snapshot_file: str, model_text: str) -> None:
+    """Write one resumable snapshot: exact-state sidecar first, then the
+    model text — both atomically.  ``gbdt.iter_`` must equal the
+    iteration the snapshot file name claims."""
+    bag_keys, bag_meta, bag_cached = _rng_state(gbdt._bag_rng)
+    feat_keys, feat_meta, feat_cached = _rng_state(gbdt._feat_rng)
+    arrays = {
+        "version": np.asarray(STATE_VERSION, dtype=np.int64),
+        "iteration": np.asarray(gbdt.iter_, dtype=np.int64),
+        "train_score": np.asarray(gbdt.train_score),
+        "prng_key": np.asarray(gbdt._key),
+        "bag_weight": np.asarray(gbdt.bag_weight),
+        "init_scores": np.asarray(gbdt.init_scores, dtype=np.float64),
+        "bag_keys": bag_keys, "bag_meta": bag_meta,
+        "bag_cached": bag_cached,
+        "feat_keys": feat_keys, "feat_meta": feat_meta,
+        "feat_cached": feat_cached,
+        "valid_count": np.asarray(len(gbdt.valid_scores), dtype=np.int64),
+    }
+    for i, vs in enumerate(gbdt.valid_scores):
+        arrays[f"valid_score_{i}"] = np.asarray(vs, dtype=np.float64)
+    _atomic_savez(state_path(snapshot_file), **arrays)
+    atomic_write_text(snapshot_file, model_text)
+
+
+def restore_snapshot_state(gbdt, snapshot_file: str) -> int:
+    """Overwrite a tree-loaded GBDT's replayed (approximate) state with
+    the sidecar's exact one; returns the snapshot iteration.  Call AFTER
+    ``load_trees_into`` and after the valid sets are attached."""
+    import jax.numpy as jnp
+    with np.load(state_path(snapshot_file)) as data:
+        it = int(data["iteration"])
+        if gbdt.iter_ != it:
+            raise LightGBMError(
+                f"snapshot state at iteration {it} does not match the "
+                f"loaded model's {gbdt.iter_} iterations "
+                f"({snapshot_file})")
+        gbdt.train_score = jnp.asarray(data["train_score"])
+        gbdt._key = jnp.asarray(data["prng_key"])
+        gbdt.bag_weight = jnp.asarray(data["bag_weight"])
+        _set_rng_state(gbdt._bag_rng, data["bag_keys"], data["bag_meta"],
+                       data["bag_cached"])
+        _set_rng_state(gbdt._feat_rng, data["feat_keys"],
+                       data["feat_meta"], data["feat_cached"])
+        # init_scores stay [0.0]: the loaded first tree already carries
+        # the folded bias (serialization._tree_for_save), and train_score
+        # above includes it once — restoring the original values would
+        # fold it a second time on the next save.  The sidecar keeps them
+        # for inspection only.
+        nv = int(data["valid_count"])
+        restored = (nv == len(gbdt.valid_scores))
+        if restored:
+            for i in range(nv):
+                saved = np.asarray(data[f"valid_score_{i}"])
+                if saved.shape != np.shape(gbdt.valid_scores[i]):
+                    restored = False
+                    break
+                gbdt.valid_scores[i] = saved.copy()
+    if not restored and gbdt.valid_sets:
+        # the valid sets changed since the snapshot: fall back to the
+        # replay path (approximate but complete)
+        log_warning("snapshot valid-set state does not match the current "
+                    "valid sets; recomputing valid scores by replay")
+        gbdt.valid_scores = [
+            np.asarray(gbdt._replay_model_scores(vset), dtype=np.float64)
+            for _, vset in gbdt.valid_sets]
+    # CEGB coupled penalties track which features the model split on;
+    # rebuild that from the loaded trees
+    if gbdt.grower_params.use_cegb_coupled:
+        gbdt._note_trees(gbdt.models)
+    return it
+
+
+def find_latest_snapshot(output_model: str) -> Tuple[Optional[str], int]:
+    """Newest resumable snapshot for ``output_model``: the highest
+    ``.snapshot_iter_N`` that has BOTH the model file and its state
+    sidecar.  Returns (path, N), or (None, 0) when none qualify."""
+    d = os.path.dirname(os.path.abspath(output_model))
+    base = os.path.basename(output_model)
+    best: Tuple[Optional[str], int] = (None, 0)
+    if not os.path.isdir(d):
+        return best
+    for name in os.listdir(d):
+        if not name.startswith(base + ".snapshot_iter_"):
+            continue
+        m = _SNAP_RE.search(name)
+        if m is None:
+            continue
+        path = os.path.join(d, name)
+        if not os.path.exists(state_path(path)):
+            continue                 # torn snapshot: model without state
+        n = int(m.group(1))
+        if n > best[1]:
+            best = (path, n)
+    return best
+
+
+def prune_snapshots(output_model: str, keep: int) -> None:
+    """Retention: delete all but the newest ``keep`` snapshots (model +
+    sidecar).  ``keep <= 0`` keeps everything (the reference
+    save_period behavior)."""
+    if keep <= 0:
+        return
+    d = os.path.dirname(os.path.abspath(output_model))
+    base = os.path.basename(output_model)
+    found = []
+    if not os.path.isdir(d):
+        return
+    for name in os.listdir(d):
+        if not name.startswith(base + ".snapshot_iter_"):
+            continue
+        m = _SNAP_RE.search(name)
+        if m is not None:
+            found.append((int(m.group(1)), os.path.join(d, name)))
+    found.sort(reverse=True)
+    for _, path in found[keep:]:
+        for victim in (path, state_path(path)):
+            try:
+                if os.path.exists(victim):
+                    os.remove(victim)
+            except OSError as e:
+                log_warning(f"could not prune snapshot {victim}: {e}")

@@ -66,6 +66,7 @@ METRICS_SCHEMA = "lightgbm_tpu.metrics/v2"
 SPAN_CAPACITY = 65536
 TIMELINE_CAPACITY = 8192
 MEM_TRACK_CAPACITY = 16384
+FAULT_CAPACITY = 512
 
 # jax.monitoring event name -> (count counter, seconds counter)
 _JAX_DURATION_EVENTS = {
@@ -121,6 +122,12 @@ class TelemetryRegistry:
         self._mem_interval_ms = 0.0
         # ------ XLA cost analysis (per jit-seam label) ------
         self._costs: Dict[str, Dict[str, float]] = {}
+        # ------ fault / recovery narration ------
+        # every injected fault, rollback, retry and salvage lands here so
+        # the metrics blob can explain a degraded run; recorded at EVERY
+        # level (faults are rare and load-bearing, unlike hot-path spans)
+        self._faults: deque = deque(maxlen=FAULT_CAPACITY)
+        self._fault_counts: Dict[str, float] = defaultdict(float)
         self._level = self._resolve_level()
 
     # ------------------------------------------------------------- level
@@ -233,6 +240,36 @@ class TelemetryRegistry:
                 {"iter": int(iteration), "count": int(count),
                  "t": round(time.perf_counter() - self._epoch, 6),
                  "counters": deltas})
+
+    # -------------------------------------------------------------- faults
+    def fault_event(self, kind: str, site: str = "", detail: str = "",
+                    iteration: Optional[int] = None) -> None:
+        """Record one fault/recovery event (``injected``, ``oom_degrade``,
+        ``nonfinite_rollback``, ``snapshot_io``, ``resume``,
+        ``collective_retry``, ``partial_save`` ...).  Unlike counters and
+        spans this records at every telemetry level: faults are rare and
+        explain why a run degraded, so they must never be gated away."""
+        with self._lock:
+            self._note_writer()
+            self._fault_counts[kind] += 1
+            ev: Dict[str, Any] = {
+                "kind": kind,
+                "t": round(time.perf_counter() - self._epoch, 6),
+            }
+            if site:
+                ev["site"] = site
+            if detail:
+                ev["detail"] = detail
+            if iteration is not None:
+                ev["iter"] = int(iteration)
+            self._faults.append(ev)
+
+    def _faults_section(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            if not self._faults and not self._fault_counts:
+                return None
+            return {"counts": dict(self._fault_counts),
+                    "events": [dict(e) for e in self._faults]}
 
     # ------------------------------------------------------ jax.monitoring
     def install_jax_listeners(self) -> None:
@@ -489,6 +526,9 @@ class TelemetryRegistry:
         cost = self._cost_section()
         if cost is not None:
             out["cost"] = cost
+        faults = self._faults_section()
+        if faults is not None:
+            out["faults"] = faults
         return out
 
     def chrome_trace(self) -> Dict[str, Any]:
@@ -499,6 +539,7 @@ class TelemetryRegistry:
             spans = list(self._spans)
             timeline = list(self._timeline)
             mem_track = list(self._mem_track)
+            faults = [dict(e) for e in self._faults]
         pid = os.getpid()
         events = []
         tids: Dict[str, int] = {}
@@ -530,6 +571,15 @@ class TelemetryRegistry:
                            "pid": pid, "tid": 0,
                            "ts": round(t_off * 1e6, 3),
                            "args": {"value": in_use}})
+        # fault/recovery events as globally-scoped instants, so a
+        # degradation is visible at a glance on the trace timeline
+        for ev in faults:
+            args = {k: v for k, v in ev.items() if k not in ("kind", "t")}
+            events.append({"name": f"fault/{ev['kind']}",
+                           "cat": "lightgbm_tpu", "ph": "i", "s": "g",
+                           "pid": pid, "tid": 0,
+                           "ts": round(ev["t"] * 1e6, 3),
+                           "args": args})
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"schema": METRICS_SCHEMA}}
 
@@ -582,6 +632,8 @@ class TelemetryRegistry:
             self._mem_track.clear()
             self._mem_interval_ms = 0.0
             self._costs = {}
+            self._faults.clear()
+            self._fault_counts.clear()
         net = sys.modules.get("lightgbm_tpu.parallel.network")
         if net is not None and hasattr(net, "reset_collective_stats"):
             net.reset_collective_stats()

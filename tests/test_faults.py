@@ -1,0 +1,315 @@
+"""Fault-tolerance tests: the injection registry, OOM-degrading chunk
+retry, non-finite guardrails, snapshot resume, crash salvage, and the
+collective retry — every recovery path exercised deterministically via
+LIGHTGBM_TPU_FAULTS.
+
+``FAULT_MATRIX_CHUNK`` (set by tools/fault_matrix.sh) narrows the
+chunk-size parametrization to one value so the matrix runs each
+configuration in a clean process.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import Application
+from lightgbm_tpu.utils.faults import (ENV_FAULTS, FAULTS, InjectedFault,
+                                       parse_spec)
+from lightgbm_tpu.utils.log import LightGBMError
+from lightgbm_tpu.utils.telemetry import TELEMETRY
+
+_MATRIX = os.environ.get("FAULT_MATRIX_CHUNK", "")
+CHUNKS = [int(_MATRIX)] if _MATRIX else [1, 4]
+
+PARAMS = {"objective": "regression", "num_leaves": 7, "verbose": -1,
+          "min_data_in_leaf": 5, "seed": 7}
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Each test starts with clean telemetry (fault counts are global and
+    accumulate across runs) and leaves no armed fault sites behind."""
+    TELEMETRY.reset()
+    yield
+    os.environ.pop(ENV_FAULTS, None)
+    FAULTS.configure()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv(ENV_FAULTS, spec)
+    FAULTS.configure()
+
+
+def _make_data(rng, n=240):
+    X = rng.rand(n, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.rand(n)
+    return X, y
+
+
+# ---------------------------------------------------------------- registry
+def test_parse_spec_grammar():
+    spec = parse_spec("chunk/oom@1x2, grad/nonfinite@3 ,snapshot/io@0x*")
+    assert spec == {"chunk/oom": (1, 2), "grad/nonfinite": (3, 1),
+                    "snapshot/io": (0, None)}
+    assert parse_spec("train/kill") == {"train/kill": (0, 1)}
+    assert parse_spec("") == {}
+    assert parse_spec("  , ") == {}
+
+
+def test_parse_spec_rejects_unknown_site():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        parse_spec("chunk/ooom")
+    with pytest.raises(ValueError, match="bad fault spec"):
+        parse_spec("chunk/oom@x")
+
+
+def test_registry_occurrence_counting(monkeypatch):
+    _arm(monkeypatch, "snapshot/io@1x2")
+    assert not FAULTS.check("snapshot/io")   # occurrence 0: before start
+    assert FAULTS.check("snapshot/io")       # occurrence 1
+    assert FAULTS.check("snapshot/io")       # occurrence 2
+    assert not FAULTS.check("snapshot/io")   # count exhausted
+    # explicit-index probing respects start/count the same way
+    _arm(monkeypatch, "grad/nonfinite@3")
+    assert not FAULTS.check("grad/nonfinite", n=2)
+    assert FAULTS.check("grad/nonfinite", n=3)
+    assert not FAULTS.check("grad/nonfinite", n=4)
+
+
+def test_registry_disabled_fast_path():
+    os.environ.pop(ENV_FAULTS, None)
+    FAULTS.configure()
+    assert not FAULTS.enabled
+    assert not FAULTS.check("chunk/oom")
+    FAULTS.maybe_raise("chunk/oom")          # no-op when disarmed
+
+
+def test_configure_resets_counters(monkeypatch):
+    _arm(monkeypatch, "train/kill")
+    assert FAULTS.check("train/kill")
+    assert not FAULTS.check("train/kill")
+    FAULTS.configure()                        # same env spec, fresh counters
+    assert FAULTS.check("train/kill")
+
+
+def test_env_wins_over_config(monkeypatch):
+    monkeypatch.setenv(ENV_FAULTS, "chunk/oom@5")
+    FAULTS.configure("chunk/oom@1,train/kill@2")
+    armed = FAULTS.armed()
+    assert armed["chunk/oom"]["start"] == 5   # env beat the config value
+    assert armed["train/kill"]["start"] == 2  # config-only site kept
+
+
+# ------------------------------------------------- OOM-degrading chunk retry
+def test_oom_degrades_and_completes(rng, monkeypatch):
+    X, y = _make_data(rng)
+    clean = lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    _arm(monkeypatch, "chunk/oom")
+    faulted = lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+    assert faulted.current_iteration() == 8
+    counts = faulted.train_stats["faults"]["counts"]
+    assert counts["oom_degrade"] == 1
+    assert counts["injected"] == 1
+    # sub-chunk splitting is bit-exact: the degraded run's model matches
+    # the clean run byte for byte
+    assert faulted.model_to_string() == clean.model_to_string()
+
+
+def test_oom_exhausts_to_actionable_error(rng, monkeypatch):
+    X, y = _make_data(rng)
+    _arm(monkeypatch, "chunk/oom@0x*")       # allocator never heals
+    with pytest.raises(LightGBMError, match="even at\\s+chunk size 1"):
+        lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+
+
+# ------------------------------------------------------ non-finite guardrail
+@pytest.mark.parametrize("chunk", CHUNKS)
+def test_nonfinite_rolls_back_to_last_good(rng, monkeypatch, chunk):
+    X, y = _make_data(rng)
+    _arm(monkeypatch, "grad/nonfinite@2")
+    bst = lgb.Booster(params=dict(PARAMS, tpu_boost_chunk=chunk),
+                      train_set=lgb.Dataset(X, label=y))
+    with pytest.raises(LightGBMError, match="Non-finite") as ei:
+        for _ in range(4):
+            if chunk > 1:
+                bst.update_chunk(chunk)
+            else:
+                bst.update()
+    # the error names the failing iteration (or the chunk holding it)
+    msg = str(ei.value)
+    assert ("iteration 2" in msg if chunk == 1 else "iterations 0..3" in msg)
+    assert "regression" in msg
+    # every iteration before the poisoned one survives; nothing after
+    kept = bst.current_iteration()
+    assert kept == (0 if chunk > 1 else 2)    # chunk 0..3 dropped whole
+    counts = TELEMETRY.stats()["faults"]["counts"]
+    assert counts["nonfinite_rollback"] == 1
+
+
+def test_nonfinite_disabled_by_config(rng, monkeypatch):
+    X, y = _make_data(rng)
+    _arm(monkeypatch, "grad/nonfinite@1")
+    # escape hatch: check_nonfinite=false trains through the NaNs
+    bst = lgb.train(dict(PARAMS, check_nonfinite=False),
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    assert bst.current_iteration() >= 1
+
+
+# ------------------------------------------------------ CLI snapshots/resume
+def _write_csv(path, rng, n=300):
+    X = rng.rand(n, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.rand(n)
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+
+
+def _cli_argv(extra=()):
+    return ["task=train", "data=train.csv", "label_column=0",
+            "objective=regression", "num_iterations=8", "num_leaves=7",
+            "min_data_in_leaf=5", "verbosity=-1", "snapshot_freq=2",
+            "output_model=model.txt", "metrics_out=metrics.json",
+            *extra]
+
+
+@pytest.mark.parametrize("chunk", CHUNKS)
+def test_kill_and_resume_is_bitexact(tmp_path, rng, monkeypatch, chunk):
+    """ISSUE acceptance: injected kill + resume=true produces a model
+    byte-identical to the uninterrupted run (identical argv, so even the
+    parameters section matches)."""
+    seed = rng.randint(1 << 30)
+    a, b = tmp_path / "a", tmp_path / "b"
+    for d in (a, b):
+        d.mkdir()
+        _write_csv(d / "train.csv", np.random.RandomState(seed))
+    argv = _cli_argv([f"tpu_boost_chunk={chunk}"])
+
+    monkeypatch.chdir(a)
+    Application(argv).run()                   # uninterrupted reference run
+
+    monkeypatch.chdir(b)
+    _arm(monkeypatch, "train/kill@4")
+    with pytest.raises(InjectedFault):
+        Application(argv).run()
+    assert (b / "model.txt.partial").exists()
+    assert not (b / "model.txt").exists()
+    blob = json.loads((b / "metrics.json").read_text())
+    assert blob["faults"]["counts"]["partial_save"] == 1
+
+    monkeypatch.delenv(ENV_FAULTS)
+    Application(argv + ["resume=true"]).run()
+    assert (b / "model.txt").read_bytes() == (a / "model.txt").read_bytes()
+    blob = json.loads((b / "metrics.json").read_text())
+    assert blob["faults"]["counts"]["resume"] == 1
+
+
+def test_snapshot_io_failure_does_not_abort(tmp_path, rng, monkeypatch):
+    _write_csv(tmp_path / "train.csv", rng)
+    monkeypatch.chdir(tmp_path)
+    _arm(monkeypatch, "snapshot/io@0x*")      # every snapshot write fails
+    Application(_cli_argv()).run()
+    assert (tmp_path / "model.txt").exists()  # run completed regardless
+    assert not list(tmp_path.glob("model.txt.snapshot_iter_*"))
+    blob = json.loads((tmp_path / "metrics.json").read_text())
+    assert blob["faults"]["counts"]["snapshot_io"] == 4  # 8 iters, freq 2
+
+
+def test_snapshot_retention(tmp_path, rng, monkeypatch):
+    _write_csv(tmp_path / "train.csv", rng)
+    monkeypatch.chdir(tmp_path)
+    Application(_cli_argv(["snapshot_keep=1"])).run()
+    snaps = sorted(p.name for p in tmp_path.glob("model.txt.snapshot_iter_*")
+                   if not p.name.endswith(".npz"))
+    assert snaps == ["model.txt.snapshot_iter_8"]
+    assert (tmp_path / "model.txt.snapshot_iter_8.state.npz").exists()
+
+
+def test_resume_without_snapshot_starts_fresh(tmp_path, rng, monkeypatch):
+    _write_csv(tmp_path / "train.csv", rng)
+    monkeypatch.chdir(tmp_path)
+    Application(_cli_argv(["resume=true"])).run()
+    assert (tmp_path / "model.txt").exists()
+
+
+def test_find_latest_requires_sidecar(tmp_path):
+    from lightgbm_tpu.utils.snapshots import (find_latest_snapshot,
+                                              prune_snapshots)
+    model = str(tmp_path / "m.txt")
+    for it in (2, 4, 6):
+        (tmp_path / f"m.txt.snapshot_iter_{it}").write_text("x")
+        if it != 6:                           # 6 is torn: no sidecar
+            (tmp_path / f"m.txt.snapshot_iter_{it}.state.npz").write_bytes(
+                b"x")
+    path, it = find_latest_snapshot(model)
+    assert it == 4 and path.endswith("snapshot_iter_4")
+    prune_snapshots(model, keep=1)
+    left = sorted(p.name for p in tmp_path.glob("m.txt.snapshot_iter_*"))
+    assert left == ["m.txt.snapshot_iter_6"]  # newest kept (sidecar or not)
+
+
+# ---------------------------------------------------- engine/network/atomic
+def test_engine_flushes_train_stats_on_crash(rng):
+    X, y = _make_data(rng)
+    seen = {}
+
+    def boom(env):
+        seen["model"] = env.model
+        if env.iteration >= 1:
+            raise RuntimeError("callback crash")
+
+    with pytest.raises(RuntimeError, match="callback crash"):
+        lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=6,
+                  callbacks=[boom])
+    # engine.py's finally still bound the run's telemetry to the booster
+    assert seen["model"].train_stats is not None
+    assert "spans" in seen["model"].train_stats
+
+
+def test_dispose_resets_collective_stats():
+    from lightgbm_tpu.parallel import network
+    network.record_collective("allgather_obj", 128, 0.001)
+    assert network.collective_stats()
+    network.dispose()
+    assert network.collective_stats() == {}
+    # back-to-back runs: the second starts from zeroed counters
+    network.record_collective("allgather_obj", 64, 0.001)
+    assert network.collective_stats()["allgather_obj"]["calls"] == 1
+    network.dispose()
+
+
+def test_allgather_retries_once(monkeypatch):
+    from lightgbm_tpu.parallel import network
+    _arm(monkeypatch, "collective/allgather")
+    TELEMETRY.reset()
+    assert network.allgather_obj({"rank": 0}) == [{"rank": 0}]
+    counts = TELEMETRY.stats()["faults"]["counts"]
+    assert counts["collective_retry"] == 1
+    _arm(monkeypatch, "collective/allgather@0x*")
+    with pytest.raises(InjectedFault):        # second failure propagates
+        network.allgather_obj({"rank": 0})
+
+
+def test_atomic_save_leaves_no_tmp(tmp_path, rng):
+    X, y = _make_data(rng)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=3)
+    out = tmp_path / "m.txt"
+    bst.save_model(str(out))
+    reread = lgb.Booster(model_file=str(out))
+    assert reread.current_iteration() == 3
+    bst.save_model(str(out))                  # overwrite in place
+    assert [p.name for p in tmp_path.iterdir()] == ["m.txt"]
+
+
+def test_fault_events_in_chrome_trace(rng, monkeypatch):
+    X, y = _make_data(rng)
+    _arm(monkeypatch, "chunk/oom")
+    lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+              lgb.Dataset(X, label=y), num_boost_round=8)
+    trace = TELEMETRY.chrome_trace()
+    names = {ev.get("name") for ev in trace["traceEvents"]}
+    assert "fault/oom_degrade" in names
+    assert "fault/injected" in names

@@ -76,12 +76,15 @@ def test_trace_export_schema(rng, tmp_path, monkeypatch):
     span_names = set()
     for ev in events:
         assert {"name", "ph", "pid", "tid"} <= set(ev)
-        assert ev["ph"] in ("X", "C", "M")
+        assert ev["ph"] in ("X", "C", "M", "i")
         if ev["ph"] == "X":        # complete event: microsecond ts + dur
             assert {"ts", "dur", "cat"} <= set(ev)
             assert isinstance(ev["tid"], int)
             assert ev["dur"] >= 0
             span_names.add(ev["name"])
+        elif ev["ph"] == "i":      # fault instant event: global scope
+            assert ev["s"] == "g"
+            assert ev["name"].startswith("fault/")
     assert {"boost", "grow", "fetch"} <= span_names
 
     # the same data is reachable through the stats API
@@ -202,22 +205,27 @@ def test_network_allgather_obj_counters():
                                 rank=0, num_machines=2)
     try:
         out = network.allgather_obj({"mapper": 7})
+        # read BEFORE dispose(): teardown resets the counters
+        st = network.collective_stats()
+        summary = network.collective_summary()
+        timer_line = GLOBAL_TIMER.summary()
+        net_stats = TELEMETRY.stats()["network"]
     finally:
         network.dispose()
     assert out == [{"mapper": 7}, {"mapper": 7}]
 
-    st = network.collective_stats()
     assert st["allgather_obj"]["calls"] == 1
     assert st["allgather_obj"]["bytes"] > 0
     assert st["allgather_obj"]["seconds"] >= 0.0
 
     # rendered into the phase summary line and the stats blob
-    assert "allgather_obj=1x" in network.collective_summary()
-    assert "allgather_obj=1x" in GLOBAL_TIMER.summary()
-    assert TELEMETRY.stats()["network"]["allgather_obj"]["calls"] == 1
+    assert "allgather_obj=1x" in summary
+    assert "allgather_obj=1x" in timer_line
+    assert net_stats["allgather_obj"]["calls"] == 1
 
-    network.reset_collective_stats()
+    # dispose() zeroed the counters so a back-to-back run starts clean
     assert network.collective_stats() == {}
+    assert "allgather_obj" not in GLOBAL_TIMER.summary()
     assert network.collective_summary() == ""
 
 

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Fault-injection matrix: run the fault-tolerance suite once per chunked
+# dispatch mode (tpu_boost_chunk 1 = per-iteration, 4 = fused chunks), each
+# in a clean process so degraded chunk caps / armed sites cannot leak
+# between configurations.
+#
+#   tools/fault_matrix.sh [extra pytest args...]
+#
+# FAULT_MATRIX_CHUNK is deliberately NOT LIGHTGBM_TPU_-prefixed: the test
+# conftest scrubs that namespace at import, and this knob must survive to
+# narrow the chunk parametrization inside tests/test_faults.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+status=0
+for chunk in 1 4; do
+  echo "=== fault matrix: tpu_boost_chunk=${chunk} ==="
+  if ! FAULT_MATRIX_CHUNK="${chunk}" JAX_PLATFORMS=cpu \
+      python -m pytest tests/test_faults.py -q -p no:cacheprovider "$@"; then
+    status=1
+  fi
+done
+exit ${status}

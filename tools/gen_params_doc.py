@@ -89,7 +89,29 @@ def generate() -> str:
         "  writes the trace there.",
         "- `metrics_out` — CLI training only: write the versioned",
         "  telemetry JSON blob (schema `lightgbm_tpu.metrics/v2`) to this",
-        "  path after training.",
+        "  path after training.  Written even when training crashes, so",
+        "  the blob's `faults` section survives for post-mortems.",
+        "- `check_nonfinite` — finiteness guardrail on the boosted score",
+        "  buffer (default `true`): a NaN/Inf iteration (diverged",
+        "  objective, bad learning rate) is rolled back to the last good",
+        "  iteration and reported with an actionable error instead of",
+        "  silently corrupting every later tree.  Costs one device->host",
+        "  scalar sync per iteration/chunk boundary; set `false` to trade",
+        "  the guardrail for that sync (see docs/ROBUSTNESS.md).",
+        "- `resume` — CLI training only: discover the newest",
+        "  `<output_model>.snapshot_iter_N` (+ its `.state.npz` exact-state",
+        "  sidecar) and continue training from iteration N, bit-exactly —",
+        "  the final model is byte-identical to an uninterrupted run.",
+        "  Runtime-only: never serialized into the model's `parameters:`",
+        "  section.",
+        "- `snapshot_keep` — retain only the newest K snapshots",
+        "  (model + sidecar); `0` (default) keeps all, matching the",
+        "  reference `save_period` behavior.",
+        "- `fault_injection` — deterministic fault-injection spec",
+        "  (`SITE[@START][xCOUNT]`, comma-separated) for robustness",
+        "  testing; the `LIGHTGBM_TPU_FAULTS` env var overrides per-site.",
+        "  Runtime-only: never serialized into the model.  See",
+        "  docs/ROBUSTNESS.md for the grammar and the site list.",
         "",
     ]
     return "\n".join(lines)

@@ -116,6 +116,7 @@ def summarize(stats: dict, top: int = 6) -> str:
     lines.extend(_memory_lines(stats))
     lines.extend(_cost_lines(stats))
     lines.extend(_utilization_lines(stats))
+    lines.extend(_fault_lines(stats))
     return "\n".join(lines)
 
 
@@ -167,6 +168,25 @@ def _cost_lines(stats: dict, top: int = 6) -> list:
             f"{_fmt_bytes(e.get('bytes_accessed', 0.0))} "
             f"= {_fmt_rate(e.get('flops_total', 0.0), 'FLOP')} "
             f"({e.get('compiles', 0)} compiles)")
+    return out
+
+
+def _fault_lines(stats: dict, top: int = 8) -> list:
+    faults = stats.get("faults")
+    if not faults:
+        return ["  faults: n/a (no injections or recoveries this run)"]
+    counts = faults.get("counts") or {}
+    parts = [f"{k}={int(v)}" for k, v in sorted(counts.items())]
+    out = ["  faults: " + (" ".join(parts) if parts else "(events only)")]
+    for ev in (faults.get("events") or [])[-top:]:
+        desc = ev.get("kind", "?")
+        if ev.get("site"):
+            desc += f" @ {ev['site']}"
+        if ev.get("iter") is not None:
+            desc += f" iter {ev['iter']}"
+        if ev.get("detail"):
+            desc += f" ({ev['detail']})"
+        out.append(f"    t={ev.get('t', 0.0):.3f}s {desc}")
     return out
 
 
