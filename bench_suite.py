@@ -26,6 +26,10 @@ collection is written to BENCH_SUITE.json:
                             scaling claim) with a serial-parity gate.
 
 Usage:  python bench_suite.py [config ...]    (default: all four)
+        python bench_suite.py --gate [config ...]
+                              (also run tools/bench_gate.py over the
+                              appended trajectory; exit 1 on wall/HBM/
+                              quality regressions vs trailing history)
 """
 
 import json
@@ -389,6 +393,7 @@ def _append_trajectory(results: list) -> None:
             mem = m.get("memory") or {}
             cost = m.get("cost") or {}
             fh.write(json.dumps({
+                "schema": "lightgbm_tpu.trajectory/v1",
                 "ts": round(time.time(), 3),
                 "config": r.get("config"),
                 "metric": r.get("metric"),
@@ -441,6 +446,14 @@ def main():
         results = list(old.values())
     with open(path, "w") as fh:
         json.dump(results, fh, indent=1)
+    if "--gate" in sys.argv[1:]:
+        # perf-regression sentinel: judge the lines just appended
+        # against the trailing trajectory (tools/bench_gate.py) after
+        # the artifacts are safely on disk
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import bench_gate
+        sys.exit(bench_gate.gate(
+            os.path.join(REPO, "BENCH_TRAJECTORY.jsonl")))
 
 
 if __name__ == "__main__":

@@ -434,8 +434,11 @@ class Booster:
     def get_stats(self) -> Dict:
         """Training telemetry snapshot (utils/telemetry.py): phase
         seconds, transfer/compile/network counters, gauges and the
-        per-iteration timeline.  ``engine.train`` attaches the same dict
-        as ``booster.train_stats`` at the end of training."""
+        per-iteration timeline, plus (v3) top-level ``schema`` and
+        ``telemetry_level`` keys — downstream tools branch on those
+        instead of sniffing sections — and a ``health`` digest when the
+        run wrote a health stream.  ``engine.train`` attaches the same
+        dict as ``booster.train_stats`` at the end of training."""
         from .utils.telemetry import TELEMETRY
         return TELEMETRY.stats()
 

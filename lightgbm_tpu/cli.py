@@ -126,6 +126,18 @@ class Application:
         if resume_snap is not None:
             done = self._resume(booster, resume_snap)
 
+        from .utils.telemetry import HEALTH
+        # streaming run-health layer: resume compacts the existing
+        # stream past the snapshot iteration and keeps appending, so a
+        # killed+resumed run yields ONE contiguous stream
+        health_path = HEALTH.resolve_path(cfg)
+        if health_path:
+            HEALTH.open(
+                health_path,
+                resume_iter=done if resume_snap is not None else None,
+                meta={"source": "cli",
+                      "num_iterations": int(cfg.num_iterations)})
+
         log_info(f"Started training for {cfg.num_iterations} iterations")
         start = time.perf_counter()
         # Chunked stepping (tpu_boost_chunk): the step is clamped so it
@@ -137,6 +149,22 @@ class Application:
         from .utils.faults import FAULTS
         from .utils.phase import profile_session
         from .utils.telemetry import TELEMETRY
+        # a preempted job (SIGTERM from the scheduler, ctrl-C) must still
+        # report: raise SystemExit so the salvage/metrics/trace/health
+        # flushes in the finally below run before the process dies.
+        # Signal handlers only bind in the main thread; elsewhere the
+        # default disposition stays (the finally still runs on exceptions)
+        import signal as _signal
+
+        def _graceful_stop(signum, frame):
+            raise SystemExit(128 + signum)
+
+        prev_handlers = {}
+        for _sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                prev_handlers[_sig] = _signal.signal(_sig, _graceful_stop)
+            except (ValueError, OSError):
+                pass
         failed = False
         try:
             # profiler window is exception-safe: a mid-training error must
@@ -153,15 +181,22 @@ class Application:
                     if (cfg.metric_freq > 0
                             and (it + 1) % cfg.metric_freq == 0
                             and metric_names):
+                        eval_rec = {}
                         if cfg.is_provide_training_metric:
                             for mname, val, _ in booster.eval_train():
                                 log_info(f"Iteration:{it + 1}, training "
                                          f"{mname} : {val:g}")
+                                eval_rec[f"training/{mname}"] = float(val)
                         for vi, vname in enumerate(names):
                             for mname, val, _ in booster.eval_valid(vi):
                                 log_info(f"Iteration:{it + 1}, "
                                          f"valid_{vi + 1} "
                                          f"{mname} : {val:g}")
+                                eval_rec[f"valid_{vi + 1}/{mname}"] = \
+                                    float(val)
+                        if eval_rec and HEALTH.active:
+                            HEALTH.record("eval", {"iter": int(it),
+                                                   "metrics": eval_rec})
                     if (cfg.snapshot_freq > 0
                             and (it + 1) % cfg.snapshot_freq == 0):
                         self._write_snapshot(booster, it + 1)
@@ -179,6 +214,16 @@ class Application:
             # metrics blob and the Chrome trace
             if failed:
                 self._salvage_partial(booster)
+            # close the stream first (writing its summary record) so the
+            # metrics blob's health digest covers the whole run; settle
+            # the async tree pipeline so the last iterations' records
+            # land before the summary (best-effort on the crash path)
+            if health_path:
+                try:
+                    booster.models
+                except Exception:
+                    pass
+                HEALTH.close(aborted=failed)
             if cfg.metrics_out:
                 import json
                 try:
@@ -188,6 +233,11 @@ class Application:
                 except OSError as e:
                     log_warning(f"could not write {cfg.metrics_out}: {e}")
             TELEMETRY.maybe_export_trace()
+            for _sig, _prev in prev_handlers.items():
+                try:
+                    _signal.signal(_sig, _prev)
+                except (ValueError, OSError):
+                    pass
         self._save_model(booster, cfg.output_model)
         log_info(f"Finished training, saved model to {cfg.output_model}")
 

@@ -197,6 +197,12 @@ _PARAMS: Dict[str, _P] = {
     # CLI (task=train): write the versioned metrics JSON blob here after
     # training ("" = don't)
     "metrics_out": _P(""),
+    # streaming run-health JSONL (utils/telemetry.HealthStream): one
+    # atomically-appended record per iteration/eval/snapshot/fault while
+    # training runs, consumable live via tools/run_monitor.py; a resumed
+    # run compacts past the snapshot iteration and keeps appending.
+    # Env LIGHTGBM_TPU_HEALTH_JSONL wins; "" = no stream
+    "health_out": _P(""),
     # -- robustness (utils/faults.py, docs/ROBUSTNESS.md) --
     # blocking finiteness check on the boosted scores at chunk
     # boundaries (and per-iteration when chunking is off): a NaN/Inf

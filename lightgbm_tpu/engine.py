@@ -121,7 +121,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # a callback or device error mid-training must not leak an open jax
     # profiler trace session
     from .utils.phase import profile_session
-    from .utils.telemetry import TELEMETRY
+    from .utils.telemetry import HEALTH, TELEMETRY
+    # streaming run-health layer (health_out= / LIGHTGBM_TPU_HEALTH_JSONL):
+    # per-iteration and per-eval records appended while the loop runs, so
+    # a long job is observable before its finally-flush
+    health_path = HEALTH.resolve_path(booster.gbdt.config)
+    if health_path:
+        HEALTH.open(health_path,
+                    meta={"source": "engine",
+                          "num_iterations": int(num_boost_round)})
     # memory_session brackets the run with HBM gauge samples and owns the
     # optional background sampler's lifetime (stopped even when a callback
     # or device error raises out of the loop)
@@ -148,6 +156,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         evaluation_result_list.extend(
                             booster.eval_train(feval))
                     evaluation_result_list.extend(booster.eval_valid(feval))
+                if evaluation_result_list and HEALTH.active:
+                    HEALTH.record("eval", {
+                        "iter": int(it),
+                        "metrics": {f"{dn}/{mn}": float(v)
+                                    for dn, mn, v, _ in
+                                    evaluation_result_list}})
                 try:
                     for cb in callbacks_after:
                         cb(callback_mod.CallbackEnv(
@@ -174,6 +188,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # Chrome trace — the partial run is often the one worth debugging
             booster.train_stats = TELEMETRY.stats()
             TELEMETRY.maybe_export_trace()
+        if health_path:
+            # settle the async tree pipeline so the last iterations'
+            # records land before the summary; best-effort on the
+            # failure path (the original exception stays primary)
+            try:
+                booster.gbdt.models
+            except Exception:
+                pass
+            # summary record (aborted on the failure path) + descriptor
+            # release; the digest stays in stats()' health section
+            HEALTH.close(aborted=failed)
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.gbdt.current_iteration()
     # success path: snapshot AFTER the finalizing fetch above so the

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import math
 import time
-from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +30,7 @@ from ..utils.jitcost import cost_jit
 from ..utils.log import (LightGBMError, check, log_fatal, log_info,
                          log_warning)
 from ..utils.phase import GLOBAL_TIMER as _PHASES
-from ..utils.telemetry import TELEMETRY
+from ..utils.telemetry import HEALTH, TELEMETRY
 from .grower import (GrowerParams, _pack_tree_device, fetch_tree_arrays,
                      fetch_tree_chunk, make_grow_tree, unpack_tree_buffers)
 from .grower_seg import print_seg_stats, seg_stats_enabled
@@ -191,6 +191,25 @@ _apply_tree_score = cost_jit("score/apply", jax.jit(_apply_tree_score_core))
 _all_finite = jax.jit(lambda x: jnp.isfinite(x).all())
 
 
+def _grad_stats_core(grads, hesss):
+    """Per-class gradient/hessian diagnostics for the health stream:
+    [C, 8] f32 columns [gmin, gmax, g_l2, g_nonfinite, hmin, hmax,
+    h_l2, h_nonfinite].  Pure jnp so the chunk scan body can inline it
+    (one extra stacked scan output, zero extra dispatches) while the
+    per-iteration paths call the jitted wrapper below — the reductions
+    lower identically either way, keeping the records bit-identical at
+    any chunk size (the same property the chunked trees rely on)."""
+    def one(x):
+        nonfinite = jnp.sum(~jnp.isfinite(x), axis=1).astype(jnp.float32)
+        safe = jnp.where(jnp.isfinite(x), x, 0.0)
+        return (jnp.min(safe, axis=1), jnp.max(safe, axis=1),
+                jnp.sqrt(jnp.sum(safe * safe, axis=1)), nonfinite)
+    return jnp.stack(one(grads) + one(hesss), axis=1)
+
+
+_grad_stats = cost_jit("health/grad_stats", jax.jit(_grad_stats_core))
+
+
 def _is_oom_error(e: BaseException) -> bool:
     """RESOURCE_EXHAUSTED-shaped device failures (real XlaRuntimeError
     allocation failures and injected chunk/oom faults) that the chunked
@@ -222,8 +241,11 @@ class GBDT:
         FAULTS.configure(getattr(config, "fault_injection", ""))
         self.train_set: Optional[TpuDataset] = None
         self._models: List[Tree] = []           # flat: iter-major, class-minor
-        # finished trees whose device->host transfer is still in flight:
-        # list of (ints_dev, floats_dev, shrinkage) in iteration order
+        # finished trees whose device->host transfer is still in flight,
+        # in iteration order: (first_iter, payload, grad_stats) where
+        # payload is [(ints_dev, floats_dev, shrinkage)] * C or a
+        # _PendingChunk, and grad_stats is the device-side health
+        # diagnostics ([C, 8] / [T, C, 8]) or None when no stream runs
         self._pending: List[tuple] = []
         self._stop_flag = False
         self.num_tree_per_iteration = (
@@ -816,6 +838,10 @@ class GBDT:
             def body(carry, _):
                 score, key = carry
                 grads, hesss = grad_core(score, arrs)
+                # health diagnostics ride the scan as one more stacked
+                # output ([T, C, 8] total): zero extra dispatches, and
+                # the tiny reduce is dwarfed by the histogram build
+                gstats = _grad_stats_core(grads, hesss)
                 roots = (roots_core(grads, hesss, member, bins)
                          if roots_core is not None else None)
                 ints_l, floats_l = [], []
@@ -829,11 +855,11 @@ class GBDT:
                     ints_l.append(ints_d)
                     floats_l.append(floats_d)
                 return ((score, key),
-                        (jnp.stack(ints_l), jnp.stack(floats_l)))
+                        (jnp.stack(ints_l), jnp.stack(floats_l), gstats))
 
-            (score, key), (ints_all, floats_all) = jax.lax.scan(
+            (score, key), (ints_all, floats_all, gstats_all) = jax.lax.scan(
                 body, (score, key), None, length=T)
-            return score, key, ints_all, floats_all
+            return score, key, ints_all, floats_all, gstats_all
 
         chunk_run = cost_jit(f"boost/chunk[{T}]", chunk_run)
         self._chunk_fns[T] = chunk_run
@@ -851,17 +877,23 @@ class GBDT:
 
     def _entry_iter_arrays(self, entry):
         """Normalize one pending entry into per-iteration host pytrees:
-        [(iter_idx, [(TreeArrays, shrinkage)] * C)].  A chunk entry fetches
-        its stacked [T, C, ...] buffers here — two host transfers for the
-        WHOLE chunk (the async copy started at dispatch), then pure numpy
-        slicing."""
-        iter_idx, payload = entry
+        [(iter_idx, [(TreeArrays, shrinkage)] * C, gstats, chunk_len)].
+        A chunk entry fetches its stacked [T, C, ...] buffers here — two
+        host transfers for the WHOLE chunk (the async copy started at
+        dispatch), then pure numpy slicing.  ``gstats`` is the [C, 8]
+        grad/hess diagnostics row for the health stream (None when no
+        stream is active — the device buffer is then never fetched)."""
+        iter_idx, payload, gstats = entry
         L = self.grower_params.num_leaves
+        fetch_stats = gstats is not None and HEALTH.active
         if isinstance(payload, _PendingChunk):
             chunk = fetch_tree_chunk(payload.ints_all, payload.floats_all,
                                      L)
+            gnp = np.asarray(gstats) if fetch_stats else None
             return [(iter_idx + t,
-                     [(arrays, payload.shrinkage) for arrays in per_class])
+                     [(arrays, payload.shrinkage) for arrays in per_class],
+                     gnp[t] if gnp is not None else None,
+                     payload.length)
                     for t, per_class in enumerate(chunk)]
         pairs = []
         for (ints_d, floats_d, lr) in payload:
@@ -871,7 +903,8 @@ class GBDT:
                                   int(ints_np.nbytes)
                                   + int(floats_np.nbytes))
             pairs.append((unpack_tree_buffers(ints_np, floats_np, L), lr))
-        return [(iter_idx, pairs)]
+        return [(iter_idx, pairs,
+                 np.asarray(gstats) if fetch_stats else None, 1)]
 
     def _materialize_iter(self, pairs):
         """One iteration's [(TreeArrays, shrinkage)] -> (trees, all_const);
@@ -912,11 +945,11 @@ class GBDT:
         """
         while len(self._pending) > keep_latest:
             per_iter = self._entry_iter_arrays(self._pending.pop(0))
-            for j, (iter_idx, pairs) in enumerate(per_iter):
+            for j, (iter_idx, pairs, gstats, clen) in enumerate(per_iter):
                 trees, all_const = self._materialize_iter(pairs)
                 if all_const:
                     rest = [(ii, self._materialize_iter(pp)[0])
-                            for ii, pp in per_iter[j + 1:]]
+                            for ii, pp, _g, _c in per_iter[j + 1:]]
                     self._undo_pending_scores([(iter_idx, trees)] + rest
                                               + self._materialize_rest())
                     self._pending = []
@@ -929,6 +962,7 @@ class GBDT:
                 self._models.extend(trees)
                 self._note_trees(trees)
                 self._apply_valid_scores(trees)
+                self._health_emit(iter_idx, trees, gstats, clen)
 
     def _note_trees(self, trees) -> None:
         """Record which features the model has split on, feeding the next
@@ -950,9 +984,52 @@ class GBDT:
     def _materialize_rest(self):
         out = []
         for entry in self._pending:
-            for iter_idx, pairs in self._entry_iter_arrays(entry):
+            for iter_idx, pairs, _g, _c in self._entry_iter_arrays(entry):
                 out.append((iter_idx, self._materialize_iter(pairs)[0]))
         return out
+
+    # ------------------------------------------------------- health stream
+    def _health_emit(self, iter_idx: int, trees, gstats,
+                     chunk_len: int) -> None:
+        """One ``iter`` health record: dispatched chunk size, per-tree
+        shape stats, grad/hess diagnostics ([C, 8] from
+        ``_grad_stats_core``) and the HBM gauge.  Emitted at tree
+        materialization, so the async pipeline's records land in
+        iteration order."""
+        if not HEALTH.active:
+            return
+        rec: Dict[str, Any] = {"iter": int(iter_idx),
+                               "chunk": int(chunk_len)}
+        tstats = []
+        for t in trees:
+            nl = int(t.num_leaves)
+            n = max(nl - 1, 0)
+            gains = np.asarray(t.split_gain[:n], dtype=np.float64)
+            tstats.append({
+                "leaves": nl,
+                "depth": int(np.max(t.leaf_depth[:nl])) if nl > 1 else 0,
+                "gain_sum": float(gains.sum()) if n else 0.0,
+                "gain_max": float(gains.max()) if n else 0.0,
+            })
+        rec["trees"] = tstats
+        if gstats is not None:
+            g = np.asarray(gstats)
+            rec["grad"] = {
+                "min": [float(v) for v in g[:, 0]],
+                "max": [float(v) for v in g[:, 1]],
+                "l2": [float(v) for v in g[:, 2]],
+                "nonfinite": [int(v) for v in g[:, 3]],
+            }
+            rec["hess"] = {
+                "min": [float(v) for v in g[:, 4]],
+                "max": [float(v) for v in g[:, 5]],
+                "l2": [float(v) for v in g[:, 6]],
+                "nonfinite": [int(v) for v in g[:, 7]],
+            }
+        hbm = TELEMETRY.memory_gauges()
+        if hbm is not None:
+            rec["hbm"] = hbm
+        HEALTH.record("iter", rec)
 
     def _undo_pending_scores(self, iter_trees) -> None:
         """Subtract discarded iterations' contributions from train_score
@@ -1067,6 +1144,10 @@ class GBDT:
                 hesss = jnp.asarray(np.asarray(hess, dtype=np.float32)
                                     .reshape(C, self.num_data))
             grads, hesss = self._bagging(self.iter_, grads, hesss)
+            # health diagnostics only when a stream consumes them — the
+            # jitted reduce stays off the default hot path
+            gstats = (_grad_stats(grads, hesss) if HEALTH.active
+                      else None)
             box[0] = grads
 
         if use_async:
@@ -1095,7 +1176,7 @@ class GBDT:
                 ints_d, floats_d = _pack_tree_device(arrays)
                 self._start_host_copy(ints_d, floats_d)
                 items.append((ints_d, floats_d, self.shrinkage_rate))
-            self._pending.append((self.iter_, items))
+            self._pending.append((self.iter_, items, gstats))
             self.iter_ += 1
             # materialize older iterations; the newest stays in flight so
             # its fetch overlaps the next iteration's device work
@@ -1162,6 +1243,9 @@ class GBDT:
             return True
         self._note_trees(self._models[-C:])
         self.iter_ += 1
+        self._health_emit(self.iter_ - 1, self._models[-C:],
+                          np.asarray(gstats) if gstats is not None
+                          else None, 1)
         TELEMETRY.mark_iteration(self.iter_ - 1)
         return False
 
@@ -1178,6 +1262,8 @@ class GBDT:
             # select transforms the gradients; membership-mask baggings
             # ignore them) — same call the eager path makes
             grads, hesss = self._bagging(self.iter_, grads, hesss)
+            gstats = (_grad_stats(grads, hesss) if HEALTH.active
+                      else None)
             box[0] = grads
         roots = None
         if fused_roots is not None:
@@ -1210,7 +1296,7 @@ class GBDT:
             _maybe_print_seg_stats(stats_t)
             self._start_host_copy(ints_d, floats_d)
             items.append((ints_d, floats_d, self.shrinkage_rate))
-        self._pending.append((self.iter_, items))
+        self._pending.append((self.iter_, items, gstats))
         self.iter_ += 1
         with _PHASES.phase("fetch"):
             # CEGB coupled penalties need this iteration's splits noted
@@ -1350,14 +1436,15 @@ class GBDT:
                     out = fn(*args)
             else:
                 out = fn(*args)
-            self.train_score, self._key, ints_all, floats_all = out
+            (self.train_score, self._key, ints_all, floats_all,
+             gstats_all) = out
             box[0] = self.train_score
         # before the chunk's buffers can become trees: a non-finite score
         # discards them and raises (older pending chunks stay good)
         self._guard_chunk_nonfinite(first_iter, t)
-        self._start_host_copy(ints_all, floats_all)
+        self._start_host_copy(ints_all, floats_all, gstats_all)
         self._pending.append((self.iter_, _PendingChunk(
-            ints_all, floats_all, self.shrinkage_rate, t)))
+            ints_all, floats_all, self.shrinkage_rate, t), gstats_all))
         self.iter_ += t
         with _PHASES.phase("fetch"):
             # valid-set scores update at materialization, and eval at the

@@ -100,6 +100,13 @@ def save_snapshot(gbdt, snapshot_file: str, model_text: str) -> None:
         arrays[f"valid_score_{i}"] = np.asarray(vs, dtype=np.float64)
     _atomic_savez(state_path(snapshot_file), **arrays)
     atomic_write_text(snapshot_file, model_text)
+    # narrate the durable point into the run-health stream: a live
+    # monitor can tell how much work a kill would lose
+    from .telemetry import HEALTH
+    if HEALTH.active:
+        HEALTH.record("snapshot", {
+            "iter": int(gbdt.iter_),
+            "file": os.path.basename(snapshot_file)})
 
 
 def restore_snapshot_state(gbdt, snapshot_file: str) -> int:

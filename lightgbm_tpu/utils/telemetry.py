@@ -49,6 +49,20 @@ The v2 schema adds two DEVICE-side sections on top of the host view:
     at the jit seams (utils/jitcost.py), keyed by function label and
     multiplied out by call counts, so ``stats()`` can report
     estimated FLOPs/s and bytes/s for the measured window.
+
+The v3 schema adds the STREAMING run-health layer: every blob carries
+top-level ``schema`` and ``telemetry_level`` keys (so tools can branch
+without sniffing sections), and — when a run writes a health stream —
+a ``health`` digest section.  The stream itself (``HealthStream``, one
+process-global ``HEALTH``) is an append-only JSONL file
+(``health_out=`` config parameter / ``LIGHTGBM_TPU_HEALTH_JSONL`` env)
+written at eval/chunk cadence while training runs, so a 5-hour job is
+legible while it is alive, not only after its ``finally`` flush.  Each
+record is a single ``os.write`` to an ``O_APPEND`` descriptor, so
+records never tear even when a signal kills the process mid-run;
+``resume=true`` compacts records past the snapshot iteration and keeps
+appending, yielding ONE contiguous stream across kill+resume.  Consume
+it live with ``tools/run_monitor.py``.
 """
 
 from __future__ import annotations
@@ -62,7 +76,9 @@ from collections import defaultdict, deque
 from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
-METRICS_SCHEMA = "lightgbm_tpu.metrics/v2"
+METRICS_SCHEMA = "lightgbm_tpu.metrics/v3"
+HEALTH_SCHEMA = "lightgbm_tpu.health/v1"
+HEALTH_ENV = "LIGHTGBM_TPU_HEALTH_JSONL"
 SPAN_CAPACITY = 65536
 TIMELINE_CAPACITY = 8192
 MEM_TRACK_CAPACITY = 16384
@@ -80,6 +96,239 @@ _JAX_COUNT_EVENTS = {
     "/jax/compilation_cache/cache_hits": "compile/cache_hits",
     "/jax/compilation_cache/cache_misses": "compile/cache_misses",
 }
+
+
+class HealthStream:
+    """Append-only JSONL run-health stream (schema ``HEALTH_SCHEMA``).
+
+    Record kinds:
+
+      * ``start`` / ``resume`` — stream (re)opened; ``resume`` carries
+        the snapshot iteration the run continues from.
+      * ``iter`` — one boosting iteration: dispatched chunk size,
+        per-tree shape stats (leaves, depth, split-gain sum/max),
+        per-class gradient/hessian stats (min/max/l2/nonfinite — folded
+        into the chunk scan, zero extra dispatches), and the HBM gauge
+        when the backend reports allocator stats.
+      * ``eval`` — train/valid metric values at the eval cadence.
+      * ``snapshot`` — a resumable snapshot was written.
+      * ``fault`` — mirror of every ``TELEMETRY.fault_event``.
+      * ``summary`` — stream closed (``aborted`` marks a crash/signal).
+
+    Every record is one ``os.write`` to an ``O_APPEND`` descriptor —
+    atomic on POSIX regular files at these sizes, so a SIGKILL between
+    records never leaves a torn line.  On resume the existing file is
+    compacted first (iteration-scoped records at/past the snapshot
+    iteration are dropped via tmp + ``os.replace``), so a killed run
+    whose pipeline had materialized past the snapshot re-emits those
+    iterations exactly once and the stream stays contiguous.
+    """
+
+    # record kinds scoped to an iteration index: these are dropped at/
+    # past the snapshot iteration when a resumed run compacts the file
+    _ITER_SCOPED = ("iter", "eval", "snapshot")
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._path = ""
+        self._fd: Optional[int] = None
+        self._t0 = time.perf_counter()
+        self._records = 0
+        self._by_kind: Dict[str, int] = defaultdict(int)
+        self._last_iter: Optional[Dict[str, Any]] = None
+        self._nonfinite_total = 0
+
+    # ------------------------------------------------------------- config
+    @staticmethod
+    def resolve_path(config=None) -> str:
+        """Stream destination: the env var wins over the ``health_out``
+        config parameter; "" = no stream."""
+        env = os.environ.get(HEALTH_ENV, "")
+        if env:
+            return env
+        if config is not None:
+            return str(getattr(config, "health_out", "") or "")
+        return ""
+
+    @property
+    def active(self) -> bool:
+        return self._fd is not None
+
+    # ---------------------------------------------------------- lifecycle
+    def open(self, path: str, resume_iter: Optional[int] = None,
+             meta: Optional[Dict[str, Any]] = None) -> None:
+        """Open (or, with ``resume_iter``, compact-and-continue) the
+        stream and write the ``start``/``resume`` record.  An IO failure
+        is survivable: logged, and the stream stays inactive."""
+        from .log import log_warning
+        with self._lock:
+            if self._fd is not None:
+                self.close(summary=False)
+            self._path = ""
+            self._records = 0
+            self._by_kind = defaultdict(int)
+            self._last_iter = None
+            self._nonfinite_total = 0
+            self._t0 = time.perf_counter()
+            try:
+                resuming = (resume_iter is not None
+                            and os.path.exists(path))
+                if resuming:
+                    self._compact_for_resume(path, int(resume_iter))
+                flags = os.O_WRONLY | os.O_CREAT | os.O_APPEND
+                if not resuming:
+                    flags |= os.O_TRUNC
+                self._fd = os.open(path, flags, 0o644)
+            except OSError as e:
+                self._fd = None
+                log_warning(f"could not open health stream {path}: {e}")
+                return
+            self._path = path
+            rec: Dict[str, Any] = {
+                "kind": "resume" if resuming else "start",
+                "schema": HEALTH_SCHEMA,
+                "ts": round(time.time(), 3),
+                "pid": os.getpid(),
+            }
+            if resuming:
+                rec["iter"] = int(resume_iter)
+            if meta:
+                rec.update(meta)
+            self._ingest(rec)
+            self._write(rec)
+
+    def _compact_for_resume(self, path: str, resume_iter: int) -> None:
+        """Drop iteration-scoped records at/past the snapshot iteration
+        (the resumed run re-emits them) and any stale ``summary``;
+        re-ingest the survivors so the digest covers the whole run."""
+        kept = []
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue                    # torn/corrupt line
+                kind = rec.get("kind")
+                if kind == "summary":
+                    continue
+                if (kind in self._ITER_SCOPED
+                        and int(rec.get("iter", -1)) >= resume_iter):
+                    continue
+                kept.append((line, rec))
+        d = os.path.dirname(os.path.abspath(path))
+        tmp = os.path.join(
+            d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+        with open(tmp, "w") as fh:
+            for line, _ in kept:
+                fh.write(line + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        for _, rec in kept:
+            self._ingest(rec)
+
+    def close(self, summary: bool = True, aborted: bool = False) -> None:
+        """Write the ``summary`` record (unless suppressed) and release
+        the descriptor.  The digest state stays readable afterwards so
+        a post-run ``stats()`` still carries the ``health`` section."""
+        with self._lock:
+            if self._fd is None:
+                return
+            if summary:
+                rec: Dict[str, Any] = {
+                    "kind": "summary",
+                    "ts": round(time.time(), 3),
+                    "records": self._records + 1,
+                    "aborted": bool(aborted),
+                }
+                if self._last_iter is not None:
+                    rec["iterations"] = int(self._last_iter["iter"]) + 1
+                if self._nonfinite_total:
+                    rec["nonfinite_total"] = self._nonfinite_total
+                self._ingest(rec)
+                self._write(rec)
+            fd, self._fd = self._fd, None
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+    def reset(self) -> None:
+        """Drop the stream and the digest state (test/bench windows)."""
+        with self._lock:
+            self.close(summary=False)
+            self._path = ""
+            self._records = 0
+            self._by_kind = defaultdict(int)
+            self._last_iter = None
+            self._nonfinite_total = 0
+
+    # ------------------------------------------------------------ records
+    def record(self, kind: str, fields: Optional[Dict[str, Any]] = None,
+               ) -> None:
+        """Append one record; no-op when the stream is closed.  ``t`` is
+        stamped as seconds since the stream opened unless provided."""
+        with self._lock:
+            if self._fd is None:
+                return
+            rec: Dict[str, Any] = {"kind": kind}
+            if fields:
+                rec.update(fields)
+            rec.setdefault("t", round(time.perf_counter() - self._t0, 6))
+            self._ingest(rec)
+            self._write(rec)
+
+    def _ingest(self, rec: Dict[str, Any]) -> None:
+        self._records += 1
+        self._by_kind[rec.get("kind", "?")] += 1
+        if rec.get("kind") == "iter":
+            self._last_iter = rec
+            for sec in ("grad", "hess"):
+                nf = (rec.get(sec) or {}).get("nonfinite")
+                if nf:
+                    self._nonfinite_total += int(sum(nf))
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        try:
+            os.write(self._fd, line.encode())
+        except OSError as e:
+            # a full disk must degrade the stream, not kill training
+            from .log import log_warning
+            log_warning(f"health stream write to {self._path} failed "
+                        f"({e}); stream disabled for the rest of the run")
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+
+    # ------------------------------------------------------------- digest
+    def summary_section(self) -> Optional[Dict[str, Any]]:
+        """The ``health`` section of ``stats()``: stream path, record
+        counts by kind, the last ``iter`` record, and nonfinite totals.
+        ``None`` when this process never opened a stream."""
+        with self._lock:
+            if not self._path:
+                return None
+            out: Dict[str, Any] = {
+                "schema": HEALTH_SCHEMA,
+                "path": self._path,
+                "active": self._fd is not None,
+                "records": self._records,
+                "by_kind": dict(self._by_kind),
+            }
+            if self._last_iter is not None:
+                out["last_iter"] = dict(self._last_iter)
+            if self._nonfinite_total:
+                out["nonfinite_total"] = self._nonfinite_total
+            return out
+
+
+HEALTH = HealthStream()
 
 
 class TelemetryRegistry:
@@ -263,6 +512,17 @@ class TelemetryRegistry:
             if iteration is not None:
                 ev["iter"] = int(iteration)
             self._faults.append(ev)
+        # mirror into the health stream (its own lock; no nesting back
+        # into this registry) so a live monitor sees faults as they land
+        if HEALTH.active:
+            fields: Dict[str, Any] = {"fault": kind}
+            if site:
+                fields["site"] = site
+            if detail:
+                fields["detail"] = detail
+            if iteration is not None:
+                fields["iter"] = int(iteration)
+            HEALTH.record("fault", fields)
 
     def _faults_section(self) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -412,6 +672,17 @@ class TelemetryRegistry:
             self.stop_mem_sampler()
             self.sample_memory("session")
 
+    def memory_gauges(self) -> Optional[Dict[str, int]]:
+        """Cheap HBM gauge for per-iteration health records: the last
+        and peak bytes-in-use already sampled at phase boundaries — no
+        fresh allocator query, so the hot path stays untouched.  None on
+        backends without memory stats."""
+        with self._lock:
+            if self._mem_last is None:
+                return None
+            return {"bytes_in_use": self._mem_last,
+                    "peak_bytes_in_use": self._mem_peak}
+
     def _memory_section(self) -> Optional[Dict[str, Any]]:
         with self._lock:
             if self._mem_last is None:
@@ -492,7 +763,9 @@ class TelemetryRegistry:
         device-side ``memory`` (HBM gauges) and ``cost`` (XLA cost
         analysis) sections.  ``memory`` is omitted on backends whose
         ``memory_stats()`` returns None; ``cost`` is omitted when no
-        instrumented seam compiled in the window."""
+        instrumented seam compiled in the window.  v3 adds top-level
+        ``schema``/``telemetry_level`` keys and, when the run wrote a
+        health stream, its ``health`` digest section."""
         import sys
         from .phase import GLOBAL_TIMER, _sync_enabled
         with self._lock:
@@ -509,8 +782,10 @@ class TelemetryRegistry:
         if net is not None and hasattr(net, "collective_stats"):
             network = net.collective_stats()
         out: Dict[str, Any] = {
-            "version": 2,
+            "schema": METRICS_SCHEMA,
+            "version": 3,
             "level": self._level,
+            "telemetry_level": self._level,
             "mode": "sync" if _sync_enabled() else "dispatch",
             "phases": phases,
             "counters": counters,
@@ -529,6 +804,9 @@ class TelemetryRegistry:
         faults = self._faults_section()
         if faults is not None:
             out["faults"] = faults
+        health = HEALTH.summary_section()
+        if health is not None:
+            out["health"] = health
         return out
 
     def chrome_trace(self) -> Dict[str, Any]:
@@ -637,6 +915,7 @@ class TelemetryRegistry:
         net = sys.modules.get("lightgbm_tpu.parallel.network")
         if net is not None and hasattr(net, "reset_collective_stats"):
             net.reset_collective_stats()
+        HEALTH.reset()
         self.refresh_level()
 
 

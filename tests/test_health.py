@@ -1,0 +1,329 @@
+"""Run-health stream tests (PR 6 tentpole): JSONL schema and record
+counts at chunk sizes 1 and 4, grad-stat bit-equality between chunked
+and unchunked runs, kill+resume stream contiguity, SIGTERM flush, the
+stats() v3 surface, and the tools that consume the stream
+(run_monitor, trace_report health digest, bench_gate).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import Application
+from lightgbm_tpu.utils.faults import ENV_FAULTS, FAULTS, InjectedFault
+from lightgbm_tpu.utils.telemetry import (HEALTH, HEALTH_ENV,
+                                          HEALTH_SCHEMA, METRICS_SCHEMA,
+                                          TELEMETRY)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_gate  # noqa: E402
+import run_monitor  # noqa: E402
+import trace_report  # noqa: E402
+
+PARAMS = {"objective": "regression", "num_leaves": 7, "verbose": -1,
+          "min_data_in_leaf": 5, "seed": 7}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    TELEMETRY.reset()
+    yield
+    os.environ.pop(ENV_FAULTS, None)
+    FAULTS.configure()
+    HEALTH.reset()
+
+
+def _make_data(rng, n=240):
+    X = rng.rand(n, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.rand(n)
+    return X, y
+
+
+def _records(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def _train_stream(tmp_path, rng, chunk, rounds=6, name="run"):
+    path = str(tmp_path / f"{name}.health.jsonl")
+    X, y = _make_data(rng)
+    params = dict(PARAMS, tpu_boost_chunk=chunk, health_out=path)
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=rounds)
+    return bst, _records(path), path
+
+
+# ------------------------------------------------------------- the stream
+@pytest.mark.parametrize("chunk", [1, 4])
+def test_stream_schema_and_counts(tmp_path, rng, chunk):
+    rounds = 6
+    bst, recs, _ = _train_stream(tmp_path, rng, chunk, rounds)
+    assert recs[0]["kind"] == "start"
+    assert recs[0]["schema"] == HEALTH_SCHEMA
+    assert recs[0]["num_iterations"] == rounds
+    assert recs[-1]["kind"] == "summary"
+    assert recs[-1]["aborted"] is False
+    assert recs[-1]["iterations"] == rounds
+
+    iters = [r for r in recs if r["kind"] == "iter"]
+    # exactly one record per boosting iteration, in order, even when the
+    # device ran them as lax.scan chunks
+    assert [r["iter"] for r in iters] == list(range(rounds))
+    for r in iters:
+        assert r["chunk"] >= 1
+        for sec in ("grad", "hess"):
+            stats = r[sec]
+            assert set(stats) == {"min", "max", "l2", "nonfinite"}
+            assert len(stats["min"]) == 1          # one tree class
+            assert stats["nonfinite"] == [0]
+        (tree,) = r["trees"]
+        assert tree["leaves"] >= 2
+        assert tree["depth"] >= 1
+        assert tree["gain_sum"] >= tree["gain_max"] > 0
+
+
+def test_grad_stats_bitexact_chunked_vs_unchunked(tmp_path):
+    """The tentpole acceptance property: grad/hess/tree records are
+    bit-identical between tpu_boost_chunk=4 and =1 because the stats are
+    folded into the same device computation (same PRNG stream, same
+    trees) rather than recomputed host-side."""
+    seed = 1234
+
+    def run(chunk):
+        rng = np.random.RandomState(seed)
+        _, recs, _ = _train_stream(tmp_path, rng, chunk,
+                                   name=f"c{chunk}")
+        return [{k: r[k] for k in ("iter", "trees", "grad", "hess")}
+                for r in recs if r["kind"] == "iter"]
+
+    assert run(4) == run(1)
+
+
+def test_stats_v3_surface(tmp_path, rng):
+    bst, _, path = _train_stream(tmp_path, rng, chunk=2)
+    stats = bst.get_stats()
+    assert stats["schema"] == METRICS_SCHEMA
+    assert stats["version"] == 3
+    assert stats["telemetry_level"] == stats["level"]
+    health = stats["health"]
+    assert health["schema"] == HEALTH_SCHEMA
+    assert health["path"] == path
+    assert health["active"] is False               # stream closed
+    assert health["by_kind"]["iter"] == 6
+    assert health["last_iter"]["iter"] == 5
+
+
+def test_env_var_overrides_param(tmp_path, rng, monkeypatch):
+    env_path = str(tmp_path / "env.health.jsonl")
+    monkeypatch.setenv(HEALTH_ENV, env_path)
+    X, y = _make_data(rng)
+    lgb.train(dict(PARAMS, health_out=str(tmp_path / "param.jsonl")),
+              lgb.Dataset(X, y), num_boost_round=2)
+    assert os.path.exists(env_path)
+    assert not os.path.exists(tmp_path / "param.jsonl")
+
+
+# ------------------------------------------------------- CLI kill+resume
+def _write_csv(path, rng, n=300):
+    X = rng.rand(n, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.rand(n)
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+
+
+def _cli_argv(extra=()):
+    return ["task=train", "data=train.csv", "label_column=0",
+            "objective=regression", "num_iterations=8", "num_leaves=7",
+            "min_data_in_leaf=5", "verbosity=-1", "snapshot_freq=2",
+            "output_model=model.txt", "metrics_out=metrics.json",
+            "health_out=run.health.jsonl", *extra]
+
+
+@pytest.mark.parametrize("chunk", [1, 4])
+def test_kill_resume_one_contiguous_stream(tmp_path, rng, monkeypatch,
+                                           chunk):
+    """ISSUE acceptance: a killed-and-resumed chunked run produces ONE
+    contiguous health stream whose per-iteration records are
+    bit-identical to an uninterrupted run's."""
+    seed = rng.randint(1 << 30)
+    a, b = tmp_path / "a", tmp_path / "b"
+    for d in (a, b):
+        d.mkdir()
+        _write_csv(d / "train.csv", np.random.RandomState(seed))
+    argv = _cli_argv([f"tpu_boost_chunk={chunk}"])
+
+    monkeypatch.chdir(a)
+    Application(argv).run()                   # uninterrupted reference
+    ref = _records(a / "run.health.jsonl")
+
+    monkeypatch.chdir(b)
+    monkeypatch.setenv(ENV_FAULTS, "train/kill@4")
+    FAULTS.configure()
+    with pytest.raises(InjectedFault):
+        Application(argv).run()
+    killed = _records(b / "run.health.jsonl")
+    assert killed[-1]["kind"] == "summary"
+    assert killed[-1]["aborted"] is True      # abort still flushed
+
+    monkeypatch.delenv(ENV_FAULTS)
+    FAULTS.configure()
+    Application(argv + ["resume=true"]).run()
+    assert (b / "model.txt").read_bytes() == (a / "model.txt").read_bytes()
+
+    recs = _records(b / "run.health.jsonl")
+    resumes = [r for r in recs if r["kind"] == "resume"]
+    assert len(resumes) == 1                  # one stream, one resume
+
+    def iter_view(records):
+        out = {}
+        for r in records:
+            if r["kind"] == "iter":           # resume overwrite wins
+                out[r["iter"]] = {k: r[k] for k in
+                                  ("iter", "trees", "grad", "hess")}
+        return out
+
+    resumed = iter_view(recs)
+    assert sorted(resumed) == list(range(8))  # contiguous, no gaps
+    assert resumed == iter_view(ref)          # bit-identical content
+    # exactly one record per iteration survives compaction
+    assert len([r for r in recs if r["kind"] == "iter"]) == 8
+    assert len([r for r in recs if r["kind"] == "summary"]) == 1
+    assert recs[-1]["aborted"] is False
+
+
+# ------------------------------------------------------------ SIGTERM
+@pytest.mark.skipif(sys.platform == "win32", reason="POSIX signals")
+def test_sigterm_flushes_health_and_metrics(tmp_path, rng):
+    _write_csv(tmp_path / "train.csv", rng)
+    health = tmp_path / "run.health.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu", "task=train",
+         "data=train.csv", "label_column=0", "objective=regression",
+         "num_iterations=100000", "num_leaves=7", "min_data_in_leaf=5",
+         "verbosity=-1", "output_model=model.txt",
+         "metrics_out=metrics.json", "health_out=run.health.jsonl"],
+        cwd=tmp_path, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if health.exists() and any(
+                    r["kind"] == "iter" for r in _records(health)):
+                break
+            if proc.poll() is not None:
+                pytest.fail(f"run exited early rc={proc.returncode}")
+            time.sleep(0.25)
+        else:
+            pytest.fail("no iter record before deadline")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert rc == 128 + signal.SIGTERM
+    recs = _records(health)
+    assert recs[-1]["kind"] == "summary"      # stream flushed on the way
+    assert recs[-1]["aborted"] is True        # out, not torn mid-record
+    blob = json.loads((tmp_path / "metrics.json").read_text())
+    assert blob["version"] == 3
+    assert (tmp_path / "model.txt.partial").exists()
+
+
+# ----------------------------------------------------------- consumers
+def test_run_monitor_posthoc(tmp_path, rng, capsys):
+    _, recs, path = _train_stream(tmp_path, rng, chunk=4)
+    assert run_monitor.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "[finished]" in out
+    assert "6/6 (100%)" in out
+    assert "grad@5" in out
+    assert run_monitor.main([str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_run_monitor_follow_live(tmp_path):
+    """--follow tails a growing stream and exits 0 once the summary
+    record lands — the 'live' half of the acceptance criterion."""
+    path = str(tmp_path / "live.health.jsonl")
+
+    def writer():
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"kind": "start", "t": 0.0,
+                                 "schema": HEALTH_SCHEMA,
+                                 "num_iterations": 3}) + "\n")
+            fh.flush()
+            for i in range(3):
+                time.sleep(0.15)
+                fh.write(json.dumps(
+                    {"kind": "iter", "iter": i, "t": 0.1 * (i + 1),
+                     "chunk": 1}) + "\n")
+                fh.flush()
+            fh.write(json.dumps({"kind": "summary", "records": 5,
+                                 "iterations": 3, "aborted": False,
+                                 "t": 1.0}) + "\n")
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        rc = run_monitor.follow(path, interval=0.05, timeout=30,
+                                out=open(os.devnull, "w"))
+    finally:
+        t.join()
+    assert rc == 0
+    state = run_monitor.StreamState()
+    with open(path, "rb") as fh:
+        state.feed(fh.read())
+    assert len(state.iters) == 3 and state.summary is not None
+
+
+def test_trace_report_health_digest(tmp_path, rng):
+    bst, _, path = _train_stream(tmp_path, rng, chunk=2)
+    text = trace_report.summarize(bst.get_stats())
+    assert f"health: 8 records -> {path}" in text
+    assert "last iter 5" in text
+    assert "health: n/a" in trace_report.summarize({"version": 2})
+
+
+def test_bench_gate_verdicts(tmp_path):
+    hist = [{"config": "c", "value": 10.0, "unit": "s",
+             "quality_ok": True, "peak_hbm_bytes": 1000}
+            for _ in range(4)]
+    ok = dict(hist[0], value=10.5)
+    bad_wall = dict(hist[0], value=20.0)
+    bad_hbm = dict(hist[0], peak_hbm_bytes=9000)
+    bad_quality = dict(hist[0], quality_ok=False)
+    assert not bench_gate.evaluate(hist + [ok])[0]
+    assert bench_gate.evaluate(hist + [bad_wall])[0]
+    assert bench_gate.evaluate(hist + [bad_hbm])[0]
+    assert bench_gate.evaluate(hist + [bad_quality])[0]
+    # empty / first-record / null-field trajectories pass with a notice
+    failures, notes = bench_gate.evaluate([])
+    assert not failures and any("no history" in n for n in notes)
+    assert not bench_gate.evaluate([ok])[0]
+
+    path = tmp_path / "traj.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n"
+                            for r in hist + [bad_wall]))
+    assert bench_gate.gate(str(path), out=open(os.devnull, "w")) == 1
+    path.write_text("".join(json.dumps(r) + "\n" for r in hist + [ok]))
+    assert bench_gate.gate(str(path), out=open(os.devnull, "w")) == 0
+    assert bench_gate.gate(str(tmp_path / "absent.jsonl"),
+                           out=open(os.devnull, "w")) == 0
+
+
+def test_bench_gate_self_test_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+         "--self-test"], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout

@@ -1,0 +1,194 @@
+"""Perf-regression sentinel over BENCH_TRAJECTORY.jsonl.
+
+BENCH_TRAJECTORY.jsonl (appended by bench_suite.py / bench.py rounds)
+is the machine-readable perf trajectory across PRs: one digest line per
+run with wall value, peak HBM, quality gate and FLOP estimates.  This
+tool turns the trailing history into a GATE instead of a log: for each
+config, the newest record is compared against the median of the
+previous ``--window`` records, and the gate fails (exit 1) when
+
+  * wall time regresses more than ``--wall-tol`` (default +15%),
+  * peak HBM regresses more than ``--hbm-tol`` (default +20%), or
+  * the quality gate flips from held to failed.
+
+A missing/empty trajectory, a config with no prior history, or records
+without comparable fields all PASS with a "no history" notice — the
+gate never blocks the first benchmark of a new config.
+
+Usage:
+  python tools/bench_gate.py                     # repo trajectory
+  python tools/bench_gate.py --path X.jsonl --window 8 --wall-tol 0.10
+  python tools/bench_gate.py --self-test         # fast CI smoke
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATH = os.path.join(REPO, "BENCH_TRAJECTORY.jsonl")
+
+
+def load(path):
+    """Trajectory records, oldest first.  Null-tolerant: a missing or
+    empty file is just an empty history; torn lines are skipped."""
+    records = []
+    if not os.path.exists(path):
+        return records
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def _median(values):
+    vals = sorted(values)
+    n = len(vals)
+    if n == 0:
+        return None
+    mid = n // 2
+    return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def _config_of(rec):
+    return rec.get("config") or rec.get("metric") or "?"
+
+
+def evaluate(records, window=5, wall_tol=0.15, hbm_tol=0.20):
+    """(failures, notes) over the trajectory.  The newest record of each
+    config is judged against the median of up to ``window`` prior
+    records of the same config; everything older informs, never gates."""
+    failures, notes = [], []
+    if not records:
+        notes.append("no history: trajectory is empty or absent — pass")
+        return failures, notes
+    by_config = {}
+    for rec in records:
+        by_config.setdefault(_config_of(rec), []).append(rec)
+    for config, recs in sorted(by_config.items()):
+        newest, history = recs[-1], recs[:-1][-window:]
+        if not history:
+            notes.append(f"{config}: no history (first record) — pass")
+            continue
+        # quality flip: regressing from held quality is a failure even
+        # when the timing looks fine
+        held_before = any(r.get("quality_ok") for r in history)
+        if held_before and newest.get("quality_ok") is False:
+            failures.append(f"{config}: quality gate flipped to FAILED "
+                            f"(held in trailing history)")
+        value = newest.get("value")
+        base_vals = [r["value"] for r in history
+                     if isinstance(r.get("value"), (int, float))
+                     and r["value"] > 0
+                     and r.get("unit") == newest.get("unit")]
+        base = _median(base_vals)
+        if (isinstance(value, (int, float)) and value > 0
+                and base is not None):
+            ratio = value / base
+            line = (f"{config}: {newest.get('metric', 'value')} "
+                    f"{value:g}{newest.get('unit', '')} vs median "
+                    f"{base:g} ({ratio - 1.0:+.1%})")
+            if ratio > 1.0 + wall_tol:
+                failures.append(f"{config}: wall {value:g}"
+                                f"{newest.get('unit', '')} regressed "
+                                f"{ratio - 1.0:+.1%} over median "
+                                f"{base:g} (tol +{wall_tol:.0%})")
+            else:
+                notes.append(line + " — ok")
+        else:
+            notes.append(f"{config}: no comparable wall history — pass")
+        hbm = newest.get("peak_hbm_bytes")
+        hbm_base = _median([r["peak_hbm_bytes"] for r in history
+                            if isinstance(r.get("peak_hbm_bytes"),
+                                          (int, float))
+                            and r["peak_hbm_bytes"] > 0])
+        if (isinstance(hbm, (int, float)) and hbm > 0
+                and hbm_base is not None):
+            if hbm / hbm_base > 1.0 + hbm_tol:
+                failures.append(
+                    f"{config}: peak HBM {hbm:.0f}B regressed "
+                    f"{hbm / hbm_base - 1.0:+.1%} over median "
+                    f"{hbm_base:.0f}B (tol +{hbm_tol:.0%})")
+    return failures, notes
+
+
+def gate(path, window=5, wall_tol=0.15, hbm_tol=0.20, out=sys.stdout):
+    failures, notes = evaluate(load(path), window, wall_tol, hbm_tol)
+    for note in notes:
+        out.write(f"bench_gate: {note}\n")
+    for failure in failures:
+        out.write(f"bench_gate: FAIL {failure}\n")
+    out.write(f"bench_gate: {'FAIL' if failures else 'PASS'} "
+              f"({len(failures)} regression(s), {path})\n")
+    return 1 if failures else 0
+
+
+def self_test():
+    """Fast smoke of the gate logic (no files, no history mutation)."""
+    hist = [{"config": "c", "value": 10.0 + 0.1 * i, "unit": "s",
+             "quality_ok": True, "peak_hbm_bytes": 1000}
+            for i in range(4)]
+
+    def verdict(newest):
+        failures, _ = evaluate(hist + [newest])
+        return bool(failures)
+
+    checks = [
+        ("empty history passes", evaluate([]) == ([], [
+            "no history: trajectory is empty or absent — pass"])),
+        ("first record passes",
+         not evaluate([{"config": "new", "value": 1.0, "unit": "s"}])[0]),
+        ("steady wall passes", not verdict(
+            {"config": "c", "value": 10.2, "unit": "s",
+             "quality_ok": True, "peak_hbm_bytes": 1000})),
+        ("wall regression fails", verdict(
+            {"config": "c", "value": 20.0, "unit": "s",
+             "quality_ok": True, "peak_hbm_bytes": 1000})),
+        ("hbm regression fails", verdict(
+            {"config": "c", "value": 10.2, "unit": "s",
+             "quality_ok": True, "peak_hbm_bytes": 5000})),
+        ("quality flip fails", verdict(
+            {"config": "c", "value": 10.2, "unit": "s",
+             "quality_ok": False, "peak_hbm_bytes": 1000})),
+        ("null fields pass", not verdict(
+            {"config": "c", "value": None, "unit": "s",
+             "quality_ok": True, "peak_hbm_bytes": None})),
+    ]
+    bad = [name for name, ok in checks if not ok]
+    for name, ok in checks:
+        print(f"bench_gate self-test: {'ok' if ok else 'FAIL'} {name}")
+    print(f"bench_gate self-test: {'FAIL' if bad else 'PASS'}")
+    return 1 if bad else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fail on wall/HBM/quality regressions in the newest "
+                    "BENCH_TRAJECTORY.jsonl records")
+    ap.add_argument("--path", default=DEFAULT_PATH)
+    ap.add_argument("--window", type=int, default=5,
+                    help="trailing records per config forming the "
+                         "baseline median (default 5)")
+    ap.add_argument("--wall-tol", type=float, default=0.15,
+                    help="allowed wall-time regression (default 0.15)")
+    ap.add_argument("--hbm-tol", type=float, default=0.20,
+                    help="allowed peak-HBM regression (default 0.20)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the built-in smoke checks and exit")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    return gate(args.path, args.window, args.wall_tol, args.hbm_tol)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

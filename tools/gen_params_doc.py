@@ -88,9 +88,18 @@ def generate() -> str:
         "  a set `LIGHTGBM_TPU_TRACE_JSON=<path>` forces level >= 2 and",
         "  writes the trace there.",
         "- `metrics_out` — CLI training only: write the versioned",
-        "  telemetry JSON blob (schema `lightgbm_tpu.metrics/v2`) to this",
+        "  telemetry JSON blob (schema `lightgbm_tpu.metrics/v3`) to this",
         "  path after training.  Written even when training crashes, so",
         "  the blob's `faults` section survives for post-mortems.",
+        "- `health_out` — stream the run-health JSONL there during",
+        "  training (schema `lightgbm_tpu.health/v1`): per-iteration",
+        "  gradient/hessian stats, tree shape, chunk size, HBM, eval/",
+        "  snapshot/fault events.  Works from every entry point (CLI,",
+        "  `engine.train`, sklearn); the `LIGHTGBM_TPU_HEALTH_JSONL` env",
+        "  var overrides.  On `resume=true` the existing stream is",
+        "  compacted past the snapshot iteration and appended to, giving",
+        "  one contiguous stream.  Tail it with `tools/run_monitor.py`",
+        "  (see docs/OBSERVABILITY.md).",
         "- `check_nonfinite` — finiteness guardrail on the boosted score",
         "  buffer (default `true`): a NaN/Inf iteration (diverged",
         "  objective, bad learning rate) is rolled back to the last good",

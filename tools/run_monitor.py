@@ -1,0 +1,235 @@
+"""Live/post-hoc terminal summary of a run-health JSONL stream.
+
+The stream is the append-only file a training run writes for
+``health_out=`` / ``LIGHTGBM_TPU_HEALTH_JSONL`` (see
+lightgbm_tpu/utils/telemetry.py, schema ``lightgbm_tpu.health/v1``):
+``start``/``resume``, per-iteration ``iter`` records (chunk size, tree
+shape, grad/hess stats, HBM), ``eval`` metric records, ``snapshot`` and
+``fault`` events, and a closing ``summary``.
+
+One-shot mode renders the stream as it stands — running OR finished.
+``--follow`` tails the file (byte-offset incremental reads, so a
+multi-hour stream is not re-parsed every tick), re-rendering every
+``--interval`` seconds until the ``summary`` record lands (exit 0) or
+``--timeout`` seconds pass without one (exit 3).
+
+Usage:
+  python tools/run_monitor.py run.health.jsonl
+  python tools/run_monitor.py run.health.jsonl --follow --interval 2
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+class StreamState:
+    """Folded view of a health stream; feed() accepts raw JSONL bytes
+    incrementally and tolerates a torn trailing line (kept in the tail
+    buffer until its newline arrives)."""
+
+    def __init__(self):
+        self.start = None
+        self.resumes = []
+        self.iters = {}                 # iter -> last record wins
+        self.evals = {}                 # iter -> last record wins
+        self.snapshots = []
+        self.faults = []
+        self.summary = None
+        self.records = 0
+        self._tail = b""
+
+    def feed(self, data: bytes) -> None:
+        buf = self._tail + data
+        lines = buf.split(b"\n")
+        self._tail = lines.pop()        # b"" when data ended in newline
+        for raw in lines:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            self.records += 1
+            kind = rec.get("kind")
+            if kind == "start":
+                self.start = rec
+            elif kind == "resume":
+                self.resumes.append(rec)
+            elif kind == "iter":
+                self.iters[int(rec.get("iter", -1))] = rec
+            elif kind == "eval":
+                self.evals[int(rec.get("iter", -1))] = rec
+            elif kind == "snapshot":
+                self.snapshots.append(rec)
+            elif kind == "fault":
+                self.faults.append(rec)
+            elif kind == "summary":
+                self.summary = rec
+
+
+def _fmt_bytes(n):
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GB"
+
+
+def render(state: StreamState, path: str) -> str:
+    lines = []
+    if state.summary is not None:
+        status = "aborted" if state.summary.get("aborted") else "finished"
+    elif state.start is not None or state.iters:
+        status = "running"
+    else:
+        status = "empty"
+    schema = (state.start or {}).get("schema", "?")
+    lines.append(f"run-health {os.path.basename(path)} [{status}] "
+                 f"schema={schema} records={state.records}")
+
+    total = (state.start or {}).get("num_iterations")
+    if state.iters:
+        done = max(state.iters) + 1
+        first, last = min(state.iters), max(state.iters)
+        progress = f"progress: {done}"
+        if total:
+            progress += f"/{int(total)} ({100.0 * done / total:.0f}%)"
+        progress += " iterations"
+        t0 = state.iters[first].get("t")
+        t1 = state.iters[last].get("t")
+        if (t0 is not None and t1 is not None and last > first
+                and t1 > t0):
+            rate = (last - first) / (t1 - t0)
+            progress += f", {rate:.2f} it/s in the stream window"
+        chunk = state.iters[last].get("chunk")
+        if chunk:
+            progress += f", chunk={chunk}"
+        lines.append("  " + progress)
+        rec = state.iters[last]
+        trees = rec.get("trees") or []
+        if trees:
+            leaves = [t.get("leaves", 0) for t in trees]
+            depth = max(t.get("depth", 0) for t in trees)
+            gain = sum(t.get("gain_sum", 0.0) for t in trees)
+            lines.append(f"  trees@{last}: {len(trees)} tree(s), "
+                         f"leaves={leaves} depth<={depth} "
+                         f"gain_sum={gain:g}")
+        grad, hess = rec.get("grad"), rec.get("hess")
+        if grad:
+            nf = sum(grad.get("nonfinite", [])) + \
+                sum((hess or {}).get("nonfinite", []))
+            lines.append(
+                f"  grad@{last}: min={min(grad['min']):g} "
+                f"max={max(grad['max']):g} l2={max(grad['l2']):g}"
+                + (f"  !! nonfinite={nf}" if nf else ""))
+        total_nf = 0
+        for r in state.iters.values():
+            for sec in ("grad", "hess"):
+                total_nf += sum((r.get(sec) or {}).get("nonfinite", []))
+        if total_nf:
+            lines.append(f"  NONFINITE: {total_nf} values across the "
+                         f"run — check learning_rate/objective")
+        hbm = rec.get("hbm")
+        if hbm:
+            lines.append(f"  hbm: {_fmt_bytes(hbm.get('bytes_in_use', 0))}"
+                         f" in use, peak "
+                         f"{_fmt_bytes(hbm.get('peak_bytes_in_use', 0))}")
+    else:
+        lines.append("  progress: no iteration records yet")
+
+    if state.evals:
+        it = max(state.evals)
+        metrics = state.evals[it].get("metrics") or {}
+        parts = [f"{k}={v:g}" for k, v in sorted(metrics.items())]
+        lines.append(f"  eval@{it}: " + " ".join(parts))
+    if state.resumes:
+        its = [r.get("iter") for r in state.resumes]
+        lines.append(f"  resumed {len(state.resumes)}x (at iteration(s) "
+                     f"{its}) — stream is contiguous across kills")
+    if state.snapshots:
+        lines.append(f"  snapshots: {len(state.snapshots)}, newest at "
+                     f"iteration {state.snapshots[-1].get('iter')}")
+    if state.faults:
+        kinds = {}
+        for f in state.faults:
+            kinds[f.get("fault", "?")] = kinds.get(f.get("fault", "?"),
+                                                   0) + 1
+        parts = [f"{k}={v}" for k, v in sorted(kinds.items())]
+        lines.append("  faults: " + " ".join(parts))
+    if state.summary is not None:
+        s = state.summary
+        lines.append(f"  summary: {s.get('records', '?')} records, "
+                     f"{s.get('iterations', '?')} iterations, "
+                     f"aborted={bool(s.get('aborted'))}")
+    return "\n".join(lines)
+
+
+def follow(path, interval, timeout, out=sys.stdout):
+    """Tail the stream until its summary record lands.  Returns 0 on a
+    completed stream, 2 when the file never appears, 3 on timeout."""
+    state = StreamState()
+    offset = 0
+    deadline = time.monotonic() + timeout if timeout > 0 else None
+    waited_for_file = False
+    while True:
+        if os.path.exists(path):
+            size = os.path.getsize(path)
+            if size < offset:            # truncated (fresh run): restart
+                state, offset = StreamState(), 0
+            if size > offset:
+                with open(path, "rb") as fh:
+                    fh.seek(offset)
+                    data = fh.read()
+                offset += len(data)
+                state.feed(data)
+                out.write(render(state, path) + "\n")
+                out.flush()
+        else:
+            waited_for_file = True
+        if state.summary is not None:
+            return 0
+        if deadline is not None and time.monotonic() >= deadline:
+            if waited_for_file and state.records == 0:
+                out.write(f"run_monitor: {path} never appeared\n")
+                return 2
+            out.write("run_monitor: timeout waiting for the summary "
+                      "record (run still alive?)\n")
+            return 3
+        time.sleep(interval)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="summarize a lightgbm_tpu run-health JSONL stream, "
+                    "live or post-hoc")
+    ap.add_argument("path")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep tailing until the summary record lands")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll period in seconds (default 2)")
+    ap.add_argument("--timeout", type=float, default=0.0,
+                    help="--follow gives up after this many seconds "
+                         "(0 = wait forever)")
+    args = ap.parse_args(argv)
+    if args.follow:
+        return follow(args.path, max(0.05, args.interval), args.timeout)
+    if not os.path.exists(args.path):
+        print(f"run_monitor: no such stream: {args.path}")
+        return 2
+    state = StreamState()
+    with open(args.path, "rb") as fh:
+        state.feed(fh.read())
+    print(render(state, args.path))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:     # e.g. piped into head
+        sys.exit(0)

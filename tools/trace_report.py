@@ -3,9 +3,9 @@
 Input: a metrics dict as produced by ``TELEMETRY.metrics_blob()`` /
 ``Booster.get_stats()`` — the blob the CLI writes for ``metrics_out=``,
 ``bench.py`` / ``bench_suite.py`` embed under ``"metrics"``, and
-``engine.train`` attaches as ``booster.train_stats``.  Both the current
-``lightgbm_tpu.metrics/v2`` schema and older v1 blobs are accepted:
-every section is optional and renders as ``n/a`` when absent.
+``engine.train`` attaches as ``booster.train_stats``.  The current
+``lightgbm_tpu.metrics/v3`` schema and the older v2/v1 blobs are all
+accepted: every section is optional and renders as ``n/a`` when absent.
 
 Usage:
   python tools/trace_report.py metrics.json          # a raw blob
@@ -15,9 +15,10 @@ Usage:
                                                      # memory/cost deltas
 
 Prints top phases, transfer bytes, compile counters/seconds, network
-collective counters, the iteration count, and (v2) the HBM memory
-envelope and XLA cost-analysis utilization digest — the digest VERDICT /
-PERF_NOTES rounds quote instead of regex-parsing stderr tails.
+collective counters, the iteration count, (v2) the HBM memory envelope
+and XLA cost-analysis utilization digest, and (v3) the run-health
+stream digest — the digest VERDICT / PERF_NOTES rounds quote instead of
+regex-parsing stderr tails.
 """
 
 import json
@@ -117,6 +118,7 @@ def summarize(stats: dict, top: int = 6) -> str:
     lines.extend(_cost_lines(stats))
     lines.extend(_utilization_lines(stats))
     lines.extend(_fault_lines(stats))
+    lines.extend(_health_lines(stats))
     return "\n".join(lines)
 
 
@@ -187,6 +189,29 @@ def _fault_lines(stats: dict, top: int = 8) -> list:
         if ev.get("detail"):
             desc += f" ({ev['detail']})"
         out.append(f"    t={ev.get('t', 0.0):.3f}s {desc}")
+    return out
+
+
+def _health_lines(stats: dict) -> list:
+    health = stats.get("health")
+    if not health:
+        return ["  health: n/a (no health_out stream this run, "
+                "or pre-v3 blob)"]
+    by_kind = health.get("by_kind") or {}
+    parts = [f"{k}={int(v)}" for k, v in sorted(by_kind.items())]
+    line = (f"  health: {int(health.get('records', 0))} records -> "
+            f"{health.get('path', '?')}"
+            + (f" [{' '.join(parts)}]" if parts else ""))
+    last = health.get("last_iter")
+    if isinstance(last, dict) and last.get("iter") is not None:
+        line += f", last iter {int(last['iter'])}"
+        if last.get("chunk"):
+            line += f" (chunk={int(last['chunk'])})"
+    nonfinite = health.get("nonfinite_total")
+    out = [line]
+    if nonfinite:
+        out.append(f"  health ALERT: {int(nonfinite)} non-finite "
+                   f"gradient/hessian values recorded")
     return out
 
 
