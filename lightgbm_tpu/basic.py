@@ -431,6 +431,24 @@ class Booster:
         configuration needs per-iteration host work."""
         return self.gbdt.train_chunk(int(chunk))
 
+    def setup_inscan_eval(self, include_train: bool = False):
+        """Attach the device-side in-scan eval program (metric/device.py)
+        so chunked updates score the valid sets and compute the attached
+        metrics per iteration on-device.  Returns None on success or a
+        short blocker string when a metric/objective isn't
+        device-computable."""
+        return self.gbdt.setup_inscan_eval(include_train)
+
+    def take_inscan_evals(self) -> List:
+        """Pop [(iteration, metric_row)] produced by in-scan eval since
+        the last call (rows appear as their chunks materialize)."""
+        return self.gbdt.take_inscan_evals()
+
+    def inscan_result_list(self, vals) -> List:
+        """One in-scan metric row -> [(set, metric, value, higher_better)],
+        the eval_train/eval_valid result shape."""
+        return self.gbdt.inscan_result_list(vals)
+
     def get_stats(self) -> Dict:
         """Training telemetry snapshot (utils/telemetry.py): phase
         seconds, transfer/compile/network counters, gauges and the

@@ -96,6 +96,11 @@ class Application:
 
     def train(self) -> None:
         cfg = self.config
+        # compile_cache= knob: persistent XLA compilation cache, enabled
+        # before the first traced computation so every compile of this
+        # run can hit (or seed) the on-disk cache
+        from .utils import maybe_enable_compile_cache
+        maybe_enable_compile_cache(cfg)
         train, valids, names = self._load_data()
         if cfg.save_binary:
             train.save_binary(cfg.data + ".bin")
@@ -140,15 +145,37 @@ class Application:
 
         log_info(f"Started training for {cfg.num_iterations} iterations")
         start = time.perf_counter()
-        # Chunked stepping (tpu_boost_chunk): the step is clamped so it
-        # never crosses a metric/snapshot boundary — chunk-granularity
-        # reporting keeps exactly the per-iteration schedule.
-        chunk = booster.boost_chunk_size()
-        freqs = [f for f in ((cfg.metric_freq if metric_names else 0),
-                             cfg.snapshot_freq) if f > 0]
         from .utils.faults import FAULTS
         from .utils.phase import profile_session
         from .utils.telemetry import TELEMETRY
+        # Chunked stepping (tpu_boost_chunk): when the attached metrics
+        # are device-computable, the in-scan eval path evaluates them
+        # inside the chunk scan at unchanged per-iteration cadence; a
+        # host-only metric falls back to per-iteration stepping (blocker
+        # named in the boost/inscan_blocked[...] gauge).  Off the in-scan
+        # path the step is clamped so it never crosses a metric boundary
+        # — chunk-granularity reporting keeps exactly the per-iteration
+        # schedule.  Snapshot boundaries always clamp.
+        chunk = booster.boost_chunk_size()
+        use_inscan = False
+        has_eval = bool(metric_names) and cfg.metric_freq > 0 and (
+            bool(names) or cfg.is_provide_training_metric)
+        explicit = int(cfg.tpu_boost_chunk) != 0
+        if has_eval and (chunk > 1 or explicit):
+            blocker = booster.setup_inscan_eval(
+                cfg.is_provide_training_metric)
+            if blocker is None:
+                use_inscan = True
+            else:
+                TELEMETRY.gauge_set(f"boost/inscan_blocked[{blocker}]", 1)
+                chunk = 1
+        # the reference CLI reports valid sets positionally
+        vlabel = {"training": "training"}
+        for _vi, _vname in enumerate(names):
+            vlabel[_vname] = f"valid_{_vi + 1}"
+        freqs = [f for f in (
+            (cfg.metric_freq if metric_names and not use_inscan else 0),
+            cfg.snapshot_freq) if f > 0]
         # a preempted job (SIGTERM from the scheduler, ctrl-C) must still
         # report: raise SystemExit so the salvage/metrics/trace/health
         # flushes in the finally below run before the process dies.
@@ -174,11 +201,29 @@ class Application:
                     step = min(chunk, cfg.num_iterations - done)
                     for f in freqs:
                         step = min(step, f - done % f)
-                    stop = (booster.train_chunk(step) if step > 1
+                    stop = (booster.train_chunk(step)
+                            if (step > 1 or use_inscan)
                             else booster.train_one_iter())
                     it = done + step - 1
                     done += step
-                    if (cfg.metric_freq > 0
+                    if use_inscan:
+                        # replay the chunk's per-iteration metric rows at
+                        # the metric_freq cadence points
+                        for j, vals in booster.take_inscan_evals():
+                            if (j + 1) % cfg.metric_freq != 0:
+                                continue
+                            eval_rec = {}
+                            for sname, mname, val, _hb in (
+                                    booster.inscan_result_list(vals)):
+                                label = vlabel.get(sname, sname)
+                                log_info(f"Iteration:{j + 1}, {label} "
+                                         f"{mname} : {val:g}")
+                                eval_rec[f"{label}/{mname}"] = float(val)
+                            if eval_rec and HEALTH.active:
+                                HEALTH.record("eval", {"iter": int(j),
+                                                       "in_scan": True,
+                                                       "metrics": eval_rec})
+                    elif (cfg.metric_freq > 0
                             and (it + 1) % cfg.metric_freq == 0
                             and metric_names):
                         eval_rec = {}
@@ -196,6 +241,7 @@ class Application:
                                     float(val)
                         if eval_rec and HEALTH.active:
                             HEALTH.record("eval", {"iter": int(it),
+                                                   "in_scan": False,
                                                    "metrics": eval_rec})
                     if (cfg.snapshot_freq > 0
                             and (it + 1) % cfg.snapshot_freq == 0):

@@ -187,6 +187,11 @@ _PARAMS: Dict[str, _P] = {
     # 1 disables chunking.  Auto-clamps to 1 when the iteration needs host
     # interaction (bagging re-draws, feature_fraction sampling, DART/RF
     # tree mutation, CEGB state, custom gradients, per-iter callbacks).
+    # Attached valid sets no longer force the clamp: when every attached
+    # metric is device-computable, the in-scan eval path (metric/device.py)
+    # scores and evaluates them inside the scan at unchanged per-iteration
+    # cadence; a custom feval or host-only metric still falls back to 1
+    # (blocker named in the boost/inscan_blocked[...] telemetry gauge).
     "tpu_boost_chunk": _P(0, ["boost_chunk"]),
     "tpu_double_precision": _P(False),     # accumulate histograms in f64-equivalent
     # telemetry (utils/telemetry.py): 0 = off, 1 = counters/gauges/
@@ -203,6 +208,12 @@ _PARAMS: Dict[str, _P] = {
     # run compacts past the snapshot iteration and keeps appending.
     # Env LIGHTGBM_TPU_HEALTH_JSONL wins; "" = no stream
     "health_out": _P(""),
+    # persistent on-disk XLA compilation cache so a restarted/resumed run
+    # warm-starts its compiles: "" (default) = off, "1"/"true"/"on"/
+    # "default" = on at <repo>/.jax_cache, any other string = cache
+    # directory path.  Hits/misses surface as compile/cache_hits|misses
+    # telemetry counters
+    "compile_cache": _P(""),
     # -- robustness (utils/faults.py, docs/ROBUSTNESS.md) --
     # blocking finiteness check on the boosted scores at chunk
     # boundaries (and per-iteration when chunking is off): a NaN/Inf
@@ -225,7 +236,8 @@ _PARAMS: Dict[str, _P] = {
 # section: they describe how THIS process ran, not what was learned, and
 # including them would make a resumed run's model differ byte-wise from
 # an uninterrupted one
-RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection"])
+RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection",
+                                 "compile_cache"])
 
 # alias -> canonical name
 ALIAS_TABLE: Dict[str, str] = {}

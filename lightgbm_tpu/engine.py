@@ -98,30 +98,47 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     train_in_valid = getattr(booster, "_train_in_valid", False)
 
-    # Chunked boosting: several iterations per device dispatch, with eval,
-    # early stopping, and after-callbacks firing at CHUNK boundaries
-    # (env.iteration = the chunk's last iteration).  An explicit
-    # tpu_boost_chunk > 1 opts eval into that granularity; the auto
-    # setting (0) never changes a run's eval/callback cadence.  Any
-    # before-iteration callback (e.g. reset_parameter) or custom fobj
-    # interacts with the host every round and forces per-iteration
-    # stepping; bagging/DART/GOSS clamps live in GBDT.boost_chunk_size.
-    chunk = booster.gbdt.boost_chunk_size()
-    if chunk > 1:
-        has_eval = bool(booster._valid_names or train_in_valid)
-        user_after = [cb for cb in user_callbacks
-                      if not getattr(cb, "before_iteration", False)]
-        if callbacks_before or fobj is not None:
-            chunk = 1
-        elif (int(booster.gbdt.config.tpu_boost_chunk) == 0
-              and (has_eval or user_after)):
-            chunk = 1
-
     # the profiler window is exception-safe (utils/phase.profile_session):
     # a callback or device error mid-training must not leak an open jax
     # profiler trace session
+    from .utils import maybe_enable_compile_cache
     from .utils.phase import profile_session
     from .utils.telemetry import HEALTH, TELEMETRY
+    # compile_cache= knob: persistent on-disk XLA compilation cache, so a
+    # restarted/resumed run warm-starts its compiles (hits/misses surface
+    # in the compile/cache_* telemetry counters)
+    maybe_enable_compile_cache(booster.gbdt.config)
+
+    # Chunked boosting: several iterations per device dispatch.  When
+    # valid sets are attached and every metric is device-computable, the
+    # in-scan eval path keeps the chunked dispatch: the scan body scores
+    # the valid sets and computes the metrics per iteration, and the
+    # loop below replays the per-iteration eval/callback/early-stopping
+    # cadence from the fetched [T, n_cols] matrix at chunk boundaries —
+    # bit-identical to per-iteration stepping.  A custom feval/fobj, a
+    # before-iteration callback (e.g. reset_parameter), or a
+    # host-computed metric forces per-iteration dispatch (the blocker is
+    # named in the boost/inscan_blocked[...] gauge);
+    # bagging/DART/GOSS clamps live in GBDT.boost_chunk_size.
+    chunk = booster.gbdt.boost_chunk_size()
+    use_inscan = False
+    has_eval = bool(booster._valid_names or train_in_valid)
+    user_after = [cb for cb in user_callbacks
+                  if not getattr(cb, "before_iteration", False)]
+    explicit = int(booster.gbdt.config.tpu_boost_chunk) != 0
+    if callbacks_before or fobj is not None:
+        chunk = 1
+    elif has_eval and (chunk > 1 or explicit):
+        blocker = ("feval" if feval is not None
+                   else booster.setup_inscan_eval(train_in_valid))
+        if blocker is None:
+            use_inscan = True
+        else:
+            TELEMETRY.gauge_set(f"boost/inscan_blocked[{blocker}]", 1)
+            chunk = 1
+    elif chunk > 1 and not explicit and user_after:
+        # auto chunking never changes a run's callback cadence
+        chunk = 1
     # streaming run-health layer (health_out= / LIGHTGBM_TPU_HEALTH_JSONL):
     # per-iteration and per-eval records appended while the loop runs, so
     # a long job is observable before its finally-flush
@@ -137,6 +154,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
     try:
         with profile_session(), TELEMETRY.memory_session():
             i = 0
+            # in-scan rows carry GBDT-global iteration indices; with an
+            # init_model those are offset from the engine's 0-based count
+            base_iter = (booster.gbdt.current_iteration()
+                         if use_inscan else 0)
             while i < num_boost_round:
                 step = min(chunk, num_boost_round - i)
                 for cb in callbacks_before:
@@ -144,11 +165,52 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         model=booster, params=params, iteration=i,
                         begin_iteration=0, end_iteration=num_boost_round,
                         evaluation_result_list=None))
-                if step > 1:
+                if step > 1 or use_inscan:
                     should_stop = booster.update_chunk(step)
                 else:
                     should_stop = booster.update(fobj=fobj)
                 it = i + step - 1
+
+                if use_inscan:
+                    # replay the chunk's per-iteration metric rows through
+                    # the normal callback cadence (print/record/early-stop
+                    # see exactly what per-iteration stepping shows them)
+                    stopped_early = False
+                    for j, vals in booster.take_inscan_evals():
+                        jr = int(j) - base_iter
+                        evaluation_result_list = (
+                            booster.inscan_result_list(vals))
+                        if HEALTH.active:
+                            HEALTH.record("eval", {
+                                "iter": jr, "in_scan": True,
+                                "metrics": {f"{dn}/{mn}": float(v)
+                                            for dn, mn, v, _ in
+                                            evaluation_result_list}})
+                        try:
+                            for cb in callbacks_after:
+                                cb(callback_mod.CallbackEnv(
+                                    model=booster, params=params,
+                                    iteration=jr, begin_iteration=0,
+                                    end_iteration=num_boost_round,
+                                    evaluation_result_list=(
+                                        evaluation_result_list)))
+                        except callback_mod.EarlyStopException as e:
+                            booster.best_iteration = e.best_iteration + 1
+                            for item in e.best_score:
+                                booster.best_score.setdefault(
+                                    item[0], {})[item[1]] = item[2]
+                            # the stop fired INSIDE the chunk: surplus
+                            # tail-of-chunk trees are discarded before
+                            # they become model state, so the final
+                            # model matches a per-iteration early stop
+                            while booster.gbdt.current_iteration() > j + 1:
+                                booster.gbdt.rollback_one_iter()
+                            stopped_early = True
+                            break
+                    if stopped_early or should_stop:
+                        break
+                    i += step
+                    continue
 
                 evaluation_result_list = []
                 if booster._valid_names or train_in_valid:
@@ -158,7 +220,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     evaluation_result_list.extend(booster.eval_valid(feval))
                 if evaluation_result_list and HEALTH.active:
                     HEALTH.record("eval", {
-                        "iter": int(it),
+                        "iter": int(it), "in_scan": False,
                         "metrics": {f"{dn}/{mn}": float(v)
                                     for dn, mn, v, _ in
                                     evaluation_result_list}})

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
+from ..core.binning import MISSING_NAN, MISSING_ZERO
 from ..core.dataset import TpuDataset
 from ..ops.split import FeatureMeta, SplitParams
 from ..utils.faults import FAULTS, InjectedFault, oom_error
@@ -40,11 +41,14 @@ from .tree import Tree
 class _PendingChunk(NamedTuple):
     """A chunk of ``length`` dispatched-but-unfetched iterations: the
     scan's stacked [T, C, len_ints]/[T, C, len_floats] device buffers,
-    materialized host-side in two transfers at the chunk boundary."""
+    materialized host-side in two transfers at the chunk boundary.
+    ``mvals`` is the in-scan evaluation's stacked [T, n_cols] metric
+    rows (None when no eval program rides the chunk)."""
     ints_all: jax.Array
     floats_all: jax.Array
     shrinkage: float
     length: int
+    mvals: Optional[jax.Array] = None
 
 
 def _maybe_print_seg_stats(stats) -> None:
@@ -210,6 +214,53 @@ def _grad_stats_core(grads, hesss):
 _grad_stats = cost_jit("health/grad_stats", jax.jit(_grad_stats_core))
 
 
+def _route_tree_rows(arrays, vbins, fmeta, depth_bound: int):
+    """Per-row leaf values of one freshly-grown device tree over a
+    row-major [Nv, G] binned matrix: the in-scan evaluation's valid-set
+    score update (pure jnp, traced inside the chunk scan body).
+
+    Same routing semantics as models/device_predict.route_one_tree, but
+    over the grower's TreeArrays fields (pre-packing, per-feature
+    missing types from fmeta instead of per-node decision bits) so the
+    scan never needs host Tree objects.  Single-leaf trees start
+    terminal at node -1 (= leaf ~(-1) = 0, whose value is 0), matching
+    the train-score update's unconditional add."""
+    sf, tb = arrays.split_feature, arrays.threshold_bin
+    dl, ic, cb = arrays.default_left, arrays.is_cat, arrays.cat_bitset
+    lc, rc = arrays.left_child, arrays.right_child
+    n = vbins.shape[0]
+
+    def step(_, node):
+        internal = node >= 0
+        safe = jnp.maximum(node, 0)
+        f = sf[safe]
+        col = f if fmeta.feat_group is None else fmeta.feat_group[f]
+        fv = jnp.take_along_axis(
+            vbins, col[:, None].astype(jnp.int32), axis=1)[:, 0] \
+            .astype(jnp.int32)
+        if fmeta.feat_group is not None:
+            off = fmeta.feat_offset[f]
+            in_range = (fv >= off) & (fv < off + fmeta.num_bin[f])
+            fv = jnp.where(in_range, fv - off, fmeta.default_bin[f])
+        mt = fmeta.missing_type[f]
+        is_missing = (((mt == MISSING_ZERO)
+                       & (fv == fmeta.default_bin[f]))
+                      | ((mt == MISSING_NAN)
+                         & (fv == fmeta.num_bin[f] - 1)))
+        num_left = jnp.where(is_missing, dl[safe], fv <= tb[safe])
+        word = cb[safe, jnp.clip(fv // 32, 0, 7)]
+        cat_left = ((word >> (fv % 32).astype(jnp.uint32)) & 1) > 0
+        go_left = jnp.where(ic[safe], cat_left, num_left)
+        nxt = jnp.where(go_left, lc[safe], rc[safe])
+        return jnp.where(internal, nxt, node)
+
+    start = jnp.where(arrays.num_leaves <= 1,
+                      jnp.full(n, -1, dtype=jnp.int32),
+                      jnp.zeros(n, dtype=jnp.int32))
+    node = jax.lax.fori_loop(0, depth_bound, step, start)
+    return arrays.leaf_value[jnp.maximum(~node, 0)]
+
+
 def _is_oom_error(e: BaseException) -> bool:
     """RESOURCE_EXHAUSTED-shaped device failures (real XlaRuntimeError
     allocation failures and injected chunk/oom faults) that the chunked
@@ -262,6 +313,7 @@ class GBDT:
         self.feature_names: List[str] = []
         self._grow_fn = None
         self.max_feature_idx = 0
+        self._inscan_evals: List[tuple] = []
         if train_set is not None:
             self.reset_train_data(train_set)
 
@@ -545,8 +597,14 @@ class GBDT:
         self._fused_fns = None
         self._fused_core = None
         self._obj_arrs = None
-        self._chunk_fns: Dict[int, object] = {}
+        self._chunk_fns: Dict[object, object] = {}
         self._shr_dev: Dict[float, jax.Array] = {}
+        # a data swap invalidates the in-scan eval program (labels, bin
+        # layout and metric bindings may all change); the engine/CLI
+        # attach a fresh one via setup_inscan_eval when eligible
+        self._inscan = None
+        self._vscores_dev = None
+        self._inscan_evals = []
         # OOM-degraded chunk-size ceiling (None = no ceiling): once a
         # chunk dispatch hits RESOURCE_EXHAUSTED the cap halves and
         # STICKS, so later chunks of the run skip the doomed sizes
@@ -592,6 +650,9 @@ class GBDT:
         score = self._replay_model_scores(valid_set)
         self.valid_sets.append((name, valid_set))
         self.valid_scores.append(score)
+        # the in-scan eval program binds the valid-set tuple at build time
+        self._inscan = None
+        self._vscores_dev = None
 
     # --------------------------------------------------------------- bagging
     def _bagging(self, iter_idx: int, grads, hesss):
@@ -682,6 +743,14 @@ class GBDT:
     # dispatch (tests install jax.transfer_guard("disallow") here to prove
     # the chunk body never touches the host)
     _chunk_guard = None
+    # in-scan evaluation (metric/device.py): the DeviceEval program the
+    # chunk scan body runs per iteration, and the device-resident [C, Nv]
+    # f32 valid-score carries it threads between dispatches.  None until
+    # setup_inscan_eval attaches one; _vscores_dev is re-uploaded from
+    # the host f64 buffers whenever it is invalidated (rollback, undo,
+    # OOM degrade, data swap)
+    _inscan = None
+    _vscores_dev = None
 
     def _build_fused_step(self):
         """One jitted call per (gradient pass, per-class tree).  Keeping the
@@ -782,8 +851,8 @@ class GBDT:
         else:
             use_score_kernel = False
 
-        def step_core(score, grads, hesss, member, bins, fmeta, fmask,
-                      sub, shrinkage, k, roots=None):
+        def step_core_full(score, grads, hesss, member, bins, fmeta, fmask,
+                           sub, shrinkage, k, roots=None):
             g_k, h_k = grads[k], hesss[k]
             if pad:
                 g_k = jnp.pad(g_k, (0, pad))
@@ -805,7 +874,12 @@ class GBDT:
                            + shrinkage * arrays.leaf_value[leaf_id])
             score = score.at[k].set(new_row)
             ints_d, floats_d = _pack_tree_device(arrays)
-            return score, ints_d, floats_d, tuple(stats)
+            # the raw TreeArrays ride along for the in-scan eval variant,
+            # which re-routes the valid sets through the freshly grown tree
+            return score, ints_d, floats_d, tuple(stats), arrays
+
+        def step_core(*a, **kw):
+            return step_core_full(*a, **kw)[:4]
 
         fused_step = cost_jit(
             "grow/fused_step",
@@ -815,22 +889,76 @@ class GBDT:
         # un-jitted building blocks; the chunked loop retraces them inside
         # its scan so a chunk body is op-for-op the per-iteration fused
         # path (bit-identical trees at any chunk size)
-        self._fused_core = (grad_core, step_core, roots_core)
+        self._fused_core = (grad_core, step_core, roots_core, step_core_full)
 
-    def _get_chunk_fn(self, T: int):
+    def _get_chunk_fn(self, T: int, with_eval: bool = False):
         """One jitted program running ``T`` boosting iterations as a
         lax.scan over the fused step, stacking each iteration's packed
         tree buffers into [T, C, ...] on-device outputs.  The score and
         PRNG-key carries are donated so no buffer copies accumulate
-        across chunks."""
-        fn = self._chunk_fns.get(T)
+        across chunks.
+
+        With ``with_eval`` the scan additionally threads the valid-set
+        score vectors through the carry, routes every freshly grown tree
+        over each valid set's binned matrix, and runs the attached
+        DeviceEval program per iteration — stacking a [T, n_cols] metric
+        matrix onto the chunk outputs so eval cadence costs zero extra
+        dispatches."""
+        cache_key = (T, "eval") if with_eval else T
+        fn = self._chunk_fns.get(cache_key)
         if fn is not None:
             return fn
         import functools
         if self._fused_core is None:
             self._build_fused_step()
-        grad_core, step_core, roots_core = self._fused_core
+        grad_core, step_core, roots_core, step_core_full = self._fused_core
         C = self.num_tree_per_iteration
+
+        if with_eval:
+            inscan = self._inscan
+            gp = self.grower_params
+            # static routing depth: max_depth when bounded, else the leaf
+            # count (a path can't be longer than num_leaves - 1 splits)
+            depth_bound = ((gp.max_depth + 1) if gp.max_depth > 0
+                           else gp.num_leaves)
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+            def chunk_run_eval(score, key, vscores, member, bins, fmeta,
+                               fmask, shrinkage, arrs, vbins, earrs):
+                def body(carry, _):
+                    score, key, vscores = carry
+                    grads, hesss = grad_core(score, arrs)
+                    gstats = _grad_stats_core(grads, hesss)
+                    roots = (roots_core(grads, hesss, member, bins)
+                             if roots_core is not None else None)
+                    ints_l, floats_l = [], []
+                    for k in range(C):
+                        key, sub = jax.random.split(key)
+                        score, ints_d, floats_d, _, arrays = step_core_full(
+                            score, grads, hesss, member, bins, fmeta,
+                            fmask, sub, shrinkage, jnp.int32(k), roots)
+                        ints_l.append(ints_d)
+                        floats_l.append(floats_d)
+                        vscores = [
+                            vs.at[k].add(shrinkage * _route_tree_rows(
+                                arrays, vb, fmeta, depth_bound))
+                            for vs, vb in zip(vscores, vbins)]
+                    mvals = inscan.eval_fn(score, vscores, earrs)
+                    return ((score, key, vscores),
+                            (jnp.stack(ints_l), jnp.stack(floats_l),
+                             gstats, mvals))
+
+                carry, (ints_all, floats_all, gstats_all, mvals_all) = \
+                    jax.lax.scan(body, (score, key, vscores), None,
+                                 length=T)
+                score, key, vscores = carry
+                return (score, key, vscores, ints_all, floats_all,
+                        gstats_all, mvals_all)
+
+            chunk_run_eval = cost_jit(f"boost/chunk_eval[{T}]",
+                                      chunk_run_eval)
+            self._chunk_fns[cache_key] = chunk_run_eval
+            return chunk_run_eval
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def chunk_run(score, key, member, bins, fmeta, fmask, shrinkage,
@@ -862,7 +990,7 @@ class GBDT:
             return score, key, ints_all, floats_all, gstats_all
 
         chunk_run = cost_jit(f"boost/chunk[{T}]", chunk_run)
-        self._chunk_fns[T] = chunk_run
+        self._chunk_fns[cache_key] = chunk_run
         return chunk_run
 
     @property
@@ -874,15 +1002,18 @@ class GBDT:
     def models(self, value) -> None:
         self._models = list(value)
         self._pending = []
+        self._vscores_dev = None
 
     def _entry_iter_arrays(self, entry):
         """Normalize one pending entry into per-iteration host pytrees:
-        [(iter_idx, [(TreeArrays, shrinkage)] * C, gstats, chunk_len)].
-        A chunk entry fetches its stacked [T, C, ...] buffers here — two
-        host transfers for the WHOLE chunk (the async copy started at
-        dispatch), then pure numpy slicing.  ``gstats`` is the [C, 8]
-        grad/hess diagnostics row for the health stream (None when no
-        stream is active — the device buffer is then never fetched)."""
+        [(iter_idx, [(TreeArrays, shrinkage)] * C, gstats, chunk_len,
+        mvals_row)].  A chunk entry fetches its stacked [T, C, ...]
+        buffers here — two host transfers for the WHOLE chunk (the async
+        copy started at dispatch), then pure numpy slicing.  ``gstats``
+        is the [C, 8] grad/hess diagnostics row for the health stream
+        (None when no stream is active — the device buffer is then never
+        fetched); ``mvals_row`` is the in-scan eval program's [n_cols]
+        metric row (None off the eval path)."""
         iter_idx, payload, gstats = entry
         L = self.grower_params.num_leaves
         fetch_stats = gstats is not None and HEALTH.active
@@ -890,10 +1021,13 @@ class GBDT:
             chunk = fetch_tree_chunk(payload.ints_all, payload.floats_all,
                                      L)
             gnp = np.asarray(gstats) if fetch_stats else None
+            mv = (np.asarray(payload.mvals)
+                  if payload.mvals is not None else None)
             return [(iter_idx + t,
                      [(arrays, payload.shrinkage) for arrays in per_class],
                      gnp[t] if gnp is not None else None,
-                     payload.length)
+                     payload.length,
+                     mv[t] if mv is not None else None)
                     for t, per_class in enumerate(chunk)]
         pairs = []
         for (ints_d, floats_d, lr) in payload:
@@ -904,7 +1038,7 @@ class GBDT:
                                   + int(floats_np.nbytes))
             pairs.append((unpack_tree_buffers(ints_np, floats_np, L), lr))
         return [(iter_idx, pairs,
-                 np.asarray(gstats) if fetch_stats else None, 1)]
+                 np.asarray(gstats) if fetch_stats else None, 1, None)]
 
     def _materialize_iter(self, pairs):
         """One iteration's [(TreeArrays, shrinkage)] -> (trees, all_const);
@@ -945,11 +1079,12 @@ class GBDT:
         """
         while len(self._pending) > keep_latest:
             per_iter = self._entry_iter_arrays(self._pending.pop(0))
-            for j, (iter_idx, pairs, gstats, clen) in enumerate(per_iter):
+            for j, (iter_idx, pairs, gstats, clen,
+                    mrow) in enumerate(per_iter):
                 trees, all_const = self._materialize_iter(pairs)
                 if all_const:
                     rest = [(ii, self._materialize_iter(pp)[0])
-                            for ii, pp, _g, _c in per_iter[j + 1:]]
+                            for ii, pp, _g, _c, _m in per_iter[j + 1:]]
                     self._undo_pending_scores([(iter_idx, trees)] + rest
                                               + self._materialize_rest())
                     self._pending = []
@@ -963,6 +1098,11 @@ class GBDT:
                 self._note_trees(trees)
                 self._apply_valid_scores(trees)
                 self._health_emit(iter_idx, trees, gstats, clen)
+                # in-scan eval rows surface only for materialized
+                # iterations: tail-of-chunk rows past an all-constant
+                # stop are discarded with their trees
+                if mrow is not None:
+                    self._inscan_evals.append((iter_idx, mrow))
 
     def _note_trees(self, trees) -> None:
         """Record which features the model has split on, feeding the next
@@ -984,7 +1124,8 @@ class GBDT:
     def _materialize_rest(self):
         out = []
         for entry in self._pending:
-            for iter_idx, pairs, _g, _c in self._entry_iter_arrays(entry):
+            for iter_idx, pairs, _g, _c, _m in self._entry_iter_arrays(
+                    entry):
                 out.append((iter_idx, self._materialize_iter(pairs)[0]))
         return out
 
@@ -1034,6 +1175,9 @@ class GBDT:
     def _undo_pending_scores(self, iter_trees) -> None:
         """Subtract discarded iterations' contributions from train_score
         (rare: only when stop is detected late under bagging randomness)."""
+        # the device valid-score carry already includes the discarded
+        # trees; drop it and re-upload from the host f64 truth next chunk
+        self._vscores_dev = None
         infos = self.train_set.feature_infos()
         for _, trees in iter_trees:
             for k, tree in enumerate(trees):
@@ -1374,7 +1518,7 @@ class GBDT:
         T = int(chunk)
         if self._stop_flag:
             return True
-        if (T <= 1 or not self._chunk_ok()
+        if ((T <= 1 and self._inscan is None) or not self._chunk_ok()
                 or self.train_set.num_used_features == 0):
             return self.train_one_iter()
         self._boost_from_average()
@@ -1384,7 +1528,7 @@ class GBDT:
                 return True
             cap = self._chunk_cap
             t = T - done if cap is None else min(T - done, cap)
-            if t <= 1:
+            if t <= 1 and self._inscan is None:
                 try:
                     # per-iteration fallback still probes the OOM site:
                     # a persistent allocator failure must reach the
@@ -1405,6 +1549,10 @@ class GBDT:
             except Exception as e:
                 if not _is_oom_error(e):
                     raise
+                if t <= 1:
+                    # in-scan runs keep the scan path even at chunk 1;
+                    # there is no smaller dispatch left to retry with
+                    raise self._oom_exhausted(e)
                 self._degrade_chunk(t, e)
                 continue                           # retry at the new cap
             done += t
@@ -1420,37 +1568,57 @@ class GBDT:
                     self._poison_scores()
                     break
             FAULTS.maybe_raise("chunk/oom", oom_error)
-        fn = self._get_chunk_fn(t)
+        inscan = self._inscan
+        fn = self._get_chunk_fn(t, with_eval=inscan is not None)
         shr = self._shr_dev.get(self.shrinkage_rate)
         if shr is None:
             # device-resident constant: materialized OUTSIDE the guarded
             # dispatch so the chunk body itself stays transfer-free
             shr = jnp.float32(self.shrinkage_rate)
             self._shr_dev[self.shrinkage_rate] = shr
+        if inscan is not None and self._vscores_dev is None:
+            # (re-)upload the valid-score carry from the host f64 truth;
+            # OUTSIDE the guarded region — this is a legitimate h2d copy
+            self._vscores_dev = [
+                jnp.asarray(np.asarray(vs, dtype=np.float32))
+                for vs in self.valid_scores]
         first_iter = self.iter_
-        args = (self.train_score, self._key, self.bag_weight, self.bins,
-                self.fmeta, self._full_fmask, shr, self._obj_arrs)
+        if inscan is not None:
+            args = (self.train_score, self._key, self._vscores_dev,
+                    self.bag_weight, self.bins, self.fmeta,
+                    self._full_fmask, shr, self._obj_arrs,
+                    inscan.vbins, inscan.arrays)
+        else:
+            args = (self.train_score, self._key, self.bag_weight,
+                    self.bins, self.fmeta, self._full_fmask, shr,
+                    self._obj_arrs)
+        mvals_all = None
         with _PHASES.phase("chunk") as box:
             if self._chunk_guard is not None:
                 with self._chunk_guard():
                     out = fn(*args)
             else:
                 out = fn(*args)
-            (self.train_score, self._key, ints_all, floats_all,
-             gstats_all) = out
+            if inscan is not None:
+                (self.train_score, self._key, self._vscores_dev, ints_all,
+                 floats_all, gstats_all, mvals_all) = out
+            else:
+                (self.train_score, self._key, ints_all, floats_all,
+                 gstats_all) = out
             box[0] = self.train_score
         # before the chunk's buffers can become trees: a non-finite score
         # discards them and raises (older pending chunks stay good)
         self._guard_chunk_nonfinite(first_iter, t)
-        self._start_host_copy(ints_all, floats_all, gstats_all)
+        self._start_host_copy(ints_all, floats_all, gstats_all, mvals_all)
         self._pending.append((self.iter_, _PendingChunk(
-            ints_all, floats_all, self.shrinkage_rate, t), gstats_all))
+            ints_all, floats_all, self.shrinkage_rate, t, mvals_all),
+            gstats_all))
         self.iter_ += t
         with _PHASES.phase("fetch"):
             # valid-set scores update at materialization, and eval at the
             # chunk boundary needs the chunk just dispatched — so forgo
             # the one-chunk-deep pipeline when valid sets are attached
-            keep = 0 if self.valid_sets else 1
+            keep = 0 if (self.valid_sets or inscan is not None) else 1
             self._flush_pending(keep_latest=keep)
         TELEMETRY.gauge_set("boost/chunk_size", t)
         TELEMETRY.mark_iteration(self.iter_ - 1, count=t)
@@ -1459,12 +1627,16 @@ class GBDT:
         """Halve the chunk-size ceiling after an OOM-shaped dispatch
         failure, or give up (with the HBM picture) when retry is
         impossible because the dispatch consumed its donated carries."""
-        for buf in (self.train_score, self._key):
+        for buf in ((self.train_score, self._key)
+                    + tuple(self._vscores_dev or ())):
             deleted = getattr(buf, "is_deleted", None)
             if deleted is not None and deleted():
-                # donate_argnums=(0, 1) handed the score/key buffers to
+                # donate_argnums handed the score/key/vscore buffers to
                 # the failed execution; there is no state left to retry
                 raise self._oom_exhausted(err)
+        # conservatively re-upload the valid-score carry: partial
+        # execution may have touched it even when not deleted
+        self._vscores_dev = None
         self._chunk_cap = max(1, t // 2)
         log_warning(f"chunk dispatch of {t} iterations failed with "
                     f"RESOURCE_EXHAUSTED; retrying at chunk size "
@@ -1516,6 +1688,8 @@ class GBDT:
                                                  self.valid_scores):
                     vscore[k] -= tree.predict_binned(vset.binned, infos)
         self.iter_ -= 1
+        # host f64 buffers are now the truth; the device carry is stale
+        self._vscores_dev = None
 
     # ------------------------------------------------------------ prediction
     def current_iteration(self) -> int:
@@ -1639,6 +1813,43 @@ class GBDT:
     def eval_valid(self, i: int) -> List[Tuple]:
         return self._eval_score(np.asarray(self.valid_scores[i]),
                                 self.valid_metrics[i])
+
+    # ------------------------------------------------------- in-scan eval
+    def setup_inscan_eval(self, include_train: bool = False):
+        """Try to attach a device-side eval program (metric/device.py) so
+        the chunked scan computes the attached metrics per iteration.
+        Returns None on success, or a short blocker string ("feval",
+        "metric:<name>", "objective:<name>", "not_chunk_capable", ...)
+        when the run must fall back to per-iteration host eval."""
+        self._inscan = None
+        self._vscores_dev = None
+        self._inscan_evals = []
+        # drop any stale eval-variant compilations (they close over the
+        # previous DeviceEval program)
+        self._chunk_fns = {k: v for k, v in self._chunk_fns.items()
+                           if not isinstance(k, tuple)}
+        if not self._chunk_ok():
+            return "not_chunk_capable"
+        from ..metric.device import build_device_eval
+        prog, blocker = build_device_eval(self, include_train)
+        if prog is None:
+            return blocker
+        self._inscan = prog
+        return None
+
+    def inscan_result_list(self, vals) -> List[Tuple]:
+        """One in-scan metric row -> the eval_train/eval_valid result
+        shape: [(set_name, metric_name, value, higher_better)]."""
+        return [(sname, mname, float(v), hb)
+                for (sname, mname, hb), v in zip(self._inscan.columns,
+                                                 vals)]
+
+    def take_inscan_evals(self) -> List[Tuple]:
+        """Pop the per-iteration metric rows materialized so far:
+        [(iter_idx, np.ndarray[n_cols])], oldest first."""
+        out = self._inscan_evals
+        self._inscan_evals = []
+        return out
 
     # ----------------------------------------------------------- importances
     def feature_importance(self, importance_type: str = "split",

@@ -3,7 +3,8 @@ from .log import (LightGBMError, Timer, check, log_debug, log_fatal, log_info,
 
 __all__ = ["LightGBMError", "Timer", "check", "log_debug", "log_fatal",
            "log_info", "log_warning", "register_log_callback",
-           "set_verbosity", "cpu_subprocess_env"]
+           "set_verbosity", "cpu_subprocess_env",
+           "enable_jax_compilation_cache", "maybe_enable_compile_cache"]
 
 
 def cpu_subprocess_env(n_virtual_devices: int = 0) -> dict:
@@ -30,20 +31,24 @@ def cpu_subprocess_env(n_virtual_devices: int = 0) -> dict:
     return env
 
 
-def enable_jax_compilation_cache(repo_root: str | None = None) -> None:
+def enable_jax_compilation_cache(repo_root: str | None = None,
+                                 cache_dir: str | None = None) -> None:
     """Persistent executable cache: the ~3min remote TPU compile amortizes
     across bench/probe runs instead of recurring (the driver's bench and
-    the perf tools share one cache under <repo>/.jax_cache)."""
+    the perf tools share one cache under <repo>/.jax_cache).  An explicit
+    ``cache_dir`` overrides the in-repo default (the CLI/engine
+    ``compile_cache=`` knob routes a path here)."""
     import os
 
     import jax
-    if repo_root is None:
-        # utils/ -> lightgbm_tpu/ -> repo root
-        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
+    if cache_dir is None:
+        if repo_root is None:
+            # utils/ -> lightgbm_tpu/ -> repo root
+            repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        cache_dir = os.path.join(repo_root, ".jax_cache")
     try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(repo_root, ".jax_cache"))
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache EVERY executable: the warmup budget is dominated by many
         # medium-size compiles (bucketed kernels, fused_step variants),
         # and the round-4 on-chip runs still paid ~200s warm — so no
@@ -51,5 +56,27 @@ def enable_jax_compilation_cache(repo_root: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # jax checks-and-latches cache usability at the FIRST compile of
+        # the process and initializes the cache at most once, so enabling
+        # (or re-pointing) it after any earlier compile would silently do
+        # nothing without a reset here
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
     except Exception:  # noqa: BLE001 — the cache is an optimization only
         pass
+
+
+def maybe_enable_compile_cache(config) -> None:
+    """Honor the ``compile_cache=`` config knob: off by default; a truthy
+    value ("1"/"true"/"on"/"default") turns on the persistent XLA
+    compilation cache at its in-repo default location, any other
+    non-empty string is taken as the cache directory.  Hits and misses
+    land in the compile/cache_hits|cache_misses telemetry counters (the
+    jax monitoring bridge already subscribes to them)."""
+    cc = str(getattr(config, "compile_cache", "") or "").strip()
+    if not cc or cc.lower() in ("0", "false", "off", "no"):
+        return
+    if cc.lower() in ("1", "true", "on", "yes", "default"):
+        enable_jax_compilation_cache()
+    else:
+        enable_jax_compilation_cache(cache_dir=cc)

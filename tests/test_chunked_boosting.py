@@ -81,7 +81,10 @@ def test_chunk_body_makes_no_transfers(rng):
     assert np.all(np.isfinite(pred))
 
 
-def test_chunk_eval_fires_at_chunk_boundaries(rng):
+def test_chunk_eval_keeps_per_iteration_cadence(rng):
+    # in-scan eval: an explicit chunk with a valid set attached keeps
+    # the chunked dispatch AND the per-iteration eval cadence — the scan
+    # body scores the valid set and computes l2 each iteration
     X, y = make_regression(rng)
     Xv, yv = make_regression(rng, n=200)
     ev = {}
@@ -89,17 +92,81 @@ def test_chunk_eval_fires_at_chunk_boundaries(rng):
                     valid_sets=[lgb.Dataset(Xv, yv)],
                     valid_names=["v"], evals_result=ev,
                     verbose_eval=False)
-    # explicit chunk=4 opts eval into chunk granularity: 2 evals / 8 rounds
-    assert len(ev["v"]["l2"]) == 2
-    # the valid scores folded in at the chunk boundary must match the
-    # per-iteration path's eval at the same rounds
+    assert len(ev["v"]["l2"]) == 8
+    # chunk=1 routes through the same device eval program, so the values
+    # must be IDENTICAL (not approximately equal) between chunk sizes
     ev1 = {}
     lgb.train(_params(1), lgb.Dataset(X, y), num_boost_round=8,
               valid_sets=[lgb.Dataset(Xv, yv)], valid_names=["v"],
               evals_result=ev1, verbose_eval=False)
-    assert ev["v"]["l2"][0] == pytest.approx(ev1["v"]["l2"][3], rel=1e-6)
-    assert ev["v"]["l2"][1] == pytest.approx(ev1["v"]["l2"][7], rel=1e-6)
+    assert ev["v"]["l2"] == ev1["v"]["l2"]
     assert bst.num_trees() == 8
+
+
+def test_inscan_eval_bit_identity_with_early_stopping(rng):
+    # noise labels overfit immediately: the stop fires INSIDE the chunk
+    # and the surplus tail-of-chunk trees must be rolled back, leaving
+    # metric values, best_iteration and the final model bit-identical
+    # between chunk sizes
+    rs = np.random.RandomState(7)
+    X = rs.rand(200, 5); y = rs.rand(200)
+    Xv = rs.rand(120, 5); yv = rs.rand(120)
+    out = {}
+    for chunk in (8, 1):
+        ev = {}
+        bst = lgb.train(_params(chunk, learning_rate=0.5, num_leaves=15,
+                                 max_bin=63, min_data_in_leaf=2),
+                        lgb.Dataset(X, y), num_boost_round=40,
+                        valid_sets=[lgb.Dataset(Xv, yv)],
+                        valid_names=["v"], evals_result=ev,
+                        verbose_eval=False, early_stopping_rounds=2)
+        out[chunk] = (ev["v"]["l2"], bst.best_iteration, bst.num_trees(),
+                      _strip_chunk_param(bst.model_to_string()))
+    assert out[8][0] == out[1][0]          # metric values bit-identical
+    assert out[8][1] == out[1][1]          # same early-stop iteration
+    assert out[8][2] == out[1][2]          # surplus trees discarded
+    assert out[8][3] == out[1][3]          # final model bit-identical
+    assert out[8][2] < 8                   # the stop really was mid-chunk
+
+
+def test_inscan_eval_dispatch_drop(rng):
+    # the acceptance A/B: with a valid set attached, chunk=4 must fetch
+    # ~4x fewer times than chunk=1 (2 chunk fetches vs 8 for 8 rounds)
+    from lightgbm_tpu.utils.telemetry import TELEMETRY
+    X, y = make_regression(rng)
+    Xv, yv = make_regression(rng, n=200)
+    fetches = {}
+    for chunk in (4, 1):
+        TELEMETRY.reset()
+        lgb.train(_params(chunk), lgb.Dataset(X, y), num_boost_round=8,
+                  valid_sets=[lgb.Dataset(Xv, yv)], valid_names=["v"],
+                  verbose_eval=False)
+        fetches[chunk] = TELEMETRY.stats()["counters"].get(
+            "transfer/fetch_calls", 0)
+    assert fetches[4] == 2
+    assert fetches[1] == 8
+
+
+def test_feval_forces_per_iteration(rng):
+    # a custom feval is host code: it must cleanly block in-scan eval
+    # (falling back to per-iteration dispatch, still evaluating every
+    # round) and name itself in the blocked gauge
+    from lightgbm_tpu.utils.telemetry import TELEMETRY
+    TELEMETRY.reset()
+    X, y = make_regression(rng)
+    Xv, yv = make_regression(rng, n=200)
+
+    def fv(preds, ds):
+        return "custom_l2", float(np.mean((preds - ds.get_label())**2)), False
+
+    ev = {}
+    lgb.train(_params(4), lgb.Dataset(X, y), num_boost_round=8,
+              valid_sets=[lgb.Dataset(Xv, yv)], valid_names=["v"],
+              evals_result=ev, verbose_eval=False, feval=fv)
+    assert len(ev["v"]["l2"]) == 8
+    assert len(ev["v"]["custom_l2"]) == 8
+    gauges = TELEMETRY.stats()["gauges"]
+    assert gauges.get("boost/inscan_blocked[feval]") == 1
 
 
 def test_auto_chunk_preserves_eval_cadence(rng):

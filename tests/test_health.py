@@ -198,6 +198,112 @@ def test_kill_resume_one_contiguous_stream(tmp_path, rng, monkeypatch,
     assert recs[-1]["aborted"] is False
 
 
+# ------------------------------------------------- in-scan eval records
+def test_eval_records_carry_in_scan_flag(tmp_path, rng):
+    """PR 7: eval records say which path produced them — in_scan: true
+    when the scan body computed the metric on device, false on the
+    legacy per-iteration host path (here forced by a custom feval)."""
+    X, y = _make_data(rng)
+    Xv, yv = _make_data(rng, n=120)
+    path = str(tmp_path / "inscan.health.jsonl")
+    params = dict(PARAMS, tpu_boost_chunk=4, health_out=path)
+    lgb.train(params, lgb.Dataset(X, y), num_boost_round=6,
+              valid_sets=[lgb.Dataset(Xv, yv)], valid_names=["v"],
+              verbose_eval=False)
+    evals = [r for r in _records(path) if r["kind"] == "eval"]
+    assert [r["iter"] for r in evals] == list(range(6))
+    assert all(r["in_scan"] is True for r in evals)
+    assert all(set(r["metrics"]) == {"v/l2"} for r in evals)
+
+    def fv(preds, ds):
+        return "c", float(np.mean((preds - ds.get_label()) ** 2)), False
+
+    path2 = str(tmp_path / "legacy.health.jsonl")
+    lgb.train(dict(params, health_out=path2), lgb.Dataset(X, y),
+              num_boost_round=6, valid_sets=[lgb.Dataset(Xv, yv)],
+              valid_names=["v"], verbose_eval=False, feval=fv)
+    evals = [r for r in _records(path2) if r["kind"] == "eval"]
+    assert [r["iter"] for r in evals] == list(range(6))
+    assert all(r["in_scan"] is False for r in evals)
+    assert all(set(r["metrics"]) == {"v/l2", "v/c"} for r in evals)
+
+
+def test_kill_resume_eval_cadence_with_valid_set(tmp_path, rng,
+                                                 monkeypatch):
+    """With a valid set attached (in-scan eval keeps chunk=4), a
+    killed-and-resumed run still yields exactly ONE eval record per
+    cadence point — no duplicates, no gaps — after stream compaction.
+    Values are asserted by cadence, not cross-resume bit-equality: the
+    resumed f32 valid-score carry is re-uploaded from the host f64
+    sidecar, which can differ in the last bit mid-stream."""
+    seed = rng.randint(1 << 30)
+    a, b = tmp_path / "a", tmp_path / "b"
+    for d in (a, b):
+        d.mkdir()
+        _write_csv(d / "train.csv", np.random.RandomState(seed))
+        _write_csv(d / "valid.csv", np.random.RandomState(seed + 1),
+                   n=120)
+    argv = _cli_argv(["tpu_boost_chunk=4", "valid=valid.csv",
+                      "metric=l2", "metric_freq=1"])
+
+    def eval_view(records):
+        evals = [r for r in records if r["kind"] == "eval"]
+        assert all(r["in_scan"] is True for r in evals)
+        assert all(set(r["metrics"]) == {"valid_1/l2"} for r in evals)
+        return [r["iter"] for r in evals]
+
+    monkeypatch.chdir(a)
+    Application(argv).run()                   # uninterrupted reference
+    assert eval_view(_records(a / "run.health.jsonl")) == list(range(8))
+
+    monkeypatch.chdir(b)
+    monkeypatch.setenv(ENV_FAULTS, "train/kill@4")
+    FAULTS.configure()
+    with pytest.raises(InjectedFault):
+        Application(argv).run()
+    monkeypatch.delenv(ENV_FAULTS)
+    FAULTS.configure()
+    Application(argv + ["resume=true"]).run()
+
+    iters = eval_view(_records(b / "run.health.jsonl"))
+    assert sorted(iters) == list(range(8))    # no gaps...
+    assert len(iters) == len(set(iters))      # ...and no duplicates
+    # the trees themselves resume bit-exactly (the f32 eval carry is
+    # observability, not model state)
+    assert (b / "model.txt").read_bytes() == (a / "model.txt").read_bytes()
+
+
+def test_compile_cache_second_run_hits(tmp_path, rng, monkeypatch):
+    """compile_cache= knob: the second same-config run warm-starts from
+    the persistent XLA cache and the metrics blob shows the hits."""
+    jax = pytest.importorskip("jax")
+    d = tmp_path / "run"
+    d.mkdir()
+    _write_csv(d / "train.csv", rng)
+    argv = _cli_argv([f"compile_cache={tmp_path / 'cc'}"])
+    monkeypatch.chdir(d)
+    prev = (jax.config.jax_compilation_cache_dir,
+            jax.config.jax_persistent_cache_min_compile_time_secs,
+            jax.config.jax_persistent_cache_min_entry_size_bytes)
+    try:
+        jax.clear_caches()                    # force real compiles
+        Application(argv).run()
+        blob1 = json.loads((d / "metrics.json").read_text())
+        TELEMETRY.reset()
+        HEALTH.reset()
+        jax.clear_caches()
+        Application(argv).run()
+        blob2 = json.loads((d / "metrics.json").read_text())
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev[0])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev[1])
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          prev[2])
+    assert blob1["counters"].get("compile/cache_misses", 0) > 0
+    assert blob2["counters"].get("compile/cache_hits", 0) > 0
+
+
 # ------------------------------------------------------------ SIGTERM
 @pytest.mark.skipif(sys.platform == "win32", reason="POSIX signals")
 def test_sigterm_flushes_health_and_metrics(tmp_path, rng):
