@@ -384,14 +384,24 @@ def run_config(config: str, probe_ok: bool) -> dict | None:
 def _append_trajectory(results: list) -> None:
     """One digest line per run appended to BENCH_TRAJECTORY.jsonl — the
     machine-readable perf trajectory across PRs (wall, peak HBM, est.
-    FLOPs).  Null-tolerant: v1 blobs / CPU backends leave the memory and
-    cost fields as null rather than breaking the append."""
+    FLOPs, and — on device_timing runs — the measured dispatch digest
+    of the heaviest seam, which tools/bench_gate.py latency-gates).
+    Null-tolerant: v1 blobs / CPU backends / timing-off runs leave the
+    memory, cost and timing fields as null rather than breaking the
+    append."""
     path = os.path.join(REPO, "BENCH_TRAJECTORY.jsonl")
     with open(path, "a") as fh:
         for r in results:
             m = r.get("metrics") or {}
             mem = m.get("memory") or {}
             cost = m.get("cost") or {}
+            timing = m.get("timing") or {}
+            # the heaviest measured seam (by synced wall) is the one a
+            # latency regression would show up in first
+            tlabels = timing.get("labels") or {}
+            tname = max(tlabels, key=lambda k: tlabels[k].get(
+                "total_s", 0.0)) if tlabels else None
+            tentry = tlabels.get(tname) or {}
             fh.write(json.dumps({
                 "schema": "lightgbm_tpu.trajectory/v1",
                 "ts": round(time.time(), 3),
@@ -406,6 +416,11 @@ def _append_trajectory(results: list) -> None:
                 "hbm_limit_bytes": mem.get("bytes_limit"),
                 "est_flops": cost.get("flops_total"),
                 "est_flops_per_s": cost.get("est_flops_per_s"),
+                "dispatch_label": tname,
+                "dispatch_mean_s": tentry.get("mean_s"),
+                "dispatch_p99_s": tentry.get("p99_s"),
+                "measured_flops_per_s": timing.get(
+                    "measured_flops_per_s"),
             }) + "\n")
 
 

@@ -146,7 +146,7 @@ class Application:
         log_info(f"Started training for {cfg.num_iterations} iterations")
         start = time.perf_counter()
         from .utils.faults import FAULTS
-        from .utils.phase import profile_session
+        from .utils.phase import PROFILE_WINDOW, profile_session
         from .utils.telemetry import TELEMETRY
         # Chunked stepping (tpu_boost_chunk): when the attached metrics
         # are device-computable, the in-scan eval path evaluates them
@@ -196,11 +196,15 @@ class Application:
         try:
             # profiler window is exception-safe: a mid-training error must
             # not leak an open jax profiler trace session
-            with profile_session(), TELEMETRY.memory_session():
+            with profile_session(cfg), TELEMETRY.memory_session():
                 while done < cfg.num_iterations:
                     step = min(chunk, cfg.num_iterations - done)
                     for f in freqs:
                         step = min(step, f - done % f)
+                    # a profile_window boundary splits the chunk so the
+                    # capture covers exactly the requested span
+                    step = PROFILE_WINDOW.clamp_step(done, step)
+                    PROFILE_WINDOW.step(done)
                     stop = (booster.train_chunk(step)
                             if (step > 1 or use_inscan)
                             else booster.train_one_iter())

@@ -214,6 +214,24 @@ _PARAMS: Dict[str, _P] = {
     # directory path.  Hits/misses surface as compile/cache_hits|misses
     # telemetry counters
     "compile_cache": _P(""),
+    # measured per-dispatch device timing (utils/jitcost.py): every
+    # cost-instrumented jit dispatch is timed wall-to-ready (sync on the
+    # returned buffers) into the metrics blob's v4 ``timing`` section —
+    # per-label count/total/mean/p50/p99 plus host dispatch-gap time —
+    # yielding MEASURED FLOP/s and B/s next to the static XLA estimates.
+    # Values (and models) are unchanged, but the sync serializes the
+    # async pipeline: an opt-in measurement mode, never a benchmark
+    # default.  Env LIGHTGBM_TPU_DEVICE_TIMING wins; runtime-only
+    "device_timing": _P(False),
+    # windowed programmatic jax-profiler capture: "START:END" opens the
+    # profiler trace only for that half-open boosting-iteration span,
+    # wrapping chunk dispatches in StepTraceAnnotation and phases in
+    # TraceAnnotation so the device trace aligns with the host Chrome
+    # trace.  Artifact dir: LIGHTGBM_TPU_PROFILE_DIR, else
+    # lightgbm_tpu.profile; path + actual window land in the blob's
+    # ``timing`` section.  "" = off.  Env LIGHTGBM_TPU_PROFILE_WINDOW
+    # wins; runtime-only
+    "profile_window": _P(""),
     # -- robustness (utils/faults.py, docs/ROBUSTNESS.md) --
     # blocking finiteness check on the boosted scores at chunk
     # boundaries (and per-iteration when chunking is off): a NaN/Inf
@@ -237,7 +255,8 @@ _PARAMS: Dict[str, _P] = {
 # including them would make a resumed run's model differ byte-wise from
 # an uninterrupted one
 RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection",
-                                 "compile_cache"])
+                                 "compile_cache", "device_timing",
+                                 "profile_window"])
 
 # alias -> canonical name
 ALIAS_TABLE: Dict[str, str] = {}

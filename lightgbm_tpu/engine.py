@@ -102,7 +102,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # a callback or device error mid-training must not leak an open jax
     # profiler trace session
     from .utils import maybe_enable_compile_cache
-    from .utils.phase import profile_session
+    from .utils.phase import PROFILE_WINDOW, profile_session
     from .utils.telemetry import HEALTH, TELEMETRY
     # compile_cache= knob: persistent on-disk XLA compilation cache, so a
     # restarted/resumed run warm-starts its compiles (hits/misses surface
@@ -152,7 +152,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # or device error raises out of the loop)
     failed = False
     try:
-        with profile_session(), TELEMETRY.memory_session():
+        with profile_session(booster.gbdt.config), \
+                TELEMETRY.memory_session():
             i = 0
             # in-scan rows carry GBDT-global iteration indices; with an
             # init_model those are offset from the engine's 0-based count
@@ -160,6 +161,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                          if use_inscan else 0)
             while i < num_boost_round:
                 step = min(chunk, num_boost_round - i)
+                # a profile_window boundary splits the chunk so the
+                # capture covers exactly the requested iteration span
+                step = PROFILE_WINDOW.clamp_step(i, step)
+                PROFILE_WINDOW.step(i)
                 for cb in callbacks_before:
                     cb(callback_mod.CallbackEnv(
                         model=booster, params=params, iteration=i,

@@ -30,7 +30,7 @@ from ..utils.faults import FAULTS, InjectedFault, oom_error
 from ..utils.jitcost import cost_jit
 from ..utils.log import (LightGBMError, check, log_fatal, log_info,
                          log_warning)
-from ..utils.phase import GLOBAL_TIMER as _PHASES
+from ..utils.phase import GLOBAL_TIMER as _PHASES, step_annotation
 from ..utils.telemetry import HEALTH, TELEMETRY
 from .grower import (GrowerParams, _pack_tree_device, fetch_tree_arrays,
                      fetch_tree_chunk, make_grow_tree, unpack_tree_buffers)
@@ -43,12 +43,15 @@ class _PendingChunk(NamedTuple):
     scan's stacked [T, C, len_ints]/[T, C, len_floats] device buffers,
     materialized host-side in two transfers at the chunk boundary.
     ``mvals`` is the in-scan evaluation's stacked [T, n_cols] metric
-    rows (None when no eval program rides the chunk)."""
+    rows (None when no eval program rides the chunk); ``wall_s`` is the
+    chunk dispatch's host wall window (wall-to-ready under
+    device_timing), carried into the health stream's iter records."""
     ints_all: jax.Array
     floats_all: jax.Array
     shrinkage: float
     length: int
     mvals: Optional[jax.Array] = None
+    wall_s: Optional[float] = None
 
 
 def _maybe_print_seg_stats(stats) -> None:
@@ -284,6 +287,8 @@ class GBDT:
         # utils/telemetry.py) and hook jax compile/retrace/cache events
         # before any tracing happens
         TELEMETRY.set_config_level(getattr(config, "telemetry_level", 1))
+        TELEMETRY.set_config_timing(getattr(config, "device_timing",
+                                            False))
         if TELEMETRY.level >= 1:
             TELEMETRY.install_jax_listeners()
         # arm fault injection for this run (env spec wins per-site) with
@@ -1007,13 +1012,16 @@ class GBDT:
     def _entry_iter_arrays(self, entry):
         """Normalize one pending entry into per-iteration host pytrees:
         [(iter_idx, [(TreeArrays, shrinkage)] * C, gstats, chunk_len,
-        mvals_row)].  A chunk entry fetches its stacked [T, C, ...]
-        buffers here — two host transfers for the WHOLE chunk (the async
-        copy started at dispatch), then pure numpy slicing.  ``gstats``
-        is the [C, 8] grad/hess diagnostics row for the health stream
-        (None when no stream is active — the device buffer is then never
-        fetched); ``mvals_row`` is the in-scan eval program's [n_cols]
-        metric row (None off the eval path)."""
+        mvals_row, wall_s)].  A chunk entry fetches its stacked [T, C,
+        ...] buffers here — two host transfers for the WHOLE chunk (the
+        async copy started at dispatch), then pure numpy slicing.
+        ``gstats`` is the [C, 8] grad/hess diagnostics row for the
+        health stream (None when no stream is active — the device buffer
+        is then never fetched); ``mvals_row`` is the in-scan eval
+        program's [n_cols] metric row (None off the eval path), its
+        fetch counted under ``transfer/eval_fetch_*``; ``wall_s`` is the
+        chunk's dispatch wall window, attributed to the chunk's FIRST
+        iteration (None elsewhere)."""
         iter_idx, payload, gstats = entry
         L = self.grower_params.num_leaves
         fetch_stats = gstats is not None and HEALTH.active
@@ -1021,13 +1029,21 @@ class GBDT:
             chunk = fetch_tree_chunk(payload.ints_all, payload.floats_all,
                                      L)
             gnp = np.asarray(gstats) if fetch_stats else None
-            mv = (np.asarray(payload.mvals)
-                  if payload.mvals is not None else None)
+            mv = None
+            if payload.mvals is not None:
+                mv = np.asarray(payload.mvals)
+                # the in-scan eval row fetch is its own host transfer;
+                # counted separately from the tree-buffer fetch_calls
+                # (whose exact counts tests pin)
+                TELEMETRY.counter_add("transfer/eval_fetch_calls")
+                TELEMETRY.counter_add("transfer/eval_fetch_bytes",
+                                      int(mv.nbytes))
             return [(iter_idx + t,
                      [(arrays, payload.shrinkage) for arrays in per_class],
                      gnp[t] if gnp is not None else None,
                      payload.length,
-                     mv[t] if mv is not None else None)
+                     mv[t] if mv is not None else None,
+                     payload.wall_s if t == 0 else None)
                     for t, per_class in enumerate(chunk)]
         pairs = []
         for (ints_d, floats_d, lr) in payload:
@@ -1038,7 +1054,8 @@ class GBDT:
                                   + int(floats_np.nbytes))
             pairs.append((unpack_tree_buffers(ints_np, floats_np, L), lr))
         return [(iter_idx, pairs,
-                 np.asarray(gstats) if fetch_stats else None, 1, None)]
+                 np.asarray(gstats) if fetch_stats else None, 1, None,
+                 None)]
 
     def _materialize_iter(self, pairs):
         """One iteration's [(TreeArrays, shrinkage)] -> (trees, all_const);
@@ -1079,12 +1096,12 @@ class GBDT:
         """
         while len(self._pending) > keep_latest:
             per_iter = self._entry_iter_arrays(self._pending.pop(0))
-            for j, (iter_idx, pairs, gstats, clen,
-                    mrow) in enumerate(per_iter):
+            for j, (iter_idx, pairs, gstats, clen, mrow,
+                    wall) in enumerate(per_iter):
                 trees, all_const = self._materialize_iter(pairs)
                 if all_const:
                     rest = [(ii, self._materialize_iter(pp)[0])
-                            for ii, pp, _g, _c, _m in per_iter[j + 1:]]
+                            for ii, pp, _g, _c, _m, _w in per_iter[j + 1:]]
                     self._undo_pending_scores([(iter_idx, trees)] + rest
                                               + self._materialize_rest())
                     self._pending = []
@@ -1097,7 +1114,8 @@ class GBDT:
                 self._models.extend(trees)
                 self._note_trees(trees)
                 self._apply_valid_scores(trees)
-                self._health_emit(iter_idx, trees, gstats, clen)
+                self._health_emit(iter_idx, trees, gstats, clen,
+                                  wall_s=wall)
                 # in-scan eval rows surface only for materialized
                 # iterations: tail-of-chunk rows past an all-constant
                 # stop are discarded with their trees
@@ -1124,23 +1142,26 @@ class GBDT:
     def _materialize_rest(self):
         out = []
         for entry in self._pending:
-            for iter_idx, pairs, _g, _c, _m in self._entry_iter_arrays(
+            for iter_idx, pairs, _g, _c, _m, _w in self._entry_iter_arrays(
                     entry):
                 out.append((iter_idx, self._materialize_iter(pairs)[0]))
         return out
 
     # ------------------------------------------------------- health stream
     def _health_emit(self, iter_idx: int, trees, gstats,
-                     chunk_len: int) -> None:
+                     chunk_len: int, wall_s=None) -> None:
         """One ``iter`` health record: dispatched chunk size, per-tree
         shape stats, grad/hess diagnostics ([C, 8] from
-        ``_grad_stats_core``) and the HBM gauge.  Emitted at tree
-        materialization, so the async pipeline's records land in
-        iteration order."""
+        ``_grad_stats_core``), the HBM gauge, and — on the chunk's first
+        iteration — the dispatch wall window (``dispatch_wall_s``).
+        Emitted at tree materialization, so the async pipeline's records
+        land in iteration order."""
         if not HEALTH.active:
             return
         rec: Dict[str, Any] = {"iter": int(iter_idx),
                                "chunk": int(chunk_len)}
+        if wall_s is not None:
+            rec["dispatch_wall_s"] = round(float(wall_s), 6)
         tstats = []
         for t in trees:
             nl = int(t.num_leaves)
@@ -1593,7 +1614,12 @@ class GBDT:
                     self.bins, self.fmeta, self._full_fmask, shr,
                     self._obj_arrs)
         mvals_all = None
-        with _PHASES.phase("chunk") as box:
+        # the chunk's dispatch wall window: host dispatch time by
+        # default, wall-to-ready when device_timing syncs inside the
+        # CostJit seam — carried into the health stream's iter records
+        t0_wall = time.perf_counter()
+        with step_annotation("chunk", first_iter), \
+                _PHASES.phase("chunk") as box:
             if self._chunk_guard is not None:
                 with self._chunk_guard():
                     out = fn(*args)
@@ -1606,13 +1632,14 @@ class GBDT:
                 (self.train_score, self._key, ints_all, floats_all,
                  gstats_all) = out
             box[0] = self.train_score
+        wall_s = time.perf_counter() - t0_wall
         # before the chunk's buffers can become trees: a non-finite score
         # discards them and raises (older pending chunks stay good)
         self._guard_chunk_nonfinite(first_iter, t)
         self._start_host_copy(ints_all, floats_all, gstats_all, mvals_all)
         self._pending.append((self.iter_, _PendingChunk(
-            ints_all, floats_all, self.shrinkage_rate, t, mvals_all),
-            gstats_all))
+            ints_all, floats_all, self.shrinkage_rate, t, mvals_all,
+            wall_s), gstats_all))
         self.iter_ += t
         with _PHASES.phase("fetch"):
             # valid-set scores update at materialization, and eval at the

@@ -10,6 +10,18 @@ at that signature, so nothing compiles twice.  Every dispatch bumps the
 label's call count, which multiplies the per-call cost out into the
 ``cost`` section of the metrics blob (telemetry.stats()).
 
+With measured device timing enabled (``device_timing=`` config knob /
+``LIGHTGBM_TPU_DEVICE_TIMING`` env), each dispatch is additionally
+timed wall-to-ready: the wrapper blocks on the returned buffers and
+records the window into the telemetry ``timing`` section (per-label
+count/total/mean/p50/p99 + the host gap between consecutive dispatches
+of the same label).  ``block_until_ready`` only synchronizes — values,
+and therefore models, are unchanged — but it does serialize the async
+pipeline, so timing is an opt-in measurement mode, never a default.
+Under an outer trace the tracer passthrough below returns before the
+timing gate, so timing latches off exactly like the AOT fallback; with
+timing off the extra cost is one attribute compare.
+
 Gating and fallbacks keep the wrapper invisible when it cannot help:
 
   * telemetry level 0 — one attribute compare, then the plain jitted
@@ -123,16 +135,34 @@ class CostJit:
         if entry is _UNSEEN:
             entry = self._aot_compile(args, key)
         TELEMETRY.cost_call(self._label)
+        if not TELEMETRY.timing_on:
+            if entry is None:
+                return self._fn(*args)
+            try:
+                return entry(*args)
+            except (TypeError, ValueError):
+                # executable rejected the call (e.g. a sharding/layout
+                # facet the signature key missed) BEFORE running —
+                # nothing was donated; latch plain-jit dispatch for
+                # this signature
+                self._compiled[key] = None
+                return self._fn(*args)
+        # measured dispatch timing: wall from dispatch to buffers ready
+        # (the plain-jit fallback is a real dispatch too, so it is timed
+        # under the same label)
+        import time
+        t0 = time.perf_counter()
         if entry is None:
-            return self._fn(*args)
-        try:
-            return entry(*args)
-        except (TypeError, ValueError):
-            # executable rejected the call (e.g. a sharding/layout facet
-            # the signature key missed) BEFORE running — nothing was
-            # donated; latch plain-jit dispatch for this signature
-            self._compiled[key] = None
-            return self._fn(*args)
+            out = self._fn(*args)
+        else:
+            try:
+                out = entry(*args)
+            except (TypeError, ValueError):
+                self._compiled[key] = None
+                out = self._fn(*args)
+        jax.block_until_ready(out)
+        TELEMETRY.record_dispatch(self._label, t0, time.perf_counter())
+        return out
 
 
 def cost_jit(label: str, jitted) -> CostJit:

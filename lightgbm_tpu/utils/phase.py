@@ -17,6 +17,18 @@ normally measures only host-side dispatch.  Set
 boundary — slower, but attributes device time to the phase that spent it
 (the jax-profiler trace, ``LIGHTGBM_TPU_PROFILE_DIR``, is the zero-skew
 alternative).
+
+Profiler capture comes in two shapes: the original all-or-nothing
+session (``LIGHTGBM_TPU_PROFILE_DIR`` wraps the whole train loop) and
+the windowed programmatic capture (``profile_window=START:END`` config
+parameter / ``LIGHTGBM_TPU_PROFILE_WINDOW`` env), which opens the
+``jax.profiler`` trace only for that boosting-iteration span — a
+multi-hour run yields a viewable-sized artifact of exactly the steady
+state (or exactly the suspect iterations).  While either capture is
+open, phases are wrapped in ``jax.profiler.TraceAnnotation`` and chunk
+dispatches in ``StepTraceAnnotation`` (models/gbdt.py), so the device
+trace aligns with the host-side Chrome trace.  The artifact path and
+actual window land in the metrics blob's ``timing`` section.
 """
 
 from __future__ import annotations
@@ -25,7 +37,7 @@ import os
 import threading
 import time
 from collections import defaultdict
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from typing import Dict, Optional, Tuple
 
 
@@ -49,6 +61,12 @@ class PhaseTimer:
         if sync and sync_obj is not None:
             import jax
             jax.block_until_ready(sync_obj)
+        ann = None
+        if profiler_active():
+            # align host phase structure with the device profiler trace
+            import jax
+            ann = jax.profiler.TraceAnnotation(f"lgbm:{name}")
+            ann.__enter__()
         t0 = time.perf_counter()
         box = [None]
         try:
@@ -57,6 +75,8 @@ class PhaseTimer:
             if sync and box[0] is not None:
                 import jax
                 jax.block_until_ready(box[0])
+            if ann is not None:
+                ann.__exit__(None, None, None)
             dur = time.perf_counter() - t0
             with self._lock:
                 self.seconds[name] += dur
@@ -100,6 +120,133 @@ GLOBAL_TIMER = PhaseTimer()
 
 _profile_session: Optional[object] = None
 
+WINDOW_ENV = "LIGHTGBM_TPU_PROFILE_WINDOW"
+DEFAULT_PROFILE_DIR = "lightgbm_tpu.profile"
+
+
+class ProfileWindow:
+    """Windowed programmatic jax-profiler capture.
+
+    ``profile_window=START:END`` (env ``LIGHTGBM_TPU_PROFILE_WINDOW``
+    wins) arms ONE capture per training run over the half-open boosting-
+    iteration span ``[START, END)``.  The train loops call
+    ``clamp_step`` (so a chunk dispatch never straddles a window
+    boundary — chunk size never changes the model, PR 1 parity, so the
+    clamp only affects dispatch granularity) and then ``step(i)`` before
+    dispatching iteration ``i``; the window opens/closes itself at the
+    boundaries.  ``close()`` in the profile_session finally guarantees
+    an exception mid-window cannot leak an open jax profiler session
+    (which would poison every later ``start_trace`` in the process).
+    The artifact dir comes from ``LIGHTGBM_TPU_PROFILE_DIR`` when set,
+    else ``lightgbm_tpu.profile``; the dir + actual captured span are
+    recorded into the metrics blob's ``timing`` section.
+    """
+
+    def __init__(self) -> None:
+        self.start = 0
+        self.end = 0
+        self.dir = ""
+        self.is_open = False
+        self._armed = False
+        self._done = False
+        self._opened_at = 0
+        self._last_iter = 0
+
+    def configure(self, config=None) -> bool:
+        """(Re-)arm from the env/config spec; returns True when a
+        window is armed.  A malformed spec warns and disables the
+        window rather than failing the run."""
+        self._armed = False
+        self._done = False
+        self.is_open = False
+        spec = os.environ.get(WINDOW_ENV, "")
+        if not spec and config is not None:
+            spec = str(getattr(config, "profile_window", "") or "")
+        if not spec:
+            return False
+        try:
+            a, _, b = spec.partition(":")
+            start, end = int(a), int(b)
+        except ValueError:
+            start, end = 0, 0
+        if end <= start or start < 0:
+            from .log import log_warning
+            log_warning(f"bad profile_window spec {spec!r} (want "
+                        "START:END with END > START >= 0); profiler "
+                        "window disabled")
+            return False
+        self.start, self.end = start, end
+        self.dir = (os.environ.get("LIGHTGBM_TPU_PROFILE_DIR")
+                    or DEFAULT_PROFILE_DIR)
+        self._armed = True
+        return True
+
+    def clamp_step(self, iteration: int, step: int) -> int:
+        """Clamp a chunk step so the next dispatch stops at the nearest
+        upcoming window boundary."""
+        if not self._armed or self._done:
+            return step
+        for boundary in (self.start, self.end):
+            if iteration < boundary:
+                return min(step, boundary - iteration)
+        return step
+
+    def step(self, iteration: int) -> None:
+        """Advance to ``iteration`` (about to be dispatched): opens the
+        trace entering the window, closes it leaving."""
+        if not self._armed or self._done:
+            return
+        self._last_iter = iteration
+        if self.is_open:
+            if iteration >= self.end:
+                self._close(iteration)
+        elif self.start <= iteration < self.end:
+            import jax
+            jax.profiler.start_trace(self.dir)
+            self.is_open = True
+            self._opened_at = iteration
+
+    def _close(self, iteration: int) -> None:
+        # clear the open marker FIRST: if stop_trace raises, the finally
+        # close() must not call it again on an already-broken session
+        self.is_open = False
+        self._done = True
+        import jax
+        jax.profiler.stop_trace()
+        from .telemetry import TELEMETRY
+        TELEMETRY.record_profile_capture({
+            "dir": self.dir, "kind": "window",
+            "window": [int(self._opened_at), int(iteration)],
+            "requested": [int(self.start), int(self.end)]})
+
+    def close(self) -> None:
+        """Force-close an open window and disarm (profile_session
+        finally): the capture then covers up to the last stepped
+        iteration."""
+        if self.is_open:
+            self._close(min(self.end, self._last_iter + 1))
+        self._armed = False
+
+
+PROFILE_WINDOW = ProfileWindow()
+
+
+def profiler_active() -> bool:
+    """True while ANY jax-profiler capture (whole-run session or
+    window) is open — gates the Trace/StepTraceAnnotation wrappers so
+    the un-profiled path stays annotation-free."""
+    return _profile_session is not None or PROFILE_WINDOW.is_open
+
+
+def step_annotation(name: str, step: int):
+    """``jax.profiler.StepTraceAnnotation`` while a capture is open
+    (the profiler's per-step grouping for chunk dispatches), else a
+    zero-overhead null context."""
+    if not profiler_active():
+        return nullcontext()
+    import jax
+    return jax.profiler.StepTraceAnnotation(name, step_num=int(step))
+
 
 def maybe_start_profile() -> None:
     """Start a jax-profiler trace if LIGHTGBM_TPU_PROFILE_DIR is set."""
@@ -116,18 +263,28 @@ def maybe_stop_profile() -> None:
     if _profile_session is not None:
         # clear the session marker FIRST: if stop_trace raises, a retry
         # must not call it again on an already-broken session
-        _profile_session = None
+        path, _profile_session = _profile_session, None
         import jax
         jax.profiler.stop_trace()
+        from .telemetry import TELEMETRY
+        TELEMETRY.record_profile_capture({"dir": path, "kind": "session"})
 
 
 @contextmanager
-def profile_session():
+def profile_session(config=None):
     """Exception-safe profiler window: an error mid-training must not
     leak an open jax profiler trace session (which would poison every
-    later start_trace in the process)."""
-    maybe_start_profile()
+    later start_trace in the process).  A configured
+    ``profile_window=START:END`` span takes over from the all-or-nothing
+    LIGHTGBM_TPU_PROFILE_DIR session — the window owns the capture and
+    the train loop drives it via PROFILE_WINDOW.step()."""
+    windowed = PROFILE_WINDOW.configure(config)
+    if not windowed:
+        maybe_start_profile()
     try:
         yield
     finally:
-        maybe_stop_profile()
+        if windowed:
+            PROFILE_WINDOW.close()
+        else:
+            maybe_stop_profile()

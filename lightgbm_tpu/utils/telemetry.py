@@ -50,6 +50,17 @@ The v2 schema adds two DEVICE-side sections on top of the host view:
     multiplied out by call counts, so ``stats()`` can report
     estimated FLOPs/s and bytes/s for the measured window.
 
+The v4 schema adds MEASURED device time: an opt-in ``timing`` section
+(``device_timing=`` config parameter / ``LIGHTGBM_TPU_DEVICE_TIMING``
+env) fed by utils/jitcost.py, which times every instrumented jit
+dispatch wall-to-ready (sync on the returned buffers) and accumulates
+per-label count/total/mean/p50/p99 plus the dispatch GAP (host overhead
+between consecutive dispatches of the same label).  Dividing the v2
+``cost`` section's static FLOPs/bytes by the measured seconds yields
+real utilization next to the estimated one.  The section also records
+the jax-profiler capture artifact (path + iteration window) when a
+``profile_window=START:END`` capture ran (utils/phase.py).
+
 The v3 schema adds the STREAMING run-health layer: every blob carries
 top-level ``schema`` and ``telemetry_level`` keys (so tools can branch
 without sniffing sections), and — when a run writes a health stream —
@@ -76,13 +87,16 @@ from collections import defaultdict, deque
 from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
-METRICS_SCHEMA = "lightgbm_tpu.metrics/v3"
+METRICS_SCHEMA = "lightgbm_tpu.metrics/v4"
 HEALTH_SCHEMA = "lightgbm_tpu.health/v1"
 HEALTH_ENV = "LIGHTGBM_TPU_HEALTH_JSONL"
+TIMING_ENV = "LIGHTGBM_TPU_DEVICE_TIMING"
 SPAN_CAPACITY = 65536
 TIMELINE_CAPACITY = 8192
 MEM_TRACK_CAPACITY = 16384
 FAULT_CAPACITY = 512
+# bounded per-label reservoir backing the p50/p99 dispatch quantiles
+TIMING_SAMPLE_CAPACITY = 4096
 
 # jax.monitoring event name -> (count counter, seconds counter)
 _JAX_DURATION_EVENTS = {
@@ -371,6 +385,14 @@ class TelemetryRegistry:
         self._mem_interval_ms = 0.0
         # ------ XLA cost analysis (per jit-seam label) ------
         self._costs: Dict[str, Dict[str, float]] = {}
+        # ------ measured per-dispatch timing (opt-in, v4) ------
+        # label -> {count, total_s, samples, last_end, gap_count,
+        # gap_total_s}; fed by utils/jitcost.py only when ``timing_on``
+        self._timing: Dict[str, Dict[str, Any]] = {}
+        self._config_timing = False
+        # the jax-profiler capture artifact (utils/phase.py): path and,
+        # for windowed captures, the iteration span
+        self._profile_capture: Optional[Dict[str, Any]] = None
         # ------ fault / recovery narration ------
         # every injected fault, rollback, retry and salvage lands here so
         # the metrics blob can explain a degraded run; recorded at EVERY
@@ -378,6 +400,9 @@ class TelemetryRegistry:
         self._faults: deque = deque(maxlen=FAULT_CAPACITY)
         self._fault_counts: Dict[str, float] = defaultdict(float)
         self._level = self._resolve_level()
+        # plain attribute (not a property): the hot-path off-switch in
+        # utils/jitcost.py stays one attribute compare
+        self.timing_on = self._resolve_timing()
 
     # ------------------------------------------------------------- level
     def _resolve_level(self) -> int:
@@ -399,6 +424,7 @@ class TelemetryRegistry:
         """Re-read env/config into the cached level (the hot-path gate is
         one attribute compare; refresh happens at setup boundaries)."""
         self._level = self._resolve_level()
+        self.timing_on = self._resolve_timing()
         return self._level
 
     @property
@@ -412,6 +438,23 @@ class TelemetryRegistry:
         except (TypeError, ValueError):
             self._config_level = None
         self.refresh_level()
+
+    def _resolve_timing(self) -> bool:
+        """Measured-dispatch timing is an opt-in on TOP of level >= 1
+        (jitcost's level gate already short-circuits below that):
+        ``LIGHTGBM_TPU_DEVICE_TIMING`` wins over the ``device_timing``
+        config parameter."""
+        if self._level < 1:
+            return False
+        env = os.environ.get(TIMING_ENV, "")
+        if env != "":
+            return env.strip().lower() not in ("0", "false", "off", "no")
+        return bool(self._config_timing)
+
+    def set_config_timing(self, flag) -> None:
+        """Bind the ``device_timing`` config parameter (env wins)."""
+        self._config_timing = bool(flag)
+        self.timing_on = self._resolve_timing()
 
     # ----------------------------------------------------- writer check
     def _note_writer(self) -> None:
@@ -755,6 +798,105 @@ class TelemetryRegistry:
             out["est_bytes_per_s"] = bytes_total / elapsed
         return out
 
+    # ------------------------------------------- measured dispatch timing
+    def record_dispatch(self, label: str, start: float, end: float) -> None:
+        """Fold one measured wall-to-ready dispatch window (two
+        ``time.perf_counter()`` values) into the per-label timing
+        accumulators.  utils/jitcost.py calls this only when
+        ``timing_on`` — the sync that produced ``end`` already happened.
+        The gap accumulators measure host overhead between consecutive
+        dispatches of the SAME label (end of one to start of the next)."""
+        wall = max(0.0, end - start)
+        with self._lock:
+            e = self._timing.get(label)
+            if e is None:
+                e = self._timing[label] = {
+                    "count": 0, "total_s": 0.0,
+                    "samples": deque(maxlen=TIMING_SAMPLE_CAPACITY),
+                    "last_end": None, "gap_count": 0, "gap_total_s": 0.0}
+            e["count"] += 1
+            e["total_s"] += wall
+            e["samples"].append(wall)
+            last_end = e["last_end"]
+            if last_end is not None and start > last_end:
+                e["gap_count"] += 1
+                e["gap_total_s"] += start - last_end
+            e["last_end"] = end
+
+    def record_profile_capture(self, info: Dict[str, Any]) -> None:
+        """Attach a jax-profiler capture's artifact location (and, for
+        windowed captures, the iteration span) to the ``timing`` section.
+        Recorded at every level: a capture the user asked for must be
+        findable from the blob."""
+        with self._lock:
+            self._profile_capture = dict(info)
+
+    @staticmethod
+    def _quantile(sorted_vals, q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1,
+                  int(round(q * (len(sorted_vals) - 1))))
+        return float(sorted_vals[idx])
+
+    def _timing_section(self) -> Optional[Dict[str, Any]]:
+        """The v4 ``timing`` section: per-label measured dispatch wall
+        (count/total/mean/p50/p99/max + gap stats) and, for labels with
+        cost analysis, measured FLOP/s and B/s — static work divided by
+        MEASURED seconds, next to the blob-level estimated rates.  The
+        quantiles come from a bounded per-label sample reservoir
+        (``TIMING_SAMPLE_CAPACITY`` newest samples).  ``None`` when
+        timing never ran and no profiler capture was taken."""
+        with self._lock:
+            if not self._timing and self._profile_capture is None:
+                return None
+            entries = {k: (dict(v), sorted(v["samples"]))
+                       for k, v in self._timing.items()}
+            costs = {k: dict(v) for k, v in self._costs.items()}
+            capture = (dict(self._profile_capture)
+                       if self._profile_capture is not None else None)
+            enabled = bool(self.timing_on)
+        labels: Dict[str, Any] = {}
+        total_s = 0.0
+        flops_timed = bytes_timed = 0.0
+        have_cost = False
+        for name, (e, samples) in entries.items():
+            n = e["count"]
+            lab: Dict[str, Any] = {
+                "count": n,
+                "total_s": round(e["total_s"], 6),
+                "mean_s": round(e["total_s"] / n, 9) if n else 0.0,
+                "p50_s": round(self._quantile(samples, 0.50), 9),
+                "p99_s": round(self._quantile(samples, 0.99), 9),
+                "max_s": round(samples[-1], 9) if samples else 0.0,
+            }
+            if e["gap_count"]:
+                lab["gap_count"] = e["gap_count"]
+                lab["gap_total_s"] = round(e["gap_total_s"], 6)
+                lab["gap_mean_s"] = round(
+                    e["gap_total_s"] / e["gap_count"], 9)
+            c = costs.get(name)
+            if c is not None and e["total_s"] > 0:
+                lab["measured_flops_per_s"] = \
+                    c["flops_total"] / e["total_s"]
+                lab["measured_bytes_per_s"] = \
+                    c["bytes_total"] / e["total_s"]
+                flops_timed += c["flops_total"]
+                bytes_timed += c["bytes_total"]
+                have_cost = True
+            labels[name] = lab
+            total_s += e["total_s"]
+        out: Dict[str, Any] = {"enabled": enabled or bool(labels)}
+        if labels:
+            out["labels"] = labels
+            out["total_s"] = round(total_s, 6)
+            if have_cost and total_s > 0:
+                out["measured_flops_per_s"] = flops_timed / total_s
+                out["measured_bytes_per_s"] = bytes_timed / total_s
+        if capture is not None:
+            out["profile"] = capture
+        return out
+
     # ------------------------------------------------------------- output
     def stats(self) -> Dict[str, Any]:
         """Versioned stats dict: phases (from the global PhaseTimer),
@@ -765,7 +907,10 @@ class TelemetryRegistry:
         ``memory_stats()`` returns None; ``cost`` is omitted when no
         instrumented seam compiled in the window.  v3 adds top-level
         ``schema``/``telemetry_level`` keys and, when the run wrote a
-        health stream, its ``health`` digest section."""
+        health stream, its ``health`` digest section.  v4 adds the
+        ``timing`` section (measured per-dispatch wall + profiler
+        capture info), present only when device timing ran or a
+        profiler capture was taken."""
         import sys
         from .phase import GLOBAL_TIMER, _sync_enabled
         with self._lock:
@@ -783,7 +928,7 @@ class TelemetryRegistry:
             network = net.collective_stats()
         out: Dict[str, Any] = {
             "schema": METRICS_SCHEMA,
-            "version": 3,
+            "version": 4,
             "level": self._level,
             "telemetry_level": self._level,
             "mode": "sync" if _sync_enabled() else "dispatch",
@@ -801,6 +946,9 @@ class TelemetryRegistry:
         cost = self._cost_section()
         if cost is not None:
             out["cost"] = cost
+        timing = self._timing_section()
+        if timing is not None:
+            out["timing"] = timing
         faults = self._faults_section()
         if faults is not None:
             out["faults"] = faults
@@ -910,6 +1058,8 @@ class TelemetryRegistry:
             self._mem_track.clear()
             self._mem_interval_ms = 0.0
             self._costs = {}
+            self._timing = {}
+            self._profile_capture = None
             self._faults.clear()
             self._fault_counts.clear()
         net = sys.modules.get("lightgbm_tpu.parallel.network")

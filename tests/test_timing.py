@@ -1,0 +1,280 @@
+"""Measured device-time attribution (metrics blob v4).
+
+Covers the ISSUE acceptance surfaces: timing parity between chunked
+and per-iteration dispatch (every timed label's count matches its cost
+call count, quantiles are finite and ordered), bit-identical models
+with ``device_timing`` on, the windowed programmatic profiler capture
+(opens/closes exactly once, exception-safe mid-training), the
+``transfer/eval_fetch_*`` counters on the in-scan eval path, the
+``dispatch_wall_s`` health-stream field feeding run_monitor's EWMA
+pace/ETA line, trace_report's v3-blob n/a-safety, and the bench_gate
+dispatch-latency verdicts.
+"""
+
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.phase import GLOBAL_TIMER, PROFILE_WINDOW
+from lightgbm_tpu.utils.telemetry import TELEMETRY
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import bench_gate  # noqa: E402
+import run_monitor  # noqa: E402
+import trace_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    """TELEMETRY and PROFILE_WINDOW are process-global: start every
+    test from a clean window and a disarmed profiler."""
+    GLOBAL_TIMER.reset()
+    TELEMETRY.reset()
+    yield
+    GLOBAL_TIMER.reset()
+    TELEMETRY.reset()
+    PROFILE_WINDOW._armed = False
+    PROFILE_WINDOW.is_open = False
+
+
+def make_binary(rng, n=500, f=5):
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _params(**kw):
+    p = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+         "min_data_in_leaf": 5, "verbose": -1}
+    p.update(kw)
+    return p
+
+
+# -------------------------------------------------------- measured timing
+
+
+def _assert_timing_matches_cost(stats):
+    timing = stats["timing"]
+    assert timing["enabled"] is True
+    cost_labels = stats["cost"]["labels"]
+    assert timing["labels"], "timing on must time at least one dispatch"
+    for name, lab in timing["labels"].items():
+        assert lab["count"] == cost_labels[name]["calls"], name
+        for key in ("mean_s", "p50_s", "p99_s", "max_s", "total_s"):
+            assert math.isfinite(lab[key]) and lab[key] >= 0.0, (name, key)
+        assert lab["p50_s"] <= lab["p99_s"] <= lab["max_s"], name
+    assert timing["total_s"] > 0.0
+
+
+def test_timing_counts_match_cost_calls_chunked_and_not(rng):
+    """Every timed label's dispatch count equals its cost call count —
+    on the chunked path (one boost/chunk[4] program per 4 iterations)
+    and on the per-iteration path alike."""
+    X, y = make_binary(rng, n=600)
+    for chunk in (4, 1):
+        GLOBAL_TIMER.reset()
+        TELEMETRY.reset()
+        lgb.train(_params(tpu_boost_chunk=chunk, device_timing=True,
+                          seed=7), lgb.Dataset(X, y), num_boost_round=8)
+        stats = TELEMETRY.stats()
+        _assert_timing_matches_cost(stats)
+        if chunk == 4:
+            assert "boost/chunk[4]" in stats["timing"]["labels"]
+            assert stats["timing"]["labels"]["boost/chunk[4]"][
+                "count"] == 2
+            assert stats["timing"].get("measured_flops_per_s", 0) > 0
+
+
+def test_timing_off_by_default_and_models_bit_identical(rng):
+    """device_timing only measures: the blob has no timing section when
+    it is off, and the saved model is byte-identical with it on (the
+    knob is runtime-only, never serialized)."""
+    X, y = make_binary(rng, n=400)
+    data = lambda: lgb.Dataset(X, y)
+    bst_off = lgb.train(_params(tpu_boost_chunk=4, seed=3), data(),
+                        num_boost_round=6)
+    assert "timing" not in TELEMETRY.stats()
+    off_str = bst_off.model_to_string()
+
+    GLOBAL_TIMER.reset()
+    TELEMETRY.reset()
+    bst_on = lgb.train(_params(tpu_boost_chunk=4, seed=3,
+                               device_timing=True), data(),
+                       num_boost_round=6)
+    assert TELEMETRY.stats()["timing"]["enabled"] is True
+    assert bst_on.model_to_string() == off_str
+
+
+def test_timing_env_override(rng, monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_TIMING", "1")
+    X, y = make_binary(rng, n=300)
+    lgb.train(_params(seed=1), lgb.Dataset(X, y), num_boost_round=2)
+    assert TELEMETRY.stats()["timing"]["enabled"] is True
+
+
+# ------------------------------------------------------- profiler window
+
+
+class _FakeProfiler:
+    def __init__(self, monkeypatch):
+        self.starts, self.stops = [], []
+        import jax
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda path: self.starts.append(path))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: self.stops.append(True))
+
+
+def test_profile_window_opens_and_closes_exactly_once(rng, monkeypatch):
+    fake = _FakeProfiler(monkeypatch)
+    X, y = make_binary(rng, n=400)
+    lgb.train(_params(tpu_boost_chunk=4, profile_window="1:3", seed=5),
+              lgb.Dataset(X, y), num_boost_round=8)
+    assert len(fake.starts) == 1
+    assert len(fake.stops) == 1
+    prof = TELEMETRY.stats()["timing"]["profile"]
+    assert prof["kind"] == "window"
+    assert prof["window"] == [1, 3]
+    assert prof["requested"] == [1, 3]
+    assert not PROFILE_WINDOW.is_open
+
+
+def test_profile_window_exception_safe_mid_training(rng, monkeypatch):
+    """A callback raising INSIDE the window must not leak an open jax
+    profiler session: the profile_session finally closes it, exactly
+    once."""
+    fake = _FakeProfiler(monkeypatch)
+    X, y = make_binary(rng, n=400)
+
+    def boom(env):
+        if env.iteration >= 1:
+            raise RuntimeError("mid-window failure")
+
+    with pytest.raises(RuntimeError, match="mid-window"):
+        lgb.train(_params(profile_window="1:6", seed=5),
+                  lgb.Dataset(X, y), num_boost_round=8,
+                  callbacks=[boom])
+    assert len(fake.starts) == 1
+    assert len(fake.stops) == 1
+    assert not PROFILE_WINDOW.is_open
+    prof = TELEMETRY.stats()["timing"]["profile"]
+    assert prof["kind"] == "window"
+
+
+def test_profile_window_bad_spec_disables(rng, monkeypatch):
+    fake = _FakeProfiler(monkeypatch)
+    X, y = make_binary(rng, n=300)
+    lgb.train(_params(profile_window="3:1", seed=2), lgb.Dataset(X, y),
+              num_boost_round=3)
+    assert fake.starts == [] and fake.stops == []
+
+
+# ------------------------------------------------- in-scan eval counters
+
+
+def test_eval_fetch_counters_separate_from_tree_fetches(rng):
+    """The in-scan eval metric-row fetch is counted under its own
+    transfer/eval_fetch_* counters — the pinned tree-fetch counters
+    (test_telemetry.test_fetch_counters_exact_for_two_chunk_run) are
+    untouched by attaching a valid set."""
+    X, y = make_binary(rng, n=600)
+    Xv, yv = make_binary(rng, n=200)
+    train = lgb.Dataset(X, y)
+    lgb.train(_params(tpu_boost_chunk=2, seed=11), train,
+              num_boost_round=4,
+              valid_sets=[lgb.Dataset(Xv, yv, reference=train)])
+    counters = TELEMETRY.stats()["counters"]
+    assert counters["transfer/eval_fetch_calls"] == 2
+    assert counters["transfer/eval_fetch_bytes"] > 0
+    assert counters["transfer/fetch_calls"] == 2
+
+
+# ------------------------------------------- health stream + run_monitor
+
+
+def test_dispatch_wall_in_health_stream_and_monitor_eta(rng, tmp_path):
+    stream = tmp_path / "run.health.jsonl"
+    X, y = make_binary(rng, n=500)
+    lgb.train(_params(tpu_boost_chunk=4, health_out=str(stream),
+                      device_timing=True, seed=9),
+              lgb.Dataset(X, y), num_boost_round=8)
+    walls = [rec.get("dispatch_wall_s")
+             for rec in map(json.loads, stream.read_text().splitlines())
+             if rec.get("kind") == "iter"]
+    assert len(walls) == 8
+    # the wall window lands on each chunk's FIRST iteration only
+    assert [w is not None for w in walls] == [True, False, False, False,
+                                             True, False, False, False]
+    assert all(w > 0 for w in walls if w is not None)
+
+    state = run_monitor.StreamState()
+    state.feed(stream.read_bytes())
+    out = run_monitor.render(state, str(stream))
+    assert "dispatch pace:" in out
+    assert "it/s" in out
+
+
+def test_monitor_eta_and_na_safety():
+    """ETA appears for an unfinished stream with measured walls, and an
+    older stream without dispatch_wall_s renders without the pace
+    line."""
+    def _stream(with_walls):
+        state = run_monitor.StreamState()
+        recs = [{"kind": "start", "schema": "lightgbm_tpu.health/v1",
+                 "num_iterations": 100}]
+        for i in range(0, 8, 4):
+            rec = {"kind": "iter", "iter": i + 3, "chunk": 4, "t": i * 1.0}
+            if with_walls:
+                rec["dispatch_wall_s"] = 0.5
+            recs.append(rec)
+        state.feed(("\n".join(json.dumps(r) for r in recs) + "\n")
+                   .encode())
+        return run_monitor.render(state, "x.jsonl")
+
+    out = _stream(True)
+    assert "dispatch pace: 8.00 it/s" in out
+    assert "ETA" in out
+    out = _stream(False)
+    assert "dispatch pace" not in out and "ETA" not in out
+
+
+# -------------------------------------------------- report + gate tools
+
+
+def test_trace_report_na_on_pre_v4_blob():
+    assert "timing: n/a" in trace_report.summarize({"version": 3})
+
+
+def test_trace_report_renders_timing_and_diff(rng):
+    X, y = make_binary(rng, n=400)
+    lgb.train(_params(tpu_boost_chunk=4, device_timing=True, seed=4),
+              lgb.Dataset(X, y), num_boost_round=4)
+    blob = TELEMETRY.stats()
+    out = trace_report.summarize(blob)
+    assert "timing (measured wall-to-ready" in out
+    assert "utilization (measured):" in out
+    d = trace_report.diff({"version": 3}, blob)
+    assert "timing (measured)" in d
+
+
+def test_bench_gate_latency_verdicts():
+    hist = [{"config": "c", "value": 10.0, "unit": "s",
+             "quality_ok": True, "dispatch_mean_s": 0.010}
+            for _ in range(4)]
+    ok = {"config": "c", "value": 10.0, "unit": "s", "quality_ok": True,
+          "dispatch_mean_s": 0.0105}
+    bad = dict(ok, dispatch_mean_s=0.013)
+    off = dict(ok, dispatch_mean_s=None)
+    assert not bench_gate.evaluate(hist + [ok])[0]
+    failures, _ = bench_gate.evaluate(hist + [bad])
+    assert failures and "dispatch latency" in failures[0]
+    assert not bench_gate.evaluate(hist + [off])[0]
+    # widening the tolerance admits the regression
+    assert not bench_gate.evaluate(hist + [bad], latency_tol=0.50)[0]
+    assert bench_gate.self_test() == 0

@@ -8,8 +8,11 @@ config, the newest record is compared against the median of the
 previous ``--window`` records, and the gate fails (exit 1) when
 
   * wall time regresses more than ``--wall-tol`` (default +15%),
-  * peak HBM regresses more than ``--hbm-tol`` (default +20%), or
-  * the quality gate flips from held to failed.
+  * peak HBM regresses more than ``--hbm-tol`` (default +20%),
+  * the quality gate flips from held to failed, or
+  * measured dispatch latency (``dispatch_mean_s``, recorded by runs
+    with ``device_timing=`` on) regresses more than ``--latency-tol``
+    (default +20%).
 
 A missing/empty trajectory, a config with no prior history, or records
 without comparable fields all PASS with a "no history" notice — the
@@ -63,7 +66,8 @@ def _config_of(rec):
     return rec.get("config") or rec.get("metric") or "?"
 
 
-def evaluate(records, window=5, wall_tol=0.15, hbm_tol=0.20):
+def evaluate(records, window=5, wall_tol=0.15, hbm_tol=0.20,
+             latency_tol=0.20):
     """(failures, notes) over the trajectory.  The newest record of each
     config is judged against the median of up to ``window`` prior
     records of the same config; everything older informs, never gates."""
@@ -118,11 +122,32 @@ def evaluate(records, window=5, wall_tol=0.15, hbm_tol=0.20):
                     f"{config}: peak HBM {hbm:.0f}B regressed "
                     f"{hbm / hbm_base - 1.0:+.1%} over median "
                     f"{hbm_base:.0f}B (tol +{hbm_tol:.0%})")
+        # measured dispatch latency (device_timing runs only): wall time
+        # can hide a slower dispatch behind async pipelining — the
+        # measured mean cannot
+        lat = newest.get("dispatch_mean_s")
+        lat_base = _median([r["dispatch_mean_s"] for r in history
+                            if isinstance(r.get("dispatch_mean_s"),
+                                          (int, float))
+                            and r["dispatch_mean_s"] > 0])
+        if (isinstance(lat, (int, float)) and lat > 0
+                and lat_base is not None):
+            if lat / lat_base > 1.0 + latency_tol:
+                failures.append(
+                    f"{config}: dispatch latency {lat * 1e3:.3f}ms "
+                    f"regressed {lat / lat_base - 1.0:+.1%} over median "
+                    f"{lat_base * 1e3:.3f}ms (tol +{latency_tol:.0%})")
+            else:
+                notes.append(f"{config}: dispatch latency "
+                             f"{lat * 1e3:.3f}ms vs median "
+                             f"{lat_base * 1e3:.3f}ms — ok")
     return failures, notes
 
 
-def gate(path, window=5, wall_tol=0.15, hbm_tol=0.20, out=sys.stdout):
-    failures, notes = evaluate(load(path), window, wall_tol, hbm_tol)
+def gate(path, window=5, wall_tol=0.15, hbm_tol=0.20, latency_tol=0.20,
+         out=sys.stdout):
+    failures, notes = evaluate(load(path), window, wall_tol, hbm_tol,
+                               latency_tol)
     for note in notes:
         out.write(f"bench_gate: {note}\n")
     for failure in failures:
@@ -135,7 +160,8 @@ def gate(path, window=5, wall_tol=0.15, hbm_tol=0.20, out=sys.stdout):
 def self_test():
     """Fast smoke of the gate logic (no files, no history mutation)."""
     hist = [{"config": "c", "value": 10.0 + 0.1 * i, "unit": "s",
-             "quality_ok": True, "peak_hbm_bytes": 1000}
+             "quality_ok": True, "peak_hbm_bytes": 1000,
+             "dispatch_mean_s": 0.010 + 0.0001 * i}
             for i in range(4)]
 
     def verdict(newest):
@@ -162,6 +188,18 @@ def self_test():
         ("null fields pass", not verdict(
             {"config": "c", "value": None, "unit": "s",
              "quality_ok": True, "peak_hbm_bytes": None})),
+        ("steady dispatch latency passes", not verdict(
+            {"config": "c", "value": 10.2, "unit": "s",
+             "quality_ok": True, "peak_hbm_bytes": 1000,
+             "dispatch_mean_s": 0.0102})),
+        ("dispatch latency regression fails", verdict(
+            {"config": "c", "value": 10.2, "unit": "s",
+             "quality_ok": True, "peak_hbm_bytes": 1000,
+             "dispatch_mean_s": 0.020})),
+        ("timing-off record passes latency gate", not verdict(
+            {"config": "c", "value": 10.2, "unit": "s",
+             "quality_ok": True, "peak_hbm_bytes": 1000,
+             "dispatch_mean_s": None})),
     ]
     bad = [name for name, ok in checks if not ok]
     for name, ok in checks:
@@ -182,12 +220,16 @@ def main(argv=None):
                     help="allowed wall-time regression (default 0.15)")
     ap.add_argument("--hbm-tol", type=float, default=0.20,
                     help="allowed peak-HBM regression (default 0.20)")
+    ap.add_argument("--latency-tol", type=float, default=0.20,
+                    help="allowed measured dispatch-latency regression "
+                         "(default 0.20; only gates device_timing runs)")
     ap.add_argument("--self-test", action="store_true",
                     help="run the built-in smoke checks and exit")
     args = ap.parse_args(argv)
     if args.self_test:
         return self_test()
-    return gate(args.path, args.window, args.wall_tol, args.hbm_tol)
+    return gate(args.path, args.window, args.wall_tol, args.hbm_tol,
+                args.latency_tol)
 
 
 if __name__ == "__main__":

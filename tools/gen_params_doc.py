@@ -88,9 +88,27 @@ def generate() -> str:
         "  a set `LIGHTGBM_TPU_TRACE_JSON=<path>` forces level >= 2 and",
         "  writes the trace there.",
         "- `metrics_out` — CLI training only: write the versioned",
-        "  telemetry JSON blob (schema `lightgbm_tpu.metrics/v3`) to this",
+        "  telemetry JSON blob (schema `lightgbm_tpu.metrics/v4`) to this",
         "  path after training.  Written even when training crashes, so",
         "  the blob's `faults` section survives for post-mortems.",
+        "- `device_timing` — measured per-dispatch device timing",
+        "  (default `false`): each instrumented jit seam's dispatch is",
+        "  synced wall-to-ready and accumulated into the metrics blob's",
+        "  `timing` section (per-label count/mean/p50/p99 + dispatch",
+        "  gaps, and measured-vs-estimated utilization).  Values and",
+        "  models are unchanged, but the sync serializes the async",
+        "  pipeline — an opt-in measurement mode, never a default.  The",
+        "  `LIGHTGBM_TPU_DEVICE_TIMING` env var overrides.  Runtime-only:",
+        "  never serialized into the model.",
+        "- `profile_window` — windowed programmatic jax-profiler capture",
+        "  (`START:END`, half-open boosting-iteration span): the trace",
+        "  opens/closes exactly at those iterations, chunk dispatches",
+        "  are split at the boundaries, and the artifact path + actual",
+        "  window are recorded in the metrics blob's `timing.profile`.",
+        "  The `LIGHTGBM_TPU_PROFILE_WINDOW` env var overrides; the",
+        "  artifact dir is `LIGHTGBM_TPU_PROFILE_DIR` or",
+        "  `lightgbm_tpu.profile`.  Runtime-only: never serialized into",
+        "  the model.",
         "- `health_out` — stream the run-health JSONL there during",
         "  training (schema `lightgbm_tpu.health/v1`): per-iteration",
         "  gradient/hessian stats, tree shape, chunk size, HBM, eval/",

@@ -80,6 +80,32 @@ def _fmt_bytes(n):
     return f"{n:.1f}GB"
 
 
+def _dispatch_rate(state: StreamState):
+    """EWMA boosting rate (iterations/sec) from the per-chunk measured
+    ``dispatch_wall_s`` fields (v4 streams with device timing/chunking;
+    None on older streams — the caller falls back to stream-window
+    timestamps)."""
+    ewma = None
+    for it in sorted(state.iters):
+        rec = state.iters[it]
+        wall = rec.get("dispatch_wall_s")
+        chunk = rec.get("chunk") or 1
+        if not isinstance(wall, (int, float)) or wall <= 0:
+            continue
+        rate = float(chunk) / float(wall)
+        ewma = rate if ewma is None else 0.7 * ewma + 0.3 * rate
+    return ewma
+
+
+def _fmt_eta(seconds: float) -> str:
+    seconds = int(round(seconds))
+    if seconds >= 3600:
+        return f"{seconds // 3600}h{(seconds % 3600) // 60:02d}m"
+    if seconds >= 60:
+        return f"{seconds // 60}m{seconds % 60:02d}s"
+    return f"{seconds}s"
+
+
 def render(state: StreamState, path: str) -> str:
     lines = []
     if state.summary is not None:
@@ -110,6 +136,13 @@ def render(state: StreamState, path: str) -> str:
         if chunk:
             progress += f", chunk={chunk}"
         lines.append("  " + progress)
+        ewma = _dispatch_rate(state)
+        if ewma is not None and ewma > 0:
+            pace = (f"  dispatch pace: {ewma:.2f} it/s "
+                    "(EWMA of measured chunk walls)")
+            if total and state.summary is None and done < total:
+                pace += f", ETA {_fmt_eta((int(total) - done) / ewma)}"
+            lines.append(pace)
         rec = state.iters[last]
         trees = rec.get("trees") or []
         if trees:

@@ -4,7 +4,7 @@ Input: a metrics dict as produced by ``TELEMETRY.metrics_blob()`` /
 ``Booster.get_stats()`` — the blob the CLI writes for ``metrics_out=``,
 ``bench.py`` / ``bench_suite.py`` embed under ``"metrics"``, and
 ``engine.train`` attaches as ``booster.train_stats``.  The current
-``lightgbm_tpu.metrics/v3`` schema and the older v2/v1 blobs are all
+``lightgbm_tpu.metrics/v4`` schema and the older v3/v2/v1 blobs are all
 accepted: every section is optional and renders as ``n/a`` when absent.
 
 Usage:
@@ -12,13 +12,15 @@ Usage:
   python tools/trace_report.py BENCH_r05.json        # a bench record
                                                      # (reads .metrics)
   python tools/trace_report.py --diff a.json b.json  # phase/counter/
-                                                     # memory/cost deltas
+                                                     # memory/cost/
+                                                     # timing deltas
 
 Prints top phases, transfer bytes, compile counters/seconds, network
 collective counters, the iteration count, (v2) the HBM memory envelope
-and XLA cost-analysis utilization digest, and (v3) the run-health
-stream digest — the digest VERDICT / PERF_NOTES rounds quote instead of
-regex-parsing stderr tails.
+and XLA cost-analysis utilization digest, (v3) the run-health stream
+digest, and (v4) the measured dispatch-timing table with
+measured-vs-estimated utilization — the digest VERDICT / PERF_NOTES
+rounds quote instead of regex-parsing stderr tails.
 """
 
 import json
@@ -117,6 +119,7 @@ def summarize(stats: dict, top: int = 6) -> str:
     lines.extend(_memory_lines(stats))
     lines.extend(_cost_lines(stats))
     lines.extend(_utilization_lines(stats))
+    lines.extend(_timing_lines(stats))
     lines.extend(_fault_lines(stats))
     lines.extend(_health_lines(stats))
     return "\n".join(lines)
@@ -235,6 +238,60 @@ def _utilization_lines(stats: dict) -> list:
             "bound on achieved rates)"]
 
 
+def _timing_lines(stats: dict, top: int = 6) -> list:
+    timing = stats.get("timing")
+    if not timing or not timing.get("enabled"):
+        out = ["  timing: n/a (device_timing off, or pre-v4 blob)"]
+        prof = (timing or {}).get("profile")
+        if prof:
+            out.append(_profile_line(prof))
+        return out
+    labels = timing.get("labels") or {}
+    ranked = sorted(labels.items(),
+                    key=lambda kv: -kv[1].get("total_s", 0.0))[:top]
+    out = [f"  timing (measured wall-to-ready, {len(labels)} seams): "
+           f"{timing.get('total_s', 0.0):.3f}s device-synced"]
+    for name, e in ranked:
+        line = (f"    {name}: {e.get('count', 0)} x "
+                f"{e.get('mean_s', 0.0) * 1e3:.3f}ms mean "
+                f"(p50 {e.get('p50_s', 0.0) * 1e3:.3f} / "
+                f"p99 {e.get('p99_s', 0.0) * 1e3:.3f} / "
+                f"max {e.get('max_s', 0.0) * 1e3:.3f}ms)")
+        if e.get("gap_mean_s") is not None:
+            line += f", gap {e['gap_mean_s'] * 1e3:.3f}ms mean"
+        out.append(line)
+    # measured vs estimated: static XLA FLOPs over the MEASURED seconds
+    # next to the wall-window estimate — the gap is dispatch overhead +
+    # how far the estimate's upper bound sits from achieved rates
+    mfps = timing.get("measured_flops_per_s")
+    efps = (stats.get("cost") or {}).get("est_flops_per_s")
+    if mfps is not None:
+        line = f"  utilization (measured): {_fmt_rate(mfps, 'FLOP/s')}"
+        mbps = timing.get("measured_bytes_per_s")
+        if mbps is not None:
+            line += f", {_fmt_rate(mbps, 'B/s')} accessed"
+        if efps:
+            line += (f"  [{100.0 * mfps / efps:.1f}% of the "
+                     "wall-window estimate]")
+        out.append(line)
+    prof = timing.get("profile")
+    if prof:
+        out.append(_profile_line(prof))
+    return out
+
+
+def _profile_line(prof: dict) -> str:
+    line = f"  profile: {prof.get('kind', '?')} -> {prof.get('dir', '?')}"
+    window = prof.get("window")
+    if window:
+        line += f" (iterations [{window[0]}, {window[1]})"
+        req = prof.get("requested")
+        if req and list(req) != list(window):
+            line += f", requested [{req[0]}, {req[1]})"
+        line += ")"
+    return line
+
+
 # ------------------------------------------------------------------ diff
 def _phase_map(stats: dict) -> dict:
     return {k: v.get("seconds", 0.0)
@@ -254,6 +311,20 @@ def _cost_scalars(stats: dict) -> dict:
     for name, e in (cost.get("labels") or {}).items():
         out[f"{name}.calls"] = e.get("calls", 0)
         out[f"{name}.flops_total"] = e.get("flops_total", 0.0)
+    return out
+
+
+def _timing_scalars(stats: dict) -> dict:
+    timing = stats.get("timing") or {}
+    out = {}
+    if timing.get("total_s") is not None:
+        out["total_s"] = timing["total_s"]
+    for k in ("measured_flops_per_s", "measured_bytes_per_s"):
+        if timing.get(k) is not None:
+            out[k] = timing[k]
+    for name, e in (timing.get("labels") or {}).items():
+        out[f"{name}.mean_s"] = e.get("mean_s", 0.0)
+        out[f"{name}.p99_s"] = e.get("p99_s", 0.0)
     return out
 
 
@@ -295,6 +366,8 @@ def diff(a: dict, b: dict) -> str:
                                _mem_scalars(b), _fmt_bytes))
     lines.extend(_diff_section("cost", _cost_scalars(a),
                                _cost_scalars(b), num))
+    lines.extend(_diff_section("timing (measured)", _timing_scalars(a),
+                               _timing_scalars(b), num))
     return "\n".join(lines)
 
 

@@ -6,7 +6,10 @@
 #
 # Usage: bash tools/verify_t1.sh             (from anywhere; cd's to repo root)
 #        bash tools/verify_t1.sh --with-gate (also run the perf-regression
-#                                             gate's self-test afterwards)
+#                                             gate's self-test afterwards —
+#                                             covers the wall/HBM/quality
+#                                             checks AND the measured
+#                                             dispatch-latency gate)
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "$1" = "--with-gate" ]; then
