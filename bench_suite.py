@@ -24,6 +24,14 @@ collection is written to BENCH_SUITE.json:
                             CPU mesh (the ICI path compiled and executed;
                             one real chip means no measured multi-chip
                             scaling claim) with a serial-parity gate.
+  * spill_ab              — the same regression config trained twice:
+                            data_in_hbm=resident vs forced host-spill
+                            (out-of-core row-block streaming,
+                            docs/ROBUSTNESS.md rung 4).  One record with
+                            the spill wall as the gated value plus the
+                            resident wall and peak-HBM deltas; quality_ok
+                            additionally requires the two models to be
+                            byte-identical (sha256 of model_to_string).
 
 Usage:  python bench_suite.py [config ...]    (default: all four)
         python bench_suite.py --gate [config ...]
@@ -57,6 +65,10 @@ TIERS = {
     # timing claim — tiers stay tiny and the record says virtual_mesh
     "feature_parallel": [("cpu-mesh", 20_000, 1, 2, 1800),
                          ("cpu-mesh", 5_000, 1, 2, 900)],
+    # two children per tier (resident + forced spill), so the per-child
+    # timeout stays the usual single-run budget
+    "spill_ab": [("tpu", 1_000_000, 2, 4, 2400),
+                 ("cpu", 10_000, 1, 2, 900)],
 }
 
 # published reference wall-clocks for vs_baseline (500 iters, CPU,
@@ -66,6 +78,7 @@ REF_500_ITERS_S = {
     "multiclass_cat": None,
     "lambdarank_msltr": 215.320,
     "feature_parallel": None,
+    "spill_ab": None,
 }
 REF_ROWS = {"lambdarank_msltr": 2_270_296}
 TOTAL_ITERS_REF = 500
@@ -174,7 +187,8 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
     rng = np.random.RandomState(7)
     gen = {"goss_regression": _gen_goss, "multiclass_cat": _gen_multiclass,
            "lambdarank_msltr": _gen_rank,
-           "feature_parallel": _gen_goss}[config]
+           "feature_parallel": _gen_goss,
+           "spill_ab": _gen_goss}[config]
     X, y, extra = gen(rng, n_rows)
     params = {"learning_rate": 0.1, "num_leaves": 255, "max_bin": 63,
               "min_sum_hessian_in_leaf": 100.0, "verbose": -1,
@@ -187,6 +201,11 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
               "tpu_boost_chunk": int(os.environ.get(
                   "LIGHTGBM_TPU_BOOST_CHUNK", "0"))}
     params.update(extra.get("params", {}))
+    # spill A/B hook: the parent pins the memory tier per child
+    # (runtime-only knob — it never reaches the serialized model)
+    dib = os.environ.get("SUITE_DATA_IN_HBM")
+    if dib:
+        params["data_in_hbm"] = dib
     if config == "goss_regression":
         params["boosting"] = "goss"
     if config == "multiclass_cat":
@@ -238,7 +257,7 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
     pred = bst.predict(X[:200_000])
     quality: dict = {}
     ok = True
-    if config in ("goss_regression", "feature_parallel"):
+    if config in ("goss_regression", "feature_parallel", "spill_ab"):
         l2 = float(np.mean((pred - y[:len(pred)]) ** 2))
         quality["l2"] = round(l2, 5)
         ok = l2 < 0.5 * float(np.var(y))
@@ -276,6 +295,11 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
         # 0.846 at a THIRD of the gate budget)
         ok = nd > 0.80
     backend = jax.default_backend()
+    # cheap cross-process identity witness: the spill A/B parent compares
+    # the resident and forced-spill children by this digest
+    import hashlib
+    model_sha = hashlib.sha256(
+        bst.model_to_string().encode()).hexdigest()
     print(RESULT_TAG + json.dumps({
         "config": config, "rows": n_rows, "backend": backend,
         "per_iter": round(per_iter, 5), "setup_s": round(t_setup, 2),
@@ -283,6 +307,7 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
         "quality_ok": bool(ok),
         "impl": _impl_label(bst, params["tpu_tree_impl"]),
         "chunk": chunk,
+        "model_sha": model_sha,
         "metrics": metrics_blob,
     }))
 
@@ -322,7 +347,72 @@ def _run_child_record(config: str, platform: str, rows: int, warmup: int,
     return None
 
 
+def _peak_hbm(rec: dict) -> int | None:
+    return ((rec.get("metrics") or {}).get("memory")
+            or {}).get("peak_bytes_in_use")
+
+
+def _run_spill_ab(probe_ok: bool) -> dict | None:
+    """Resident-vs-forced-spill A/B on the same config and data: one
+    trajectory record whose gated value is the SPILL wall (so an
+    out-of-core streaming regression trips tools/bench_gate.py), with
+    the resident wall and the peak-HBM delta riding along.  quality_ok
+    also demands byte-identical models — the out-of-core tier's core
+    contract."""
+    config = "spill_ab"
+    for platform, rows, warmup, measure, timeout_s in TIERS[config]:
+        if platform == "tpu" and not probe_ok:
+            continue
+        env = (_cpu_env() if platform.startswith("cpu")
+               else dict(os.environ))
+        pair = {}
+        for tier in ("resident", "spill"):
+            e = dict(env)
+            e["SUITE_DATA_IN_HBM"] = tier
+            pair[tier] = _run_child_record(config, platform, rows,
+                                           warmup, measure, timeout_s, e)
+        res, spl = pair["resident"], pair["spill"]
+        if res is None or spl is None:
+            continue
+        total_res = res["per_iter"] * TOTAL_ITERS_REF
+        total_spl = spl["per_iter"] * TOTAL_ITERS_REF
+        bit_identical = (res.get("model_sha") is not None
+                         and res.get("model_sha") == spl.get("model_sha"))
+        out = {
+            "config": config,
+            "metric": f"{config}_{spl['rows']}r_500iter_train_time_"
+                      f"{spl['backend']}_spill",
+            "value": round(total_spl, 2),
+            "unit": "s",
+            "impl": spl["impl"],
+            "chunk": spl.get("chunk", 1),
+            "quality": dict(
+                spl["quality"],
+                spill_wall_ratio=round(total_spl / max(total_res, 1e-9),
+                                       3),
+                bit_identical=bit_identical),
+            "quality_ok": bool(spl["quality_ok"] and res["quality_ok"]
+                               and bit_identical),
+            "resident_value": round(total_res, 2),
+            "metrics": spl.get("metrics"),
+        }
+        pr, ps = _peak_hbm(res), _peak_hbm(spl)
+        if pr is not None and ps is not None:
+            out["resident_peak_hbm_bytes"] = int(pr)
+            out["spill_peak_hbm_bytes"] = int(ps)
+            out["peak_hbm_delta_bytes"] = int(ps) - int(pr)
+        if spl["backend"] == "cpu" and platform == "tpu":
+            out["fallback"] = True
+        if platform.startswith("cpu") and "tpu" in (
+                t[0] for t in TIERS[config]):
+            out["fallback"] = True
+        return out
+    return None
+
+
 def run_config(config: str, probe_ok: bool) -> dict | None:
+    if config == "spill_ab":
+        return _run_spill_ab(probe_ok)
     for platform, rows, warmup, measure, timeout_s in TIERS[config]:
         if platform == "tpu" and not probe_ok:
             continue
@@ -402,6 +492,12 @@ def _append_trajectory(results: list) -> None:
             tname = max(tlabels, key=lambda k: tlabels[k].get(
                 "total_s", 0.0)) if tlabels else None
             tentry = tlabels.get(tname) or {}
+            # spill A/B records carry their resident-vs-spill deltas into
+            # the trajectory; absent on every other config
+            extra = {k: r[k] for k in ("resident_value",
+                                       "resident_peak_hbm_bytes",
+                                       "spill_peak_hbm_bytes",
+                                       "peak_hbm_delta_bytes") if k in r}
             fh.write(json.dumps({
                 "schema": "lightgbm_tpu.trajectory/v1",
                 "ts": round(time.time(), 3),
@@ -421,6 +517,7 @@ def _append_trajectory(results: list) -> None:
                 "dispatch_p99_s": tentry.get("p99_s"),
                 "measured_flops_per_s": timing.get(
                     "measured_flops_per_s"),
+                **extra,
             }) + "\n")
 
 

@@ -248,6 +248,16 @@ _PARAMS: Dict[str, _P] = {
     # deterministic fault injection spec (same grammar as the
     # LIGHTGBM_TPU_FAULTS env var, which wins per-site); "" = off
     "fault_injection": _P(""),
+    # where the binned training matrix lives during boosting
+    # (data/hostspill.py): "auto" = admission-check the estimated
+    # working set against the device's reported HBM and start in the
+    # host-spill (out-of-core) tier only when it does not fit;
+    # "resident" = always keep it in HBM and never spill (the ladder
+    # then ends at chunk size 1); "spill" = force the host-spill tier:
+    # the matrix stays in host memory and is streamed into HBM as
+    # fixed-order row-blocks per dispatch window.  Bit-identical models
+    # either way.  Runtime-only: never serialized into the model
+    "data_in_hbm": _P("auto"),
 }
 
 # runtime-only knobs excluded from a saved model's ``parameters:``
@@ -256,7 +266,7 @@ _PARAMS: Dict[str, _P] = {
 # an uninterrupted one
 RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection",
                                  "compile_cache", "device_timing",
-                                 "profile_window"])
+                                 "profile_window", "data_in_hbm"])
 
 # alias -> canonical name
 ALIAS_TABLE: Dict[str, str] = {}
@@ -441,6 +451,11 @@ class Config:
         else:
             raise ValueError(f"Unknown tree learner type {self.tree_learner}")
         self.tree_learner = tl
+        dib = str(self.data_in_hbm).strip().lower() or "auto"
+        if dib not in ("auto", "resident", "spill"):
+            raise ValueError("data_in_hbm must be one of auto, resident, "
+                             f"spill (got {self.data_in_hbm!r})")
+        self.data_in_hbm = dib
 
     # -- accessors --
     def to_dict(self) -> Dict[str, Any]:

@@ -447,6 +447,35 @@ class TpuDataset:
         hits = np.nonzero(self.used_feature_indices == real_feature)[0]
         return int(hits[0]) if len(hits) else -1
 
+    def host_binned(self) -> np.ndarray:
+        """Row-major [N, F] host bin matrix — the exact byte image
+        ``device_binned`` uploads (shared with the host-spill store so
+        resident and spilled training see identical device bytes)."""
+        return self.binned
+
+    def host_binned_T(self, row_multiple: int = 1,
+                      packed4: bool = False) -> np.ndarray:
+        """Host-side feature-major training layout — the exact byte
+        image ``device_binned_T`` uploads (see there for the layout
+        contract); factored out so the host-spill store streams the
+        same bytes the resident path would."""
+        npad = (-self.num_data) % row_multiple
+        t = np.ascontiguousarray(self.binned.T)
+        if npad:
+            t = np.pad(t, ((0, 0), (0, npad)))
+        if packed4:
+            from ..ops.pallas_histogram import pack_bins_4bit
+            t = pack_bins_4bit(t)
+        return t
+
+    def drop_device_cache(self) -> None:
+        """Release the cached device copies of the bin matrix (the
+        host-spill tier streams from the host arrays instead; keeping
+        the device cache alive would defeat the spill)."""
+        self._device_binned = None
+        self._device_binned_T = None
+        self._device_binned_T_key = None
+
     def device_binned(self):
         """The bin matrix as a device array (uploaded once, cached)."""
         import jax.numpy as jnp
@@ -467,13 +496,7 @@ class TpuDataset:
         import jax.numpy as jnp
         key = getattr(self, "_device_binned_T_key", None)
         if key != (row_multiple, packed4):
-            npad = (-self.num_data) % row_multiple
-            t = np.ascontiguousarray(self.binned.T)
-            if npad:
-                t = np.pad(t, ((0, 0), (0, npad)))
-            if packed4:
-                from ..ops.pallas_histogram import pack_bins_4bit
-                t = pack_bins_4bit(t)
+            t = self.host_binned_T(row_multiple, packed4)
             from ..utils.telemetry import TELEMETRY
             TELEMETRY.counter_add("transfer/h2d_bytes", int(t.nbytes))
             self._device_binned_T = jnp.asarray(t)

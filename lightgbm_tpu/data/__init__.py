@@ -1,0 +1,5 @@
+"""Out-of-core data tiers: host-resident bin storage streamed to HBM."""
+
+from .hostspill import HostSpillStore
+
+__all__ = ["HostSpillStore"]

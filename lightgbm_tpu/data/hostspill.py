@@ -1,0 +1,173 @@
+"""Host-spill (out-of-core) storage for the binned training matrix.
+
+The final rung of the memory-pressure recovery ladder
+(docs/ROBUSTNESS.md): when the binned matrix cannot stay resident in
+HBM — the PR 4 chunk ladder bottomed out at 1, or the proactive
+admission check said it never fit — the matrix moves to a
+``HostSpillStore``: the exact host-side byte image that
+``TpuDataset.device_binned()`` / ``device_binned_T()`` would upload,
+split into fixed-order row-blocks.  Each dispatch window reassembles
+the byte-identical device matrix by streaming the blocks
+double-buffered: ``jax.device_put`` of block t+1 is issued before
+block t is folded into the preallocated device buffer (a donated
+jitted ``dynamic_update_slice_in_dim``), so the next host->device DMA
+overlaps the current fold.  Because the reassembled matrix is
+byte-identical to the resident upload and the bins are integers, every
+downstream kernel sees identical inputs — spilled and resident
+training produce **bit-identical models** by construction (this is the
+"Out-of-Core GPU Gradient Boosting" posture of arxiv 2005.09148,
+adapted to the lax.scan chunk loop: the matrix is resident DURING a
+dispatch window and released between windows, which is what recovers
+fragmentation and between-window working-set headroom).
+
+Fault site ``oocore/h2d`` fires per block transfer (and at the
+resident upload seam in models/gbdt.py), making the escalation path
+deterministically testable.
+
+Env knobs:
+  LIGHTGBM_TPU_SPILL_BLOCK_MB  target block size in MiB (default 64)
+  LIGHTGBM_TPU_SPILL_MMAP      directory: back the host matrix with a
+                               memory-mapped .npy instead of RAM
+"""
+
+import os
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from ..utils.faults import FAULTS, oom_error
+from ..utils.telemetry import TELEMETRY
+
+DEFAULT_BLOCK_BYTES = 64 << 20
+
+# one jitted fold per row axis; the two block shapes (full + tail)
+# compile once each because the start offset enters as a traced scalar
+_FOLDS = {}
+
+
+def _fold_for(axis: int):
+    if axis not in _FOLDS:
+        import jax
+
+        def fold(buf, blk, start):
+            return jax.lax.dynamic_update_slice_in_dim(buf, blk, start,
+                                                       axis=axis)
+
+        _FOLDS[axis] = jax.jit(fold, donate_argnums=(0,))
+    return _FOLDS[axis]
+
+
+def _block_bytes_from_env() -> int:
+    raw = os.environ.get("LIGHTGBM_TPU_SPILL_BLOCK_MB", "")
+    try:
+        mb = float(raw)
+    except ValueError:
+        mb = 0.0
+    return int(mb * (1 << 20)) if mb > 0 else DEFAULT_BLOCK_BYTES
+
+
+class HostSpillStore:
+    """Fixed-order row-block view of one host bin matrix.
+
+    ``mat`` is the exact array the resident path would upload (row-major
+    [N, F], or the feature-major padded/packed [F', Npad] training
+    layout); ``row_axis`` is the axis that indexes rows.  Blocks are
+    contiguous slices along that axis in ascending order — the order is
+    deterministic and the reassembled device matrix is byte-identical
+    to ``jnp.asarray(mat)``, so bit-identity of the trained model needs
+    no further argument.
+    """
+
+    def __init__(self, mat: np.ndarray, row_axis: int, block_rows: int,
+                 mmap_path: Optional[str] = None):
+        self.mat = mat
+        self.row_axis = int(row_axis)
+        self.shape = tuple(mat.shape)
+        self.dtype = mat.dtype
+        self.nbytes = int(mat.nbytes)
+        self.num_rows = int(mat.shape[self.row_axis])
+        self.block_rows = max(1, int(block_rows))
+        self.num_blocks = -(-self.num_rows // self.block_rows)
+        self.mmap_path = mmap_path
+
+    # ------------------------------------------------------ construction
+    @classmethod
+    def from_matrix(cls, mat: np.ndarray, row_axis: int,
+                    block_bytes: Optional[int] = None,
+                    mmap_dir: Optional[str] = None) -> "HostSpillStore":
+        """Build a store over ``mat``; block size targets ``block_bytes``
+        (env LIGHTGBM_TPU_SPILL_BLOCK_MB, default 64MiB) per transfer.
+        ``mmap_dir`` (env LIGHTGBM_TPU_SPILL_MMAP) rehomes the matrix
+        into a memory-mapped .npy so the host copy is pageable too; the
+        file is unlinked immediately (the mapping keeps it alive), so
+        nothing leaks on any exit path."""
+        if block_bytes is None:
+            block_bytes = _block_bytes_from_env()
+        rows = int(mat.shape[row_axis])
+        row_bytes = max(1, mat.nbytes // max(1, rows))
+        block_rows = min(rows, max(1, block_bytes // row_bytes))
+        mmap_path = None
+        if mmap_dir is None:
+            mmap_dir = os.environ.get("LIGHTGBM_TPU_SPILL_MMAP") or None
+        if mmap_dir:
+            fd, path = tempfile.mkstemp(suffix=".npy", prefix="spill_",
+                                        dir=mmap_dir)
+            os.close(fd)
+            np.save(path, mat)
+            mat = np.load(path, mmap_mode="r")
+            mmap_path = path
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        return cls(mat, row_axis, block_rows, mmap_path=mmap_path)
+
+    # ---------------------------------------------------------- blocks
+    def block_bounds(self, i: int):
+        a = i * self.block_rows
+        return a, min(a + self.block_rows, self.num_rows)
+
+    def block(self, i: int) -> np.ndarray:
+        """Block ``i`` as a contiguous host array (one block's copy at a
+        time — the only transient the spill tier materializes)."""
+        a, b = self.block_bounds(i)
+        sl = [slice(None)] * self.mat.ndim
+        sl[self.row_axis] = slice(a, b)
+        return np.ascontiguousarray(self.mat[tuple(sl)])
+
+    # ------------------------------------------------------- streaming
+    def _put_block(self, i: int):
+        """Probe the injection site, then start block ``i``'s
+        host->device transfer (async on TPU; sync-but-correct on CPU)."""
+        import jax
+        if FAULTS.enabled:
+            FAULTS.maybe_raise("oocore/h2d", oom_error)
+        blk = self.block(i)
+        arr = jax.device_put(blk)
+        TELEMETRY.counter_add("oocore/h2d_bytes", int(blk.nbytes))
+        TELEMETRY.counter_add("oocore/h2d_blocks")
+        return arr
+
+    def stream_to_device(self):
+        """Reassemble the full device matrix from the host blocks.
+
+        Double-buffered: block t+1's device_put is issued before block
+        t's fold, so (on TPU) the next DMA overlaps the current
+        dynamic_update_slice.  The fold donates the accumulating buffer,
+        so the device never holds more than matrix + one block + one
+        in-flight block.
+        """
+        import jax.numpy as jnp
+        fold = _fold_for(self.row_axis)
+        buf = jnp.zeros(self.shape, dtype=self.dtype)
+        if self.num_blocks == 0:
+            return buf
+        pending = self._put_block(0)
+        for i in range(self.num_blocks):
+            cur = pending
+            pending = (self._put_block(i + 1)
+                       if i + 1 < self.num_blocks else None)
+            start, _ = self.block_bounds(i)
+            buf = fold(buf, cur, start)
+        return buf

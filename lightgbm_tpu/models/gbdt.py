@@ -276,6 +276,11 @@ def _is_oom_error(e: BaseException) -> bool:
             or type(e).__name__ in ("XlaRuntimeError", "InternalError"))
 
 
+# proactive-admission headroom: start in the host-spill tier when the
+# estimated working set exceeds this fraction of the reported HBM
+_ADMIT_FRACTION = 0.9
+
+
 class GBDT:
     """Gradient Boosted Decision Trees (boosting='gbdt')."""
 
@@ -452,11 +457,42 @@ class GBDT:
             # (a stripe boundary inside a packed byte would split it).
             self._packed4 = self.num_bins <= 16 and not (
                 parallel and feature_mode)
-            self.bins = train_set.device_binned_T(rb * rows_D,
-                                                  packed4=self._packed4)
-            self._row_pad = int(self.bins.shape[1]) - self.num_data
+            self._bins_layout = ("T", rb * rows_D, self._packed4)
         else:
-            self.bins = train_set.device_binned()
+            self._bins_layout = ("rows", 0, False)
+        # memory-tier resolution (docs/ROBUSTNESS.md, rung 4 of the
+        # recovery ladder) BEFORE any upload: a run whose working set
+        # never fit starts out-of-core instead of crash-and-retrying
+        self._spill_store = None
+        self._bins_window = None
+        self._bins_hold = 0
+        self._spill_unavail = None
+        self._data_tier = self._resolve_data_tier(parallel)
+        if self._data_tier == "spill":
+            self._activate_spill(train_set)
+        else:
+            try:
+                # the resident upload is itself a bin-matrix h2d
+                # transfer, so it hosts the oocore/h2d injection site:
+                # "the matrix never fit" becomes deterministically
+                # reproducible
+                if FAULTS.enabled:
+                    FAULTS.maybe_raise("oocore/h2d", oom_error)
+                self._upload_resident_bins(train_set)
+            except Exception as e:
+                if (not _is_oom_error(e)
+                        or self._spill_blocked_reason(parallel)):
+                    raise
+                TELEMETRY.fault_event(
+                    "oom_spill", site="oocore/h2d", iteration=self.iter_,
+                    detail="resident bin-matrix upload hit "
+                           "RESOURCE_EXHAUSTED; spilling to host")
+                log_warning("uploading the bin matrix to HBM failed with "
+                            "RESOURCE_EXHAUSTED; continuing in the "
+                            "host-spill (out-of-core) tier")
+                self._data_tier = "spill"
+                TELEMETRY.set_data_tier("spill")
+                self._activate_spill(train_set)
         # rb threads through as the single block size for BOTH the bin
         # matrix padding and every kernel launch (grower + segment grower);
         # re-picking it at a kernel call site could desync from the padding
@@ -614,6 +650,179 @@ class GBDT:
         # chunk dispatch hits RESOURCE_EXHAUSTED the cap halves and
         # STICKS, so later chunks of the run skip the doomed sizes
         self._chunk_cap: Optional[int] = None
+
+    # ------------------------------------------------------- memory tiers
+    def _spill_blocked_reason(self, parallel: bool) -> Optional[str]:
+        """Why the host-spill tier is off the table for this run, or
+        None when it is available."""
+        if parallel or getattr(self, "_mesh", None) is not None:
+            return ("distributed learners keep the bin matrix sharded "
+                    "in HBM")
+        if str(getattr(self.config, "data_in_hbm", "auto")).strip() \
+                .lower() == "resident":
+            return "data_in_hbm=resident pins the bin matrix in HBM"
+        return None
+
+    def _estimate_working_set(self) -> int:
+        """Pre-dispatch estimate of the training working set in bytes:
+        the bin matrix in its resolved device layout, the f32 boosting
+        state (scores/grads/hessians per class, bag weights, leaf ids),
+        plus the largest CostJit ``memory_analysis`` working set already
+        on record (a resumed/warm process knows its compiled programs'
+        temp+argument+output bytes; a cold one contributes 0)."""
+        kind, rm, packed4 = self._bins_layout
+        ts = self.train_set
+        if kind == "T":
+            npad_rows = self.num_data + ((-self.num_data) % max(1, rm))
+            f = ts.num_columns
+            mat_bytes = (-(-f // 2) * npad_rows if packed4
+                         else f * npad_rows * ts.binned.dtype.itemsize)
+        else:
+            mat_bytes = int(ts.binned.nbytes)
+        state_bytes = 4 * self.num_data * (3 * self.num_tree_per_iteration
+                                           + 2)
+        return mat_bytes + state_bytes + TELEMETRY.cost_working_set()
+
+    def _resolve_data_tier(self, parallel: bool) -> str:
+        """data_in_hbm=auto|resident|spill -> this run's starting tier.
+
+        ``auto`` is the proactive admission check: estimated working
+        set vs the device's reported HBM capacity
+        (``TELEMETRY.device_memory_budget()``); backends without
+        allocator stats (CPU) stay resident.  The ``oocore/admit``
+        fault site forces the spill decision deterministically.  Every
+        spill decision lands in the telemetry faults section as an
+        ``oocore_admit`` event.  The tier is runtime-only state — it is
+        never serialized into models or snapshots."""
+        choice = str(getattr(self.config, "data_in_hbm", "auto")).strip() \
+            .lower()
+        blocked = self._spill_blocked_reason(parallel)
+        if blocked is not None:
+            if choice == "spill":
+                log_warning(f"data_in_hbm=spill ignored: {blocked}")
+            self._spill_unavail = blocked
+            TELEMETRY.set_data_tier("resident")
+            return "resident"
+        if choice == "spill":
+            TELEMETRY.fault_event("oocore_admit", site="oocore/admit",
+                                  iteration=self.iter_,
+                                  detail="forced by data_in_hbm=spill")
+            TELEMETRY.set_data_tier("spill")
+            return "spill"
+        tier, detail = "resident", ""
+        if FAULTS.enabled and FAULTS.check("oocore/admit"):
+            tier, detail = "spill", "injected admission failure"
+        else:
+            budget = TELEMETRY.device_memory_budget()
+            if budget:
+                need = self._estimate_working_set()
+                if need > _ADMIT_FRACTION * budget:
+                    tier = "spill"
+                    detail = (f"estimated working set ~{need} B vs "
+                              f"{budget} B reported HBM")
+        if tier == "spill":
+            TELEMETRY.fault_event("oocore_admit", site="oocore/admit",
+                                  iteration=self.iter_, detail=detail)
+            log_warning(f"admission check: {detail}; starting in the "
+                        "host-spill (out-of-core) tier")
+        TELEMETRY.set_data_tier(tier)
+        return tier
+
+    def _upload_resident_bins(self, train_set: TpuDataset) -> None:
+        """Resident tier: the cached whole-matrix device upload."""
+        kind, rm, packed4 = self._bins_layout
+        if kind == "T":
+            self.bins = train_set.device_binned_T(rm, packed4=packed4)
+            self._row_pad = int(self.bins.shape[1]) - self.num_data
+        else:
+            self.bins = train_set.device_binned()
+
+    def _activate_spill(self, train_set: TpuDataset) -> None:
+        """Move the bin matrix to the host-spill tier: build the
+        fixed-order row-block store over the exact bytes the resident
+        path would upload (bit-identity by construction), and drop
+        every resident device copy so its HBM is reclaimable."""
+        from ..data.hostspill import HostSpillStore
+        kind, rm, packed4 = self._bins_layout
+        if kind == "T":
+            mat = train_set.host_binned_T(rm, packed4=packed4)
+            self._row_pad = int(mat.shape[1]) - self.num_data
+            axis = 1
+        else:
+            mat = train_set.host_binned()
+            axis = 0
+        self._spill_store = HostSpillStore.from_matrix(mat, row_axis=axis)
+        self.bins = None
+        self._bins_window = None
+        train_set.drop_device_cache()
+        TELEMETRY.gauge_set("oocore/spill_bytes", self._spill_store.nbytes)
+        TELEMETRY.gauge_set("oocore/block_rows",
+                            self._spill_store.block_rows)
+
+    def _device_bins(self):
+        """The device bin matrix for the next dispatch.  Resident tier:
+        the cached upload.  Spill tier: stream the host row-blocks into
+        a fresh device matrix (data/hostspill.py) and keep it only for
+        the current dispatch window — train_chunk releases it on exit,
+        so between windows that HBM is reclaimable (the matrix IS
+        resident during a window; the win is between-window headroom
+        and allocator fragmentation recovery)."""
+        if self.bins is not None:
+            return self.bins
+        if self._bins_window is None:
+            with _PHASES.phase("h2d_stream"):
+                self._bins_window = self._spill_store.stream_to_device()
+        return self._bins_window
+
+    def _release_bins_window(self) -> None:
+        """Drop the spill tier's per-window device matrix (no-op when
+        resident: self.bins keeps the only reference there)."""
+        self._bins_window = None
+
+    def _donated_carries_deleted(self) -> bool:
+        """True when a failed dispatch consumed its donated score/key/
+        vscore buffers — there is no device state left to retry from."""
+        for buf in ((self.train_score, self._key)
+                    + tuple(self._vscores_dev or ())):
+            deleted = getattr(buf, "is_deleted", None)
+            if deleted is not None and deleted():
+                return True
+        return False
+
+    def _escalate_spill(self, err: BaseException) -> bool:
+        """Reactive rung 3->4 of the recovery ladder: the chunk-size
+        ladder bottomed out at 1 and dispatch still RESOURCE_EXHAUSTs —
+        move the bin matrix to the host-spill tier and let the caller
+        retry, instead of giving up.  Returns False (recording the
+        reason for _oom_exhausted) when the tier is unavailable or
+        already active."""
+        if getattr(self, "_data_tier", "resident") == "spill":
+            self._spill_unavail = "already at the host-spill tier"
+            return False
+        blocked = self._spill_blocked_reason(False)
+        if blocked is not None:
+            self._spill_unavail = blocked
+            return False
+        if self._donated_carries_deleted():
+            self._spill_unavail = ("the failed dispatch consumed its "
+                                   "donated score/key carries; no device "
+                                   "state left to retry from")
+            return False
+        # same recovery pattern as the PR 7 vscores invalidation: drop
+        # the device carry, re-upload from the host f64 truth at the
+        # next dispatch (outside the transfer guard)
+        self._vscores_dev = None
+        self._activate_spill(self.train_set)
+        self._data_tier = "spill"
+        TELEMETRY.set_data_tier("spill")
+        TELEMETRY.fault_event(
+            "oom_spill", site="chunk/oom", iteration=self.iter_,
+            detail="chunk ladder exhausted at size 1; bin matrix spilled "
+                   "to host (out-of-core tier)")
+        log_warning("dispatch still RESOURCE_EXHAUSTED at chunk size 1; "
+                    "spilling the bin matrix to host memory and streaming "
+                    "row-blocks per dispatch window (out-of-core tier)")
+        return True
 
     def _replay_model_scores(self, dataset: TpuDataset) -> np.ndarray:
         """[C, N] f64 raw scores of the current model on ``dataset``: the
@@ -1160,6 +1369,9 @@ class GBDT:
             return
         rec: Dict[str, Any] = {"iter": int(iter_idx),
                                "chunk": int(chunk_len)}
+        # memory tier of the bin matrix (resident / spill), so a live
+        # monitor can see an out-of-core escalation mid-run
+        rec["data_tier"] = getattr(self, "_data_tier", None) or "resident"
         if wall_s is not None:
             rec["dispatch_wall_s"] = round(float(wall_s), 6)
         tstats = []
@@ -1272,6 +1484,11 @@ class GBDT:
             self._poison_scores()
         it = self.iter_
         stop = self._train_one_iter_impl(grad, hess)
+        # per-iteration dispatch outside a chunk window: the spilled
+        # matrix is released per iteration (out-of-core pays one stream
+        # per dispatch window, by definition)
+        if getattr(self, "_bins_hold", 0) <= 0:
+            self._release_bins_window()
         self._guard_nonfinite(it)
         return stop
 
@@ -1315,6 +1532,7 @@ class GBDT:
                       else None)
             box[0] = grads
 
+        bins = self._device_bins()
         if use_async:
             items = []
             for k in range(C):
@@ -1327,7 +1545,7 @@ class GBDT:
                     member = jnp.pad(member, (0, self._row_pad))
                 with _PHASES.phase("grow") as box:
                     arrays, leaf_id, *stats = self._grow_fn(
-                        self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
+                        bins, g_k, h_k, member, self.fmeta, fmask, sub)
                     box[0] = leaf_id
                 _maybe_print_seg_stats(stats)
                 if self._row_pad:
@@ -1364,7 +1582,7 @@ class GBDT:
                 member = jnp.pad(member, (0, self._row_pad))
             with _PHASES.phase("grow") as box:
                 arrays, leaf_id, *stats = self._grow_fn(
-                    self.bins, g_k, h_k, member, self.fmeta, fmask, sub)
+                    bins, g_k, h_k, member, self.fmeta, fmask, sub)
                 box[0] = leaf_id
             _maybe_print_seg_stats(stats)
             if self._row_pad:
@@ -1430,11 +1648,12 @@ class GBDT:
             gstats = (_grad_stats(grads, hesss) if HEALTH.active
                       else None)
             box[0] = grads
+        bins = self._device_bins()
         roots = None
         if fused_roots is not None:
             with _PHASES.phase("roots"):
                 roots = fused_roots(grads, hesss, self.bag_weight,
-                                    self.bins)
+                                    bins)
         items = []
         for k in range(C):
             fmask = self._tree_feature_mask()
@@ -1446,7 +1665,7 @@ class GBDT:
                 extra = () if roots is None else (roots,)
                 self.train_score, ints_d, floats_d, stats_t = fused_step(
                     self.train_score, grads, hesss, self.bag_weight,
-                    self.bins, self.fmeta, fmask, sub,
+                    bins, self.fmeta, fmask, sub,
                     jnp.float32(self.shrinkage_rate), jnp.int32(k), *extra)
                 box[0] = self.train_score
             # instrumented parallel growers run inside the jitted step,
@@ -1544,40 +1763,54 @@ class GBDT:
             return self.train_one_iter()
         self._boost_from_average()
         done = 0
-        while done < T:
-            if self._stop_flag:
-                return True
-            cap = self._chunk_cap
-            t = T - done if cap is None else min(T - done, cap)
-            if t <= 1 and self._inscan is None:
+        # one spill window per train_chunk call: the streamed matrix is
+        # held across the dispatch loop and released on every exit path
+        self._bins_hold = getattr(self, "_bins_hold", 0) + 1
+        try:
+            while done < T:
+                if self._stop_flag:
+                    return True
+                cap = self._chunk_cap
+                t = T - done if cap is None else min(T - done, cap)
+                if t <= 1 and self._inscan is None:
+                    try:
+                        # per-iteration fallback still probes the OOM
+                        # site: a persistent allocator failure must
+                        # reach the next rung (spill) or the actionable
+                        # give-up error, not silently complete
+                        if FAULTS.enabled:
+                            FAULTS.maybe_raise("chunk/oom", oom_error)
+                        stop = self.train_one_iter()
+                    except Exception as e:
+                        if not _is_oom_error(e):
+                            raise
+                        if self._escalate_spill(e):
+                            continue               # retry out-of-core
+                        raise self._oom_exhausted(e)  # out of headroom
+                    if stop:
+                        return True
+                    done += 1
+                    continue
                 try:
-                    # per-iteration fallback still probes the OOM site:
-                    # a persistent allocator failure must reach the
-                    # actionable give-up error, not silently complete
-                    if FAULTS.enabled:
-                        FAULTS.maybe_raise("chunk/oom", oom_error)
-                    stop = self.train_one_iter()
+                    self._dispatch_chunk(t)
                 except Exception as e:
                     if not _is_oom_error(e):
                         raise
-                    raise self._oom_exhausted(e)   # out of headroom
-                if stop:
-                    return True
-                done += 1
-                continue
-            try:
-                self._dispatch_chunk(t)
-            except Exception as e:
-                if not _is_oom_error(e):
-                    raise
-                if t <= 1:
-                    # in-scan runs keep the scan path even at chunk 1;
-                    # there is no smaller dispatch left to retry with
-                    raise self._oom_exhausted(e)
-                self._degrade_chunk(t, e)
-                continue                           # retry at the new cap
-            done += t
-        return bool(self._stop_flag)
+                    if t <= 1:
+                        # in-scan runs keep the scan path even at chunk
+                        # 1; the chunk ladder has no smaller dispatch —
+                        # the spill tier is the only rung left
+                        if self._escalate_spill(e):
+                            continue
+                        raise self._oom_exhausted(e)
+                    self._degrade_chunk(t, e)
+                    continue                       # retry at the new cap
+                done += t
+            return bool(self._stop_flag)
+        finally:
+            self._bins_hold -= 1
+            if self._bins_hold <= 0:
+                self._release_bins_window()
 
     def _dispatch_chunk(self, t: int) -> None:
         """Dispatch one fused chunk of ``t`` iterations and enqueue its
@@ -1604,14 +1837,18 @@ class GBDT:
                 jnp.asarray(np.asarray(vs, dtype=np.float32))
                 for vs in self.valid_scores]
         first_iter = self.iter_
+        # spill tier: reassemble the device matrix here, OUTSIDE the
+        # transfer-guarded region below (streaming is a legitimate h2d
+        # copy, like the vscores re-upload above)
+        bins = self._device_bins()
         if inscan is not None:
             args = (self.train_score, self._key, self._vscores_dev,
-                    self.bag_weight, self.bins, self.fmeta,
+                    self.bag_weight, bins, self.fmeta,
                     self._full_fmask, shr, self._obj_arrs,
                     inscan.vbins, inscan.arrays)
         else:
             args = (self.train_score, self._key, self.bag_weight,
-                    self.bins, self.fmeta, self._full_fmask, shr,
+                    bins, self.fmeta, self._full_fmask, shr,
                     self._obj_arrs)
         mvals_all = None
         # the chunk's dispatch wall window: host dispatch time by
@@ -1654,13 +1891,13 @@ class GBDT:
         """Halve the chunk-size ceiling after an OOM-shaped dispatch
         failure, or give up (with the HBM picture) when retry is
         impossible because the dispatch consumed its donated carries."""
-        for buf in ((self.train_score, self._key)
-                    + tuple(self._vscores_dev or ())):
-            deleted = getattr(buf, "is_deleted", None)
-            if deleted is not None and deleted():
-                # donate_argnums handed the score/key/vscore buffers to
-                # the failed execution; there is no state left to retry
-                raise self._oom_exhausted(err)
+        if self._donated_carries_deleted():
+            # donate_argnums handed the score/key/vscore buffers to
+            # the failed execution; there is no state left to retry
+            self._spill_unavail = ("the failed dispatch consumed its "
+                                   "donated score/key carries; no device "
+                                   "state left to retry from")
+            raise self._oom_exhausted(err)
         # conservatively re-upload the valid-score carry: partial
         # execution may have touched it even when not deleted
         self._vscores_dev = None
@@ -1673,9 +1910,11 @@ class GBDT:
                               detail=f"chunk {t} -> {self._chunk_cap}")
 
     def _oom_exhausted(self, err: BaseException) -> LightGBMError:
-        """The actionable give-up error once even per-iteration dispatch
-        OOMs: names the iteration and the peak-HBM figure from the
-        telemetry memory section (PR 3) when the backend reports one."""
+        """The actionable give-up error once every rung of the recovery
+        ladder is spent: names the iteration, the NEXT rung that could
+        not be taken (so failures at the true ceiling are diagnosable),
+        and the peak-HBM figure from the telemetry memory section
+        (PR 3) when the backend reports one."""
         mem = TELEMETRY.stats().get("memory") or {}
         peak, limit = mem.get("peak_bytes_in_use"), mem.get("bytes_limit")
         if peak:
@@ -1684,10 +1923,17 @@ class GBDT:
                 hbm += f" of {limit / 1e9:.2f} GB limit"
         else:
             hbm = "; peak HBM unavailable (backend reports no memory stats)"
+        if getattr(self, "_data_tier", "resident") == "spill":
+            rung = ("; next rung: none — the bin matrix is already "
+                    "streaming from host memory (out-of-core tier)")
+        else:
+            reason = (getattr(self, "_spill_unavail", None)
+                      or "escalation was not attempted")
+            rung = f"; next rung: spill unavailable: {reason}"
         return LightGBMError(
             f"device out of memory at iteration {self.iter_} even at "
-            f"chunk size 1{hbm} — reduce num_leaves/max_bin or shard the "
-            f"data across more devices ({err})")
+            f"chunk size 1{rung}{hbm} — reduce num_leaves/max_bin or "
+            f"shard the data across more devices ({err})")
 
     def refit(self, leaf_preds: np.ndarray) -> None:
         """Refit leaf outputs on the current training data given per-row

@@ -13,7 +13,8 @@ Spec grammar (env ``LIGHTGBM_TPU_FAULTS`` or config
     SITE[@START][xCOUNT]
 
 ``SITE`` is a registered site name (``chunk/oom``, ``grad/nonfinite``,
-``snapshot/io``, ``train/kill``, ``collective/allgather``).  ``@START``
+``snapshot/io``, ``train/kill``, ``collective/allgather``,
+``oocore/h2d``, ``oocore/admit``).  ``@START``
 is the 0-based occurrence (or explicit index, e.g. iteration) at which
 the fault starts firing; default 0.  ``xCOUNT`` is how many
 occurrences fire; default 1, ``x*`` means every occurrence from START
@@ -53,6 +54,8 @@ KNOWN_SITES = frozenset([
     "snapshot/io",       # snapshot write raises OSError
     "train/kill",        # CLI training loop dies between iterations
     "collective/allgather",  # first attempt of allgather_obj fails
+    "oocore/h2d",        # bin-matrix host->device transfer raises OOM
+    "oocore/admit",      # admission check decides the matrix won't fit
 ])
 
 

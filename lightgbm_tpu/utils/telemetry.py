@@ -383,6 +383,9 @@ class TelemetryRegistry:
         self._mem_thread: Optional[threading.Thread] = None
         self._mem_stop: Optional[threading.Event] = None
         self._mem_interval_ms = 0.0
+        # which tier the binned training matrix lives in ("resident" /
+        # "spill", models/gbdt.py); None until a run resolves it
+        self._data_tier: Optional[str] = None
         # ------ XLA cost analysis (per jit-seam label) ------
         self._costs: Dict[str, Dict[str, float]] = {}
         # ------ measured per-dispatch timing (opt-in, v4) ------
@@ -715,6 +718,28 @@ class TelemetryRegistry:
             self.stop_mem_sampler()
             self.sample_memory("session")
 
+    def device_memory_budget(self) -> Optional[int]:
+        """The device allocator's reported capacity (``bytes_limit``) or
+        None on backends without memory stats — the denominator of the
+        out-of-core admission check (models/gbdt.py)."""
+        ms = self._device_memory_stats()
+        if not ms:
+            return None
+        limit = ms.get("bytes_limit")
+        return int(limit) if limit else None
+
+    def set_data_tier(self, tier: Optional[str]) -> None:
+        """Record which tier the binned matrix lives in ("resident" /
+        "spill").  Like fault_event this records at every level: a tier
+        transition explains a run's performance cliff and must never be
+        gated away."""
+        with self._lock:
+            self._data_tier = tier
+
+    def data_tier(self) -> Optional[str]:
+        with self._lock:
+            return self._data_tier
+
     def memory_gauges(self) -> Optional[Dict[str, int]]:
         """Cheap HBM gauge for per-iteration health records: the last
         and peak bytes-in-use already sampled at phase boundaries — no
@@ -728,19 +753,27 @@ class TelemetryRegistry:
 
     def _memory_section(self) -> Optional[Dict[str, Any]]:
         with self._lock:
-            if self._mem_last is None:
+            # a spilled run surfaces its tier even on backends without
+            # allocator stats (CPU tests); a resident run on such a
+            # backend keeps the section cleanly absent, as before
+            if self._mem_last is None and self._data_tier != "spill":
                 return None
-            out: Dict[str, Any] = {
-                "bytes_in_use": self._mem_last,
-                "peak_bytes_in_use": self._mem_peak,
-                "largest_alloc": self._mem_largest,
-                "phases": {k: dict(v) for k, v in self._mem_phase.items()},
-            }
-            if self._mem_limit is not None:
-                out["bytes_limit"] = self._mem_limit
-            if self._mem_interval_ms > 0:
-                out["sampler"] = {"interval_ms": self._mem_interval_ms,
-                                  "samples": len(self._mem_track)}
+            out: Dict[str, Any] = {}
+            if self._mem_last is not None:
+                out.update({
+                    "bytes_in_use": self._mem_last,
+                    "peak_bytes_in_use": self._mem_peak,
+                    "largest_alloc": self._mem_largest,
+                    "phases": {k: dict(v)
+                               for k, v in self._mem_phase.items()},
+                })
+                if self._mem_limit is not None:
+                    out["bytes_limit"] = self._mem_limit
+                if self._mem_interval_ms > 0:
+                    out["sampler"] = {"interval_ms": self._mem_interval_ms,
+                                      "samples": len(self._mem_track)}
+            if self._data_tier is not None:
+                out["data_tier"] = self._data_tier
             return out
 
     # --------------------------------------------------- XLA cost analysis
@@ -764,6 +797,20 @@ class TelemetryRegistry:
                 if k in analysis:
                     e[k] = float(analysis[k])
             e["compiles"] += 1
+
+    def cost_working_set(self) -> int:
+        """Largest per-executable working set (argument + temp + output
+        bytes) among the cost-instrumented seams, from XLA's
+        memory_analysis — 0 when nothing compiled yet.  Feeds the
+        out-of-core admission check alongside the bin-matrix bytes."""
+        with self._lock:
+            best = 0
+            for e in self._costs.values():
+                ws = int(e.get("argument_bytes", 0)
+                         + e.get("temp_bytes", 0)
+                         + e.get("output_bytes", 0))
+                best = max(best, ws)
+            return best
 
     def cost_call(self, label: str, count: int = 1) -> None:
         """Count ``count`` dispatches of a cost-instrumented seam; the
@@ -1057,6 +1104,7 @@ class TelemetryRegistry:
             self._mem_phase = {}
             self._mem_track.clear()
             self._mem_interval_ms = 0.0
+            self._data_tier = None
             self._costs = {}
             self._timing = {}
             self._profile_capture = None

@@ -123,9 +123,98 @@ def test_oom_degrades_and_completes(rng, monkeypatch):
 def test_oom_exhausts_to_actionable_error(rng, monkeypatch):
     X, y = _make_data(rng)
     _arm(monkeypatch, "chunk/oom@0x*")       # allocator never heals
-    with pytest.raises(LightGBMError, match="even at\\s+chunk size 1"):
+    with pytest.raises(LightGBMError, match="even at\\s+chunk size 1") as ei:
         lgb.train(dict(PARAMS, tpu_boost_chunk=4),
                   lgb.Dataset(X, label=y), num_boost_round=8)
+    # the ladder took every rung before giving up: it spilled to host and
+    # STILL exhausted, so the error says there is no further rung
+    assert "next rung: none" in str(ei.value)
+    assert "out-of-core" in str(ei.value)
+
+
+# ------------------------------------------- out-of-core (host-spill) rung
+@pytest.mark.parametrize("chunk", CHUNKS)
+def test_oocore_h2d_fault_spills_and_completes(rng, monkeypatch, chunk):
+    """An OOM at the resident bin-matrix upload escalates straight to the
+    host-spill tier; the run completes and the model is byte-identical to
+    the clean resident run."""
+    X, y = _make_data(rng)
+    clean = lgb.train(dict(PARAMS, tpu_boost_chunk=chunk),
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    _arm(monkeypatch, "oocore/h2d")          # single-fire at the upload
+    faulted = lgb.train(dict(PARAMS, tpu_boost_chunk=chunk),
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+    assert faulted.current_iteration() == 8
+    counts = faulted.train_stats["faults"]["counts"]
+    assert counts["oom_spill"] == 1
+    assert counts["injected"] == 1
+    assert "oom_degrade" not in counts        # no chunk ladder involved
+    assert faulted.train_stats["memory"]["data_tier"] == "spill"
+    assert faulted.model_to_string() == clean.model_to_string()
+
+
+def test_oocore_ladder_bottoms_out_into_spill(rng, monkeypatch):
+    """The full recovery ladder in one run: chunk 4 OOMs -> halve to 2 ->
+    OOMs -> halve to 1 -> still OOMs -> spill the bin matrix to host ->
+    training completes bit-identically."""
+    X, y = _make_data(rng)
+    clean = lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    _arm(monkeypatch, "chunk/oom@0x3")
+    faulted = lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+    assert faulted.current_iteration() == 8
+    counts = faulted.train_stats["faults"]["counts"]
+    assert counts["oom_degrade"] == 2         # 4 -> 2 -> 1
+    assert counts["oom_spill"] == 1           # 1 -> out-of-core
+    assert counts["injected"] == 3
+    assert faulted.train_stats["memory"]["data_tier"] == "spill"
+    assert faulted.model_to_string() == clean.model_to_string()
+
+
+def test_oocore_h2d_exhausts_to_giveup(rng, monkeypatch):
+    """Persistent transfer OOMs: the upload failure spills to host, the
+    per-block streaming then exhausts every rung and the give-up error
+    says no further rung exists."""
+    X, y = _make_data(rng)
+    _arm(monkeypatch, "oocore/h2d@0x*")
+    with pytest.raises(LightGBMError, match="even at\\s+chunk size 1") as ei:
+        lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+    assert "next rung: none" in str(ei.value)
+    assert "out-of-core" in str(ei.value)
+
+
+def test_oocore_admit_fault_forces_spill(rng, monkeypatch):
+    """The oocore/admit site makes the proactive admission check fail
+    deterministically: the run starts out-of-core without a single
+    RESOURCE_EXHAUSTED and still trains byte-identically."""
+    X, y = _make_data(rng)
+    clean = lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    _arm(monkeypatch, "oocore/admit")
+    faulted = lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+    counts = faulted.train_stats["faults"]["counts"]
+    assert counts["oocore_admit"] == 1
+    assert counts["injected"] == 1
+    assert "oom_degrade" not in counts and "oom_spill" not in counts
+    assert faulted.train_stats["memory"]["data_tier"] == "spill"
+    assert faulted.model_to_string() == clean.model_to_string()
+
+
+def test_oocore_spill_blocked_names_reason(rng, monkeypatch):
+    """Satellite 3: data_in_hbm=resident pins the matrix in HBM, so the
+    bottomed-out ladder's give-up error names the rung it could not
+    take — and why."""
+    X, y = _make_data(rng)
+    _arm(monkeypatch, "chunk/oom@0x*")
+    with pytest.raises(LightGBMError, match="even at\\s+chunk size 1") as ei:
+        lgb.train(dict(PARAMS, tpu_boost_chunk=4, data_in_hbm="resident"),
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+    msg = str(ei.value)
+    assert "spill unavailable" in msg
+    assert "data_in_hbm=resident" in msg
 
 
 # ------------------------------------------------------ non-finite guardrail

@@ -1,0 +1,263 @@
+"""Host-spill (out-of-core) tier tests: the HostSpillStore block
+reassembly, proactive admission (data_in_hbm=auto against a reported
+HBM budget), forced-spill byte-identity against resident training at
+chunk sizes 1 and 4, kill+resume mid-spill via the CLI, and the tier's
+observability surface (health-stream iter records + run_monitor).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import Application
+from lightgbm_tpu.data.hostspill import HostSpillStore
+from lightgbm_tpu.utils.faults import ENV_FAULTS, FAULTS, InjectedFault
+from lightgbm_tpu.utils.telemetry import TELEMETRY, TelemetryRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import run_monitor  # noqa: E402
+
+PARAMS = {"objective": "regression", "num_leaves": 7, "verbose": -1,
+          "min_data_in_leaf": 5, "seed": 7}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    TELEMETRY.reset()
+    yield
+    os.environ.pop(ENV_FAULTS, None)
+    FAULTS.configure()
+
+
+def _make_data(rng, n=240):
+    X = rng.rand(n, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.rand(n)
+    return X, y
+
+
+def _fake_mem(monkeypatch, bytes_limit):
+    """Pretend the backend reports allocator stats with the given HBM
+    capacity (the CPU backend's memory_stats() is None, so the real
+    admission path can't be exercised here)."""
+    ms = {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+          "largest_alloc_size": 0, "bytes_limit": int(bytes_limit)}
+    monkeypatch.setattr(TelemetryRegistry, "_device_memory_stats",
+                        lambda self: dict(ms))
+
+
+# ----------------------------------------------------------- the store
+def test_store_blocks_rows_layout(rng):
+    """Row-major [N, F]: blocked streaming reassembles the exact bytes,
+    tail block included (101 rows is not a multiple of 16)."""
+    mat = rng.randint(0, 256, size=(101, 7)).astype(np.uint8)
+    store = HostSpillStore.from_matrix(mat, row_axis=0, block_bytes=7 * 16)
+    assert store.block_rows == 16
+    assert store.num_blocks == 7              # 6 full blocks + 5-row tail
+    assert store.block_bounds(6) == (96, 101)
+    assert store.block(6).shape == (5, 7)
+    out = np.asarray(store.stream_to_device())
+    assert out.dtype == mat.dtype
+    np.testing.assert_array_equal(out, mat)
+
+
+def test_store_blocks_feature_major_layout(rng):
+    """Feature-major [F, Npad] (the pallas training layout): rows are
+    axis 1, blocks slice columns of the transposed image."""
+    mat = rng.randint(0, 16, size=(5, 64)).astype(np.int32)
+    store = HostSpillStore.from_matrix(mat, row_axis=1,
+                                       block_bytes=5 * 4 * 10)
+    assert store.num_rows == 64
+    assert store.block_rows == 10
+    assert store.num_blocks == 7
+    out = np.asarray(store.stream_to_device())
+    np.testing.assert_array_equal(out, mat)
+
+
+def test_store_default_block_size_is_one_block(rng):
+    """The 64MiB default comfortably holds a small matrix in one block —
+    the spill machinery must not fragment tiny datasets."""
+    mat = rng.randint(0, 256, size=(240, 4)).astype(np.uint8)
+    store = HostSpillStore.from_matrix(mat, row_axis=0)
+    assert store.num_blocks == 1
+    np.testing.assert_array_equal(np.asarray(store.stream_to_device()), mat)
+
+
+def test_store_mmap_roundtrip(rng, tmp_path):
+    """mmap backing: same bytes, file unlinked immediately (the mapping
+    keeps it alive), nothing left behind in the spill dir."""
+    mat = rng.randint(0, 256, size=(50, 3)).astype(np.uint8)
+    store = HostSpillStore.from_matrix(mat, row_axis=0, block_bytes=3 * 8,
+                                       mmap_dir=str(tmp_path))
+    assert isinstance(store.mat, np.memmap)
+    assert list(tmp_path.iterdir()) == []     # unlinked at construction
+    np.testing.assert_array_equal(np.asarray(store.stream_to_device()), mat)
+
+
+def test_store_transfer_counters(rng):
+    mat = rng.randint(0, 256, size=(32, 4)).astype(np.uint8)
+    store = HostSpillStore.from_matrix(mat, row_axis=0, block_bytes=4 * 8)
+    store.stream_to_device()
+    counters = TELEMETRY.stats()["counters"]
+    assert counters["oocore/h2d_blocks"] == store.num_blocks == 4
+    assert counters["oocore/h2d_bytes"] == mat.nbytes
+
+
+# ------------------------------------------- forced spill == resident
+@pytest.mark.parametrize("chunk", [1, 4])
+def test_forced_spill_bitidentical_to_resident(rng, chunk):
+    """ISSUE acceptance: data_in_hbm=spill streams the matrix per
+    dispatch window and the trained model is byte-identical to the
+    resident run at both chunk sizes."""
+    X, y = _make_data(rng)
+    resident = lgb.train(dict(PARAMS, tpu_boost_chunk=chunk),
+                         lgb.Dataset(X, label=y), num_boost_round=8)
+    assert "memory" not in resident.train_stats  # CPU resident: unchanged
+    spilled = lgb.train(dict(PARAMS, tpu_boost_chunk=chunk,
+                             data_in_hbm="spill"),
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+    assert spilled.model_to_string() == resident.model_to_string()
+    stats = spilled.train_stats
+    assert stats["memory"]["data_tier"] == "spill"
+    counts = stats["faults"]["counts"]
+    assert counts["oocore_admit"] == 1        # the forced decision logged
+    assert "oom_degrade" not in counts and "oom_spill" not in counts
+    assert stats["counters"]["oocore/h2d_blocks"] >= 1
+    assert stats["gauges"]["oocore/spill_bytes"] > 0
+
+
+def test_data_in_hbm_validation():
+    from lightgbm_tpu.config import Config
+    with pytest.raises(ValueError, match="data_in_hbm must be one of"):
+        Config(data_in_hbm="hbm2")
+    assert Config(data_in_hbm="RESIDENT").data_in_hbm == "resident"
+    assert Config().data_in_hbm == "auto"
+
+
+# --------------------------------------------------- proactive admission
+def test_admission_check_selects_spill(rng, monkeypatch):
+    """Satellite: a device whose reported HBM cannot hold the estimated
+    working set starts out-of-core PROACTIVELY — the run completes with
+    zero RESOURCE_EXHAUSTED events in the faults section."""
+    X, y = _make_data(rng)
+    resident = lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                         lgb.Dataset(X, label=y), num_boost_round=8)
+    _fake_mem(monkeypatch, bytes_limit=4096)  # matrix can never fit
+    bst = lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    assert bst.current_iteration() == 8
+    counts = bst.train_stats["faults"]["counts"]
+    assert counts["oocore_admit"] == 1
+    for oom_kind in ("oom_degrade", "oom_spill", "injected"):
+        assert oom_kind not in counts         # zero RESOURCE_EXHAUSTED
+    assert bst.train_stats["memory"]["data_tier"] == "spill"
+    assert bst.model_to_string() == resident.model_to_string()
+
+
+def test_admission_resident_override(rng, monkeypatch):
+    """data_in_hbm=resident overrides the admission check: the matrix is
+    pinned in HBM even when the reported budget says it won't fit."""
+    X, y = _make_data(rng)
+    _fake_mem(monkeypatch, bytes_limit=4096)
+    bst = lgb.train(dict(PARAMS, tpu_boost_chunk=4,
+                         data_in_hbm="resident"),
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    assert bst.current_iteration() == 8
+    # no fault events at all -> the faults section is cleanly absent
+    counts = bst.train_stats.get("faults", {}).get("counts", {})
+    assert "oocore_admit" not in counts
+    assert bst.train_stats["memory"]["data_tier"] == "resident"
+
+
+def test_admission_passes_with_headroom(rng, monkeypatch):
+    """A roomy budget keeps the run resident — auto must not spill for
+    no reason."""
+    X, y = _make_data(rng)
+    _fake_mem(monkeypatch, bytes_limit=1 << 40)
+    bst = lgb.train(dict(PARAMS, tpu_boost_chunk=4),
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    counts = bst.train_stats.get("faults", {}).get("counts", {})
+    assert "oocore_admit" not in counts
+    assert bst.train_stats["memory"]["data_tier"] == "resident"
+
+
+# ------------------------------------------------ CLI: kill+resume mid-spill
+def _write_csv(path, rng, n=300):
+    X = rng.rand(n, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.rand(n)
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+
+
+def _cli_argv(extra=()):
+    return ["task=train", "data=train.csv", "label_column=0",
+            "objective=regression", "num_iterations=8", "num_leaves=7",
+            "min_data_in_leaf=5", "verbosity=-1", "snapshot_freq=2",
+            "tpu_boost_chunk=4", "output_model=model.txt",
+            "metrics_out=metrics.json", *extra]
+
+
+def test_kill_and_resume_mid_spill_bitexact(tmp_path, rng, monkeypatch):
+    """ISSUE acceptance: a spill-mode run killed mid-training resumes
+    from its snapshot still in spill mode and lands byte-identical to an
+    uninterrupted RESIDENT run — data_in_hbm is runtime-only, so even
+    the serialized parameters sections match."""
+    seed = rng.randint(1 << 30)
+    a, b = tmp_path / "a", tmp_path / "b"
+    for d in (a, b):
+        d.mkdir()
+        _write_csv(d / "train.csv", np.random.RandomState(seed))
+
+    monkeypatch.chdir(a)
+    Application(_cli_argv()).run()            # uninterrupted, resident
+
+    monkeypatch.chdir(b)
+    argv = _cli_argv(["data_in_hbm=spill"])
+    monkeypatch.setenv(ENV_FAULTS, "train/kill@4")
+    FAULTS.configure()
+    with pytest.raises(InjectedFault):
+        Application(argv).run()
+    assert (b / "model.txt.partial").exists()
+
+    monkeypatch.delenv(ENV_FAULTS)
+    FAULTS.configure()
+    Application(argv + ["resume=true"]).run()
+    assert (b / "model.txt").read_bytes() == (a / "model.txt").read_bytes()
+    blob = json.loads((b / "metrics.json").read_text())
+    assert blob["faults"]["counts"]["resume"] == 1
+    # once per process run: the killed run AND the resume each resolved
+    # the forced tier (telemetry counts span both in-process runs)
+    assert blob["faults"]["counts"]["oocore_admit"] == 2
+    assert blob["memory"]["data_tier"] == "spill"
+
+
+# ----------------------------------------------------- observability
+def test_health_stream_carries_data_tier(tmp_path, rng):
+    path = str(tmp_path / "run.health.jsonl")
+    X, y = _make_data(rng)
+    lgb.train(dict(PARAMS, tpu_boost_chunk=4, data_in_hbm="spill",
+                   health_out=path),
+              lgb.Dataset(X, label=y), num_boost_round=6)
+    with open(path) as fh:
+        recs = [json.loads(line) for line in fh if line.strip()]
+    iters = [r for r in recs if r["kind"] == "iter"]
+    assert iters and all(r["data_tier"] == "spill" for r in iters)
+
+    state = run_monitor.StreamState()
+    with open(path, "rb") as fh:
+        state.feed(fh.read())
+    assert "tier=spill" in run_monitor.render(state, path)
+
+
+def test_run_monitor_tier_na_safe():
+    """Older streams have no data_tier field; the monitor renders them
+    unchanged."""
+    state = run_monitor.StreamState()
+    state.feed(json.dumps({"kind": "iter", "iter": 0, "chunk": 2,
+                           "t": 1.0}).encode() + b"\n")
+    out = run_monitor.render(state, "x.jsonl")
+    assert "tier=" not in out
+    assert "chunk=2" in out

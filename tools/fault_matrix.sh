@@ -4,6 +4,13 @@
 # in a clean process so degraded chunk caps / armed sites cannot leak
 # between configurations.
 #
+# Each chunk mode runs twice: once for the core sites (chunk/oom,
+# grad/nonfinite, snapshot/io, train/kill, collective/allgather) and once
+# for the out-of-core sites (oocore/h2d, oocore/admit), so the FULL
+# memory-pressure escalation ladder — halve -> halve -> spill -> give-up
+# (docs/ROBUSTNESS.md) — is exercised in CI-shaped form with per-group
+# process isolation.
+#
 #   tools/fault_matrix.sh [extra pytest args...]
 #
 # FAULT_MATRIX_CHUNK is deliberately NOT LIGHTGBM_TPU_-prefixed: the test
@@ -14,10 +21,18 @@ cd "$(dirname "$0")/.."
 
 status=0
 for chunk in 1 4; do
-  echo "=== fault matrix: tpu_boost_chunk=${chunk} ==="
-  if ! FAULT_MATRIX_CHUNK="${chunk}" JAX_PLATFORMS=cpu \
-      python -m pytest tests/test_faults.py -q -p no:cacheprovider "$@"; then
-    status=1
-  fi
+  for group in core oocore; do
+    if [ "${group}" = "oocore" ]; then
+      kexpr="oocore"
+    else
+      kexpr="not oocore"
+    fi
+    echo "=== fault matrix: tpu_boost_chunk=${chunk} sites=${group} ==="
+    if ! FAULT_MATRIX_CHUNK="${chunk}" JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_faults.py -q -p no:cacheprovider \
+        -k "${kexpr}" "$@"; then
+      status=1
+    fi
+  done
 done
 exit ${status}

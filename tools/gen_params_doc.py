@@ -134,6 +134,19 @@ def generate() -> str:
         "- `snapshot_keep` — retain only the newest K snapshots",
         "  (model + sidecar); `0` (default) keeps all, matching the",
         "  reference `save_period` behavior.",
+        "- `data_in_hbm` — where the binned feature matrix lives during",
+        "  training (default `auto`): `auto` runs a proactive admission",
+        "  check before the first dispatch (estimated working set vs the",
+        "  device's reported HBM capacity) and starts out-of-core when",
+        "  the matrix won't fit; `resident` pins it in HBM (the",
+        "  memory-pressure ladder then ends at chunk size 1); `spill`",
+        "  forces the host-spill tier — the matrix stays in host memory",
+        "  (optionally mmap-backed via `LIGHTGBM_TPU_SPILL_MMAP`) and is",
+        "  streamed into HBM as fixed-order row-blocks per dispatch",
+        "  window (`LIGHTGBM_TPU_SPILL_BLOCK_MB`, default 64).  Models",
+        "  are bit-identical across tiers.  Runtime-only: never",
+        "  serialized into the model.  See docs/ROBUSTNESS.md (the",
+        "  recovery ladder) and docs/OBSERVABILITY.md (`data_tier`).",
         "- `fault_injection` — deterministic fault-injection spec",
         "  (`SITE[@START][xCOUNT]`, comma-separated) for robustness",
         "  testing; the `LIGHTGBM_TPU_FAULTS` env var overrides per-site.",

@@ -135,6 +135,11 @@ def render(state: StreamState, path: str) -> str:
         chunk = state.iters[last].get("chunk")
         if chunk:
             progress += f", chunk={chunk}"
+        # memory tier of the bin matrix (v4 streams; older streams have
+        # no data_tier field and render unchanged)
+        tier = state.iters[last].get("data_tier")
+        if tier:
+            progress += f", tier={tier}"
         lines.append("  " + progress)
         ewma = _dispatch_rate(state)
         if ewma is not None and ewma > 0:
