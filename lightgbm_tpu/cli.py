@@ -101,6 +101,14 @@ class Application:
         # run can hit (or seed) the on-disk cache
         from .utils import maybe_enable_compile_cache
         maybe_enable_compile_cache(cfg)
+        # multi-host lifecycle: bind the collective retry policy and
+        # bring the jax.distributed world up (config/env driven) BEFORE
+        # data loading — sharded ingest bins against bin bounds synced
+        # via allgather_obj, which needs the world
+        from .parallel import distributed, network
+        network.configure(cfg)
+        distributed.maybe_initialize(cfg)
+        dist_active = distributed.is_active()
         train, valids, names = self._load_data()
         if cfg.save_binary:
             train.save_binary(cfg.data + ".bin")
@@ -110,8 +118,11 @@ class Application:
         booster = create_boosting(cfg, train, objective)
         resume_snap = None
         if cfg.resume:
-            from .utils.snapshots import find_latest_snapshot
-            resume_snap, _ = find_latest_snapshot(cfg.output_model)
+            # multi-host: elect the newest snapshot iteration ALL hosts
+            # possess (allgather of local manifests) so every host rolls
+            # to the same point; single-host falls through to plain
+            # local discovery inside elect_snapshot
+            resume_snap, _ = distributed.elect_snapshot(cfg.output_model)
             if resume_snap is None:
                 log_warning("resume=true but no resumable snapshot next to "
                             f"{cfg.output_model}; starting from scratch")
@@ -130,6 +141,11 @@ class Application:
         done = 0
         if resume_snap is not None:
             done = self._resume(booster, resume_snap)
+            if dist_active:
+                # resume boundary: a host that failed to roll to the
+                # elected snapshot must surface as a named missing rank
+                # here, not as a divergent model later
+                distributed.barrier("resume")
 
         from .utils.telemetry import HEALTH
         # streaming run-health layer: resume compacts the existing
@@ -137,11 +153,15 @@ class Application:
         # killed+resumed run yields ONE contiguous stream
         health_path = HEALTH.resolve_path(cfg)
         if health_path:
+            meta = {"source": "cli",
+                    "num_iterations": int(cfg.num_iterations)}
+            if dist_active:
+                meta["rank"] = distributed.rank()
+                meta["world"] = distributed.world()
             HEALTH.open(
                 health_path,
                 resume_iter=done if resume_snap is not None else None,
-                meta={"source": "cli",
-                      "num_iterations": int(cfg.num_iterations)})
+                meta=meta)
 
         log_info(f"Started training for {cfg.num_iterations} iterations")
         start = time.perf_counter()
@@ -184,6 +204,13 @@ class Application:
         import signal as _signal
 
         def _graceful_stop(signum, frame):
+            # multi-host: the first SIGTERM is a preemption notice —
+            # note it and let the loop drain the whole fleet to one
+            # synchronized snapshot (a second signal force-exits);
+            # single-host keeps the direct salvage-and-exit path
+            if dist_active and distributed.local_preemption() is None:
+                distributed.note_local_preemption(f"signal {signum}")
+                return
             raise SystemExit(128 + signum)
 
         prev_handlers = {}
@@ -193,6 +220,8 @@ class Application:
             except (ValueError, OSError):
                 pass
         failed = False
+        preempted = False
+        preempt_target = None
         try:
             # profiler window is exception-safe: a mid-training error must
             # not leak an open jax profiler trace session
@@ -201,6 +230,10 @@ class Application:
                     step = min(chunk, cfg.num_iterations - done)
                     for f in freqs:
                         step = min(step, f - done % f)
+                    if preempt_target is not None:
+                        # draining: stop exactly at the fleet-agreed
+                        # iteration, never past it
+                        step = min(step, preempt_target - done)
                     # a profile_window boundary splits the chunk so the
                     # capture covers exactly the requested span
                     step = PROFILE_WINDOW.clamp_step(done, step)
@@ -251,6 +284,36 @@ class Application:
                             and (it + 1) % cfg.snapshot_freq == 0):
                         self._write_snapshot(booster, it + 1)
                     FAULTS.maybe_raise("train/kill", n=it)
+                    if dist_active and preempt_target is None:
+                        # deterministic preemption injection: the
+                        # dist/preempt site stands in for a scheduler
+                        # SIGTERM on this host
+                        if FAULTS.check("dist/preempt", n=it):
+                            distributed.note_local_preemption(
+                                "injected dist/preempt")
+                        notice = distributed.preempt_notice()
+                        if notice is not None:
+                            # rebroadcast (idempotent) so every host
+                            # sees the notice, then agree on the drain
+                            # target: the max progress across the fleet
+                            distributed.publish_preempt(
+                                str(notice.get("reason", "preempt")),
+                                done)
+                            preempt_target = (
+                                distributed.negotiate_preempt_target(
+                                    done))
+                            log_warning(
+                                f"preemption notice ({notice}); "
+                                "draining the fleet to iteration "
+                                f"{preempt_target}")
+                    if preempt_target is not None \
+                            and done >= preempt_target:
+                        # every host is at the agreed iteration: meet,
+                        # snapshot synchronously, leave cleanly
+                        distributed.barrier("preempt")
+                        self._write_snapshot(booster, done)
+                        preempted = True
+                        break
                     if stop:
                         break
                     log_info(f"{time.perf_counter() - start:.6f} seconds "
@@ -288,6 +351,15 @@ class Application:
                     _signal.signal(_sig, _prev)
                 except (ValueError, OSError):
                     pass
+        if preempted:
+            # the whole fleet checkpointed at the same iteration; exit
+            # with the "try again later" code so the scheduler restarts
+            # the job, which resumes from the elected snapshot
+            log_warning(
+                f"preempted at iteration {done}: synchronized snapshot "
+                f"written; exiting {distributed.PREEMPT_EXIT_CODE} for "
+                "restart with resume=true")
+            raise SystemExit(distributed.PREEMPT_EXIT_CODE)
         self._save_model(booster, cfg.output_model)
         log_info(f"Finished training, saved model to {cfg.output_model}")
 
@@ -313,14 +385,18 @@ class Application:
         losing one snapshot must not abort a long run."""
         cfg = self.config
         from .models.serialization import save_model_to_string
-        from .utils.faults import FAULTS
+        from .parallel import distributed
         from .utils.snapshots import prune_snapshots, save_snapshot
         from .utils.telemetry import TELEMETRY
         snap = f"{cfg.output_model}.snapshot_iter_{iteration}"
+        # snapshot boundary: all hosts reach the same iteration before
+        # any writes — a dead host trips the timeout naming its rank
+        # instead of leaving a half-fleet snapshot generation
+        distributed.barrier("snapshot")
         try:
-            FAULTS.maybe_raise(
-                "snapshot/io",
-                lambda site: OSError(f"injected IO failure at {site}"))
+            # save_snapshot retries transient IO once (shared policy in
+            # utils/retry.py) and probes the snapshot/io fault site per
+            # attempt; only a persistent failure reaches this except
             save_snapshot(booster, snap,
                           save_model_to_string(booster, self.config))
             prune_snapshots(cfg.output_model, int(cfg.snapshot_keep))

@@ -163,6 +163,22 @@ _PARAMS: Dict[str, _P] = {
     "time_out": _P(120),
     "machine_list_filename": _P("", ["machine_list_file", "machine_list", "mlist"]),
     "machines": _P("", ["workers", "nodes"]),
+    # -- multi-host lifecycle (parallel/distributed.py): explicit
+    # jax.distributed world.  coordinator_address="" leaves init to the
+    # launcher/env; num_hosts=0 / host_rank=-1 = auto-detect from the
+    # binning_world() launch markers (SLURM/OMPI).  The
+    # LIGHTGBM_TPU_COORDINATOR_ADDRESS/_NUM_HOSTS/_HOST_RANK env vars
+    # win.  Runtime-only: per-host topology, never part of the model
+    "coordinator_address": _P(""),
+    "num_hosts": _P(0),
+    "host_rank": _P(-1),
+    # hardened collective seam: extra attempts after the first failure
+    # of a host-level collective (retry-once default preserved), and
+    # the per-attempt wall budget for collectives, barriers, and the
+    # distributed-init handshake — a dead host then surfaces as an
+    # error naming the missing rank instead of a hang
+    "collective_retries": _P(1),
+    "collective_timeout_s": _P(120.0),
     # -- device --
     "gpu_platform_id": _P(-1),
     "gpu_device_id": _P(-1),
@@ -266,7 +282,10 @@ _PARAMS: Dict[str, _P] = {
 # an uninterrupted one
 RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection",
                                  "compile_cache", "device_timing",
-                                 "profile_window", "data_in_hbm"])
+                                 "profile_window", "data_in_hbm",
+                                 "coordinator_address", "num_hosts",
+                                 "host_rank", "collective_retries",
+                                 "collective_timeout_s"])
 
 # alias -> canonical name
 ALIAS_TABLE: Dict[str, str] = {}
@@ -455,6 +474,16 @@ class Config:
         if dib not in ("auto", "resident", "spill"):
             raise ValueError("data_in_hbm must be one of auto, resident, "
                              f"spill (got {self.data_in_hbm!r})")
+        if self.collective_retries < 0:
+            raise ValueError("collective_retries must be >= 0")
+        if self.collective_timeout_s <= 0:
+            raise ValueError("collective_timeout_s must be > 0")
+        if (self.coordinator_address and self.num_hosts > 0
+                and self.host_rank >= self.num_hosts):
+            raise ValueError(
+                f"host_rank={self.host_rank} must be in "
+                f"[0, num_hosts={self.num_hosts}) when "
+                "coordinator_address is set (or -1 to auto-detect)")
         self.data_in_hbm = dib
 
     # -- accessors --

@@ -298,8 +298,11 @@ class GBDT:
             TELEMETRY.install_jax_listeners()
         # arm fault injection for this run (env spec wins per-site) with
         # fresh occurrence counters — same lifecycle as the telemetry
-        # level binding above
+        # level binding above; the collective retry policy binds at the
+        # same point so every entry path (engine/sklearn/CLI) gets it
         FAULTS.configure(getattr(config, "fault_injection", ""))
+        from ..parallel import network as _network
+        _network.configure(config)
         self.train_set: Optional[TpuDataset] = None
         self._models: List[Tree] = []           # flat: iter-major, class-minor
         # finished trees whose device->host transfer is still in flight,
@@ -1661,6 +1664,14 @@ class GBDT:
             # grows the same trees regardless of which path engages
             self._key, sub = jax.random.split(self._key)
             t0_grow = time.perf_counter()
+            # instrumented parallel growers run inside the jitted step,
+            # where their own wrapper is trace-time only; the fault
+            # probe (collective/reduce_scatter etc.) and the per-tree
+            # collective counters both live at this eager dispatch site
+            coll_kind = getattr(self._grow_fn, "_collective_kind", None)
+            if coll_kind is not None:
+                from ..parallel import network
+                network.probe_dispatch_collective(coll_kind)
             with _PHASES.phase("grow") as box:
                 extra = () if roots is None else (roots,)
                 self.train_score, ints_d, floats_d, stats_t = fused_step(
@@ -1668,10 +1679,6 @@ class GBDT:
                     bins, self.fmeta, fmask, sub,
                     jnp.float32(self.shrinkage_rate), jnp.int32(k), *extra)
                 box[0] = self.train_score
-            # instrumented parallel growers run inside the jitted step,
-            # where their own wrapper is trace-time only; record the
-            # per-tree collective at this eager dispatch site instead
-            coll_kind = getattr(self._grow_fn, "_collective_kind", None)
             if coll_kind is not None:
                 from ..parallel import network
                 network.record_collective(

@@ -1,0 +1,502 @@
+"""Preemption-tolerant multi-host lifecycle over ``jax.distributed``.
+
+The reference's multi-machine story is a machine list + socket handshake
+(``Network::Init``, src/network/linkers_socket.cpp:23-230) and its
+failure story is "the TCP read times out and the job dies".  On a
+preemptible TPU fleet the collectives themselves are XLA's problem; the
+hard part is everything around them — bringing the world up from a
+launcher's environment, surviving a host that vanishes mid-run, and
+stopping N hosts at the *same* iteration when any one of them receives
+a preemption notice.  This module owns that layer:
+
+  * **Init lifecycle** — :func:`maybe_initialize` drives an explicit
+    ``jax.distributed.initialize`` from config (``coordinator_address=``,
+    ``num_hosts=``, ``host_rank=``) or from the same launch markers
+    ``network.binning_world()`` recognizes (SLURM / OpenMPI / TPU pod
+    env), with retry/backoff via ``utils/retry.py`` and the
+    deterministic ``dist/init`` fault site.  :func:`shutdown_owned`
+    tears down only a client this module created — an externally
+    initialized world is adopted, never destroyed.
+
+  * **Host-level collectives over the coordinator KV store** — the
+    coordination service that ``jax.distributed`` already runs gives
+    every host a tiny strongly-consistent KV namespace with *per-call
+    timeouts*.  :func:`kv_allgather_bytes` is the transport behind
+    ``network.allgather_obj`` on multi-process runs: it works on every
+    backend (XLA's CPU backend has no cross-process computations, so
+    ``multihost_utils`` cannot serve the 2-process CPU test harness),
+    and a dead peer surfaces as a DEADLINE naming the missing rank
+    instead of a hang.
+
+  * **Barrier with a deadline** — :func:`barrier` announces this rank
+    under a per-call generation key and polls every other rank's
+    announcement with a bounded budget; on expiry it raises a
+    ``LightGBMError`` naming exactly which ranks never arrived.  Used
+    at snapshot and resume boundaries so one dead host produces an
+    actionable error, not a wedged fleet.
+
+  * **Cross-host snapshot election** — :func:`elect_snapshot` allgathers
+    each host's local snapshot manifest and elects the newest iteration
+    *every* host possesses; hosts whose local newest is ahead roll back
+    to the common one, so a fleet restarted after an uncoordinated kill
+    resumes bit-identically instead of diverging.
+
+  * **Coordinated preemption** — any host that receives SIGTERM (or
+    trips the ``dist/preempt`` fault site) posts a preemption notice to
+    the KV store; every host sees it at its next iteration boundary,
+    the fleet allgathers its per-host progress and agrees on the
+    maximum (:func:`negotiate_preempt_target`), trains up to that
+    iteration, barriers, snapshots synchronously, and exits with
+    :data:`PREEMPT_EXIT_CODE` — a restart with ``resume=true`` then
+    elects exactly that snapshot on every host.
+
+Every cross-host step lands in the run-health stream as a ``dist``
+record (rank, world, barrier waits, elected iteration), so a live
+monitor can watch a preemption drain in real time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.log import LightGBMError, log_info, log_warning
+
+# sysexits.h EX_TEMPFAIL: "try again later" — the scheduler-facing
+# contract for a run that checkpointed and exited under preemption
+PREEMPT_EXIT_CODE = 75
+
+# KV namespaces (all under the coordination service's flat store)
+_AG_PREFIX = "lgbm/ag"           # allgather payload chunks
+_BAR_PREFIX = "lgbm/bar"         # barrier announcements
+_PREEMPT_DIR = "lgbm/preempt/"   # preemption notice directory
+_PREEMPT_KEY = _PREEMPT_DIR + "notice"   # single JSON value
+
+_KV_CHUNK = 1 << 20              # 1 MiB per KV value: stay far below the
+#                                  coordination-service gRPC message cap
+
+# env fallbacks for the config knobs (a launcher that cannot edit argv
+# exports these instead); the conftest scrub namespace is deliberate —
+# tests must opt in explicitly
+ENV_COORDINATOR = "LIGHTGBM_TPU_COORDINATOR_ADDRESS"
+ENV_NUM_HOSTS = "LIGHTGBM_TPU_NUM_HOSTS"
+ENV_HOST_RANK = "LIGHTGBM_TPU_HOST_RANK"
+
+
+class _State:
+    __slots__ = ("owned", "ag_gen", "bar_gen", "preempt_seen",
+                 "local_notice")
+
+    def __init__(self):
+        self.owned = False           # this module called initialize()
+        self.ag_gen = 0              # allgather generation counter
+        self.bar_gen = 0             # barrier generation counter
+        self.preempt_seen = False    # a notice was already acted on
+        self.local_notice = None     # reason set by SIGTERM/fault site
+
+
+_state = _State()
+
+
+# --------------------------------------------------------------------- world
+def client():
+    """The live coordination-service client, or ``None``.  Read through
+    jax's private distributed state (same access the rest of this repo
+    uses in ``network.binning_world``) — it never initializes a device
+    backend."""
+    try:
+        from jax._src import distributed as _jd
+        return _jd.global_state.client
+    except (ImportError, AttributeError):
+        return None
+
+
+def world() -> int:
+    """Process count of the initialized world (1 when uninitialized).
+    Read from distributed state, not ``jax.process_count()``, so asking
+    never triggers a backend init."""
+    try:
+        from jax._src import distributed as _jd
+        n = _jd.global_state.num_processes
+        return int(n) if n else 1
+    except (ImportError, AttributeError):
+        return 1
+
+
+def rank() -> int:
+    try:
+        from jax._src import distributed as _jd
+        r = _jd.global_state.process_id
+        return int(r) if r else 0
+    except (ImportError, AttributeError):
+        return 0
+
+
+def is_active() -> bool:
+    """True when a multi-process world is up (client present, world>1)."""
+    return client() is not None and world() > 1
+
+
+def _health(event: str, **fields) -> None:
+    """One ``dist`` record into the run-health stream (no-op when no
+    stream is open): every cross-host step is narrated with rank/world
+    so a live monitor can watch a preemption drain."""
+    from ..utils.telemetry import HEALTH
+    if not HEALTH.active:
+        return
+    rec: Dict[str, Any] = {"event": event, "rank": rank(),
+                           "world": world()}
+    rec.update(fields)
+    HEALTH.record("dist", rec)
+
+
+# ------------------------------------------------------------------ detection
+def detect_launch(config=None) -> Optional[Tuple[str, int, int]]:
+    """Resolve ``(coordinator_address, num_hosts, host_rank)`` from the
+    env fallbacks (which win, mirroring every other knob) or the config.
+    Returns ``None`` when nothing requests a multi-host world.  A
+    partial spec (coordinator without a resolvable world/rank) is a
+    config error, not a silent single-host run."""
+    coord = os.environ.get(ENV_COORDINATOR, "")
+    nhosts_s = os.environ.get(ENV_NUM_HOSTS, "")
+    rank_s = os.environ.get(ENV_HOST_RANK, "")
+    if not coord and config is not None:
+        coord = str(getattr(config, "coordinator_address", "") or "")
+        if not nhosts_s:
+            nhosts_s = str(int(getattr(config, "num_hosts", 0) or 0))
+        if not rank_s:
+            hr = int(getattr(config, "host_rank", -1))
+            rank_s = "" if hr < 0 else str(hr)
+    if not coord:
+        return None
+    # the launch markers binning_world() recognizes double as world/rank
+    # sources when the explicit knobs are absent
+    if not nhosts_s or int(nhosts_s or 0) <= 0:
+        nhosts_s = (os.environ.get("SLURM_JOB_NUM_NODES", "")
+                    or os.environ.get("OMPI_COMM_WORLD_SIZE", ""))
+    if not rank_s:
+        rank_s = (os.environ.get("SLURM_PROCID", "")
+                  or os.environ.get("OMPI_COMM_WORLD_RANK", ""))
+    try:
+        nhosts = int(nhosts_s)
+        host_rank = int(rank_s)
+    except ValueError:
+        raise LightGBMError(
+            f"coordinator_address={coord!r} is set but the world could "
+            f"not be resolved (num_hosts={nhosts_s!r}, "
+            f"host_rank={rank_s!r}); set num_hosts=/host_rank= (or the "
+            f"{ENV_NUM_HOSTS}/{ENV_HOST_RANK} env vars)")
+    if nhosts <= 0 or host_rank < 0 or host_rank >= nhosts:
+        raise LightGBMError(
+            f"invalid multi-host spec: coordinator={coord} "
+            f"num_hosts={nhosts} host_rank={host_rank}")
+    return coord, nhosts, host_rank
+
+
+def maybe_initialize(config=None) -> bool:
+    """Bring the multi-host world up when the config/env requests one.
+
+    Idempotent: an already-initialized world (ours or external) is
+    adopted as-is.  The handshake itself retries with backoff under the
+    configured collective policy, and the deterministic ``dist/init``
+    fault site fires before the real call so init-failure handling is
+    testable without killing a coordinator.  Returns True when a
+    multi-process world is up after the call."""
+    if client() is not None:
+        return world() > 1
+    launch = detect_launch(config)
+    if launch is None:
+        return False
+    coord, nhosts, host_rank = launch
+    if nhosts == 1:
+        log_info("multi-host spec resolves to a single host; skipping "
+                 "jax.distributed init")
+        return False
+    from ..utils.faults import FAULTS
+    from ..utils.retry import retry_call
+    from ..utils.telemetry import TELEMETRY
+    from . import network
+
+    retries, timeout_s, backoff_s = network.collective_policy()
+
+    def _init():
+        FAULTS.maybe_raise("dist/init")
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=nhosts,
+            process_id=host_rank,
+            initialization_timeout=max(1, int(timeout_s)))
+
+    def _on_retry(_k, e):
+        TELEMETRY.fault_event("collective_retry", site="dist/init",
+                              detail=str(e))
+
+    t0 = time.perf_counter()
+    retry_call(_init, attempts=1 + retries, backoff_s=backoff_s,
+               fatal=(LightGBMError,), on_retry=_on_retry,
+               label="dist/init")
+    _state.owned = True
+    log_info(f"jax.distributed initialized: rank {host_rank}/{nhosts} "
+             f"via {coord} ({time.perf_counter() - t0:.2f}s)")
+    _health("init", coordinator=coord,
+            init_s=round(time.perf_counter() - t0, 3))
+    return True
+
+
+def shutdown_owned() -> None:
+    """Tear down the distributed client IF this module created it (an
+    adopted external world is left alone), so a dispose()d process can
+    re-init a fresh world under a new size."""
+    if not _state.owned:
+        return
+    _state.owned = False
+    _state.ag_gen = 0
+    _state.bar_gen = 0
+    _state.preempt_seen = False
+    _state.local_notice = None
+    try:
+        import jax
+        jax.distributed.shutdown()
+    except Exception as e:  # noqa: BLE001 — teardown is best-effort
+        log_warning(f"jax.distributed.shutdown failed: {e}")
+
+
+# ------------------------------------------------------------ KV collectives
+def _remaining_ms(deadline: float) -> int:
+    """Milliseconds left until ``deadline`` (perf_counter), floored at 1
+    so the coordination service still raises DEADLINE promptly instead
+    of an invalid-argument error."""
+    return max(1, int((deadline - time.perf_counter()) * 1000))
+
+
+def kv_allgather_bytes(blob: bytes, timeout_s: float,
+                       label: str = "allgather") -> List[bytes]:
+    """Allgather one byte payload per rank through the coordination
+    service KV store; returns rank-ordered blobs (self included).
+
+    Every rank must call this the same number of times in the same
+    order — a per-process generation counter namespaces each call.
+    Payloads are chunked at ``_KV_CHUNK`` to stay under the service's
+    message cap.  A rank that never posts its payload surfaces as a
+    ``LightGBMError`` naming that rank once the budget expires.  Own
+    keys from generation g-2 are deleted on entry (provably no peer
+    can still need them once this rank reaches g), so long runs do not
+    grow coordinator memory."""
+    c = client()
+    if c is None or world() <= 1:
+        return [blob]
+    me, n = rank(), world()
+    gen = _state.ag_gen
+    _state.ag_gen += 1
+    if gen >= 2:
+        try:
+            c.key_value_delete(f"{_AG_PREFIX}/{gen - 2}/{me}/")
+        except Exception:  # noqa: BLE001 — GC is best-effort
+            pass
+    nchunks = max(1, (len(blob) + _KV_CHUNK - 1) // _KV_CHUNK)
+    for i in range(nchunks):
+        c.key_value_set_bytes(f"{_AG_PREFIX}/{gen}/{me}/{i}",
+                              blob[i * _KV_CHUNK:(i + 1) * _KV_CHUNK])
+    c.key_value_set(f"{_AG_PREFIX}/{gen}/{me}/n", str(nchunks))
+    deadline = time.perf_counter() + max(0.001, timeout_s)
+    out: List[bytes] = []
+    for r in range(n):
+        try:
+            cnt = int(c.blocking_key_value_get(
+                f"{_AG_PREFIX}/{gen}/{r}/n", _remaining_ms(deadline)))
+            parts = [
+                c.blocking_key_value_get_bytes(
+                    f"{_AG_PREFIX}/{gen}/{r}/{i}", _remaining_ms(deadline))
+                for i in range(cnt)]
+        except Exception as e:  # noqa: BLE001 — deadline or service loss
+            raise LightGBMError(
+                f"{label}: rank {r} did not publish its payload within "
+                f"{timeout_s:g}s (world {n}, generation {gen}) — host "
+                f"{r} is dead or partitioned: {e}") from e
+        out.append(b"".join(parts))
+    return out
+
+
+def barrier(name: str, timeout_s: Optional[float] = None) -> float:
+    """Cross-host barrier with a deadline; returns the wait in seconds.
+
+    No-op (0.0) on single-process runs.  Each rank announces itself
+    under a per-call generation key and polls every other rank's
+    announcement against the shared budget; on expiry the error names
+    exactly the ranks that never arrived.  Probes the deterministic
+    ``collective/barrier`` fault site per call, and records the wait in
+    the per-collective counters plus a ``dist`` health record."""
+    from ..utils.faults import FAULTS
+    from . import network
+    if not is_active():
+        return 0.0
+    FAULTS.maybe_raise("collective/barrier")
+    if timeout_s is None:
+        timeout_s = network.collective_policy()[1]
+    c = client()
+    me, n = rank(), world()
+    gen = _state.bar_gen
+    _state.bar_gen += 1
+    prefix = f"{_BAR_PREFIX}/{name}/{gen}"
+    c.key_value_set(f"{prefix}/{me}", "1", allow_overwrite=True)
+    t0 = time.perf_counter()
+    deadline = t0 + max(0.001, timeout_s)
+    missing: List[int] = []
+    for r in range(n):
+        if r == me:
+            continue
+        try:
+            c.blocking_key_value_get(f"{prefix}/{r}",
+                                     _remaining_ms(deadline))
+        except Exception:  # noqa: BLE001 — deadline or service loss
+            missing.append(r)
+    wait = time.perf_counter() - t0
+    if missing:
+        arrived = sorted(set(range(n)) - set(missing) - {me})
+        raise LightGBMError(
+            f"barrier '{name}' timed out after {timeout_s:g}s: missing "
+            f"rank(s) {missing} of world {n} (rank {me} waited, "
+            f"rank(s) {arrived or '[]'} arrived) — a host died or is "
+            "partitioned; restart the fleet with resume=true to "
+            "continue from the elected snapshot")
+    network.record_collective("barrier", 0, wait)
+    _health("barrier", name=name, wait_s=round(wait, 6))
+    return wait
+
+
+# ------------------------------------------------------- snapshot election
+def local_snapshot_manifest(output_model: str) -> List[int]:
+    """Sorted iterations of every RESUMABLE local snapshot (model file
+    plus exact-state sidecar) for ``output_model``."""
+    from ..utils.snapshots import _SNAP_RE, state_path
+    d = os.path.dirname(os.path.abspath(output_model))
+    base = os.path.basename(output_model)
+    iters = []
+    if not os.path.isdir(d):
+        return iters
+    for fname in os.listdir(d):
+        if not fname.startswith(base + ".snapshot_iter_"):
+            continue
+        m = _SNAP_RE.search(fname)
+        if m is None:
+            continue
+        path = os.path.join(d, fname)
+        if os.path.exists(state_path(path)):
+            iters.append(int(m.group(1)))
+    return sorted(iters)
+
+
+def elect_common_iteration(manifests: List[List[int]]) -> int:
+    """The newest iteration present in EVERY manifest (0 when none):
+    the only snapshot the whole fleet can roll to together."""
+    if not manifests:
+        return 0
+    common = set(manifests[0])
+    for m in manifests[1:]:
+        common &= set(m)
+    return max(common) if common else 0
+
+
+def elect_snapshot(output_model: str) -> Tuple[Optional[str], int]:
+    """Cross-host-consistent snapshot discovery: allgather every host's
+    local manifest, elect the newest iteration ALL hosts possess, and
+    return this host's ``(path, iteration)`` for it — ``(None, 0)``
+    when no common snapshot exists.  Single-process runs fall through
+    to plain local discovery."""
+    from ..utils.snapshots import find_latest_snapshot
+    if not is_active():
+        return find_latest_snapshot(output_model)
+    from . import network
+    local = local_snapshot_manifest(output_model)
+    manifests = network.allgather_obj({"rank": rank(), "iters": local})
+    elected = elect_common_iteration(
+        [m["iters"] for m in manifests])
+    _health("elect", iteration=elected,
+            local_newest=(local[-1] if local else 0),
+            manifests={str(m["rank"]): len(m["iters"])
+                       for m in manifests})
+    if elected <= 0:
+        if any(m["iters"] for m in manifests):
+            log_warning(
+                "no snapshot iteration is present on every host "
+                f"(manifests: {[m['iters'] for m in manifests]}); "
+                "starting from scratch on all hosts")
+        return None, 0
+    if local and local[-1] > elected:
+        log_warning(
+            f"local newest snapshot (iteration {local[-1]}) is ahead of "
+            f"the fleet-wide elected iteration {elected}; rolling back "
+            "to the common snapshot")
+    log_info(f"elected snapshot iteration {elected} across "
+             f"{world()} hosts")
+    return f"{output_model}.snapshot_iter_{elected}", elected
+
+
+# ----------------------------------------------------------- preemption flow
+def note_local_preemption(reason: str) -> None:
+    """Record that THIS host was asked to stop (SIGTERM handler or the
+    ``dist/preempt`` fault site).  Consumed at the next iteration
+    boundary by :func:`preempt_notice`."""
+    if _state.local_notice is None:
+        _state.local_notice = reason
+        log_warning(f"preemption notice on rank {rank()}: {reason}")
+
+
+def local_preemption() -> Optional[str]:
+    return _state.local_notice
+
+
+def publish_preempt(reason: str, iteration: int) -> None:
+    """Post the fleet-wide preemption notice (idempotent; last writer
+    wins, which is fine — any notice drains the whole fleet)."""
+    c = client()
+    if c is None:
+        return
+    notice = json.dumps({"rank": rank(), "reason": reason,
+                         "iter": int(iteration)})
+    try:
+        c.key_value_set(_PREEMPT_KEY, notice, allow_overwrite=True)
+    except Exception as e:  # noqa: BLE001
+        log_warning(f"could not publish preemption notice: {e}")
+    _health("preempt", reason=reason, iter=int(iteration))
+
+
+def preempt_notice(poll: bool = True) -> Optional[Dict[str, Any]]:
+    """The fleet-wide preemption notice, or ``None``.  A local notice
+    (this host's SIGTERM / fault site) counts without any KV traffic;
+    otherwise one cheap KV probe per call (``poll=False`` skips it for
+    hot paths)."""
+    if _state.local_notice is not None:
+        return {"rank": rank(), "reason": _state.local_notice,
+                "iter": -1}
+    if not poll:
+        return None
+    c = client()
+    if c is None or world() <= 1:
+        return None
+    try:
+        pairs = c.key_value_dir_get(_PREEMPT_DIR)
+    except Exception:  # noqa: BLE001 — absent key / service loss
+        return None
+    for key, val in pairs:
+        if key.endswith("notice"):
+            try:
+                return json.loads(val)
+            except ValueError:
+                return {"rank": -1, "reason": val, "iter": -1}
+    return None
+
+
+def negotiate_preempt_target(done: int) -> int:
+    """Agree on the iteration every host will snapshot at: the MAXIMUM
+    of all hosts' completed iterations, so no host has to un-train.
+    Hosts behind the target keep training up to it before the barrier."""
+    from . import network
+    if not is_active():
+        return int(done)
+    progress = network.allgather_obj({"rank": rank(), "done": int(done)})
+    target = max(int(p["done"]) for p in progress)
+    _health("preempt_target", target=target, done=int(done))
+    return target

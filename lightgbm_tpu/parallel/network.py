@@ -11,7 +11,11 @@ the process-wide mesh used by the parallel tree learners.
 Multi-host: run one process per host under `jax.distributed.initialize`;
 `jax.devices()` then spans all hosts and the same mesh covers DCN, which is
 the TPU equivalent of the reference's machine list + socket handshake
-(linkers_socket.cpp:23-230).
+(linkers_socket.cpp:23-230).  `parallel/distributed.py` owns the init
+lifecycle, barriers, snapshot election and preemption flow; this module
+owns the mesh, the per-collective counters, and the hardened host-level
+collective seam (`allgather_obj` with configurable retries / backoff /
+per-attempt timeout via `collective_retries=` / `collective_timeout_s=`).
 """
 
 from __future__ import annotations
@@ -26,9 +30,31 @@ import numpy as np
 from ..utils.log import LightGBMError, log_info, log_warning
 
 _mesh: Optional["jax.sharding.Mesh"] = None
+_mesh_fingerprint: Optional[tuple] = None
 _injected: Optional[dict] = None
 
 MACHINES_AXIS = "machines"
+
+# Retry policy for host-level collectives (and the distributed-init
+# handshake): total extra attempts, per-attempt wall budget, first
+# backoff.  The defaults preserve the historical retry-once behavior;
+# configure() rebinds them from `collective_retries=` /
+# `collective_timeout_s=` at the same lifecycle point as
+# FAULTS.configure.
+_policy = {"retries": 1, "timeout_s": 120.0, "backoff_s": 0.05}
+
+
+def configure(config) -> None:
+    """Bind the collective retry policy from a Config (clamped sane)."""
+    retries = int(getattr(config, "collective_retries", 1))
+    timeout_s = float(getattr(config, "collective_timeout_s", 120.0))
+    _policy["retries"] = max(0, retries)
+    _policy["timeout_s"] = max(0.001, timeout_s)
+
+
+def collective_policy() -> tuple:
+    """(retries, timeout_s, backoff_s) currently in force."""
+    return _policy["retries"], _policy["timeout_s"], _policy["backoff_s"]
 
 # ---------------------------------------------------------------------------
 # Per-collective counters: calls, payload bytes, wall seconds — the TPU
@@ -97,9 +123,20 @@ def reset_collective_stats() -> None:
         _coll_race_warned = False
 
 
+def _device_fingerprint(devices) -> tuple:
+    """Identity + order of a device list — what the mesh's collective
+    layout assumptions are actually keyed on."""
+    return tuple((getattr(d, "process_index", 0), getattr(d, "id", i))
+                 for i, d in enumerate(devices))
+
+
 def init(num_machines: int = 0) -> "jax.sharding.Mesh":
-    """Build (or rebuild) the 1-D device mesh over the `machines` axis."""
-    global _mesh
+    """Build (or rebuild) the 1-D device mesh over the `machines` axis.
+
+    Always re-queries ``jax.devices()`` so a second init after
+    ``dispose()`` — possibly under a NEW ``jax.distributed`` world size —
+    builds a fresh mesh instead of reusing stale device ordering."""
+    global _mesh, _mesh_fingerprint
     devices = jax.devices()
     if num_machines <= 0:
         num_machines = len(devices)
@@ -109,6 +146,7 @@ def init(num_machines: int = 0) -> "jax.sharding.Mesh":
         num_machines = len(devices)
     _mesh = jax.sharding.Mesh(np.asarray(devices[:num_machines]),
                               (MACHINES_AXIS,))
+    _mesh_fingerprint = _device_fingerprint(devices)
     log_info(f"Initialized TPU collective mesh with {num_machines} devices")
     return _mesh
 
@@ -135,9 +173,18 @@ def injected() -> Optional[dict]:
 
 
 def mesh() -> "jax.sharding.Mesh":
+    """The process-wide mesh, rebuilt if the visible device set changed
+    since it was created (e.g. a fresh ``jax.distributed`` world came up
+    after ``dispose()``) — collectives over a mesh of dead/reordered
+    devices would silently misroute."""
     global _mesh
     if _mesh is None:
-        init()
+        return init()
+    if _device_fingerprint(jax.devices()) != _mesh_fingerprint:
+        log_warning("visible device set changed since the mesh was "
+                    "built; rebuilding the collective mesh")
+        spanned_all = int(_mesh.devices.size) == len(_mesh_fingerprint)
+        return init(0 if spanned_all else int(_mesh.devices.size))
     return _mesh
 
 
@@ -213,38 +260,57 @@ def allgather_obj(obj):
     of every rank's object (self included), rank-ordered.
 
     Uses the injected allgather when tests fake a multi-machine run
-    (init_with_functions), else jax.experimental.multihost_utils over DCN
-    for real multi-process meshes, else identity.
+    (init_with_functions), else the coordination-service KV transport
+    when a ``jax.distributed`` world is up (works on every backend and
+    turns a dead peer into an error naming the missing rank — see
+    ``distributed.kv_allgather_bytes``), else
+    jax.experimental.multihost_utils over DCN, else identity.
 
-    One transient failure is retried (recorded as a ``collective_retry``
-    fault event): host-level allgather runs over DCN during data loading,
-    where a single hiccup should not kill a long job.  A second failure
+    Transient failures are retried under the configured policy
+    (``collective_retries=`` extra attempts, exponential backoff,
+    per-attempt budget from ``collective_timeout_s=``; default retry
+    once), each retry recorded as a ``collective_retry`` fault event:
+    host-level allgather runs over DCN during data loading, where a
+    single hiccup should not kill a long job.  Exhausting the attempts
     propagates — a dead link is not transient.  The retry path is
     exercised deterministically via the ``collective/allgather`` fault
-    site."""
-    try:
-        return _allgather_obj_once(obj)
-    except LightGBMError:
-        raise                        # config/topology errors: not transient
-    except Exception as e:
-        from ..utils.telemetry import TELEMETRY
-        log_warning(f"allgather_obj failed ({type(e).__name__}: {e}); "
-                    "retrying once")
+    site, probed per attempt."""
+    from ..utils.retry import retry_call
+    from ..utils.telemetry import TELEMETRY
+    retries, _timeout_s, backoff_s = collective_policy()
+
+    def _on_retry(_k, e):
         TELEMETRY.fault_event("collective_retry",
                               site="collective/allgather", detail=str(e))
-        return _allgather_obj_once(obj)
+
+    return retry_call(lambda: _allgather_obj_once(obj),
+                      attempts=1 + retries, backoff_s=backoff_s,
+                      fatal=(LightGBMError,), on_retry=_on_retry,
+                      label="allgather_obj")
 
 
 def _allgather_obj_once(obj):
     import pickle
 
     from ..utils.faults import FAULTS
+    from . import distributed
     FAULTS.maybe_raise("collective/allgather")   # probed per attempt
     blob = pickle.dumps(obj)
     t0 = time.perf_counter()
     if _injected is not None:
         out = [pickle.loads(b) for b in _injected["allgather"](blob)]
         record_collective("allgather_obj", len(blob),
+                          time.perf_counter() - t0)
+        return out
+    if distributed.is_active():
+        # coordinator KV transport: backend-agnostic (XLA's CPU backend
+        # has no cross-process computations) with real per-call
+        # deadlines and missing-rank attribution
+        blobs = distributed.kv_allgather_bytes(
+            blob, timeout_s=collective_policy()[1], label="allgather_obj")
+        out = [pickle.loads(b) for b in blobs]
+        record_collective("allgather_obj",
+                          sum(len(b) for b in blobs),
                           time.perf_counter() - t0)
         return out
     if jax.process_count() == 1:
@@ -263,11 +329,45 @@ def _allgather_obj_once(obj):
     return out
 
 
+def probe_dispatch_collective(kind: Optional[str]) -> None:
+    """Deterministic fault probe at the eager dispatch seam of an
+    in-jit device collective (the grower's reduce-scatter/allgather/psum
+    runs INSIDE compiled programs where an injected Python exception
+    cannot fire, and donated carries cannot be re-dispatched — so the
+    fault site probes just before dispatch).  The site is named after
+    the canonical data-parallel histogram reduce-scatter and fires for
+    whichever grower collective is active.  Retried under the
+    configured policy like any transient DCN hiccup; a spec that never
+    heals (``x*``) exhausts the attempts and propagates."""
+    site = "collective/reduce_scatter" if kind else None
+    from ..utils.faults import FAULTS, KNOWN_SITES
+    if site not in KNOWN_SITES or not FAULTS.enabled:
+        return
+    from ..utils.retry import retry_call
+    from ..utils.telemetry import TELEMETRY
+    retries, _timeout_s, backoff_s = collective_policy()
+
+    def _on_retry(_k, e):
+        TELEMETRY.fault_event("collective_retry", site=site,
+                              detail=str(e))
+
+    retry_call(lambda: FAULTS.maybe_raise(site),
+               attempts=1 + retries, backoff_s=backoff_s,
+               fatal=(LightGBMError,), on_retry=_on_retry, label=site)
+
+
 def dispose() -> None:
     """Tear down the mesh/injection AND the collective counters —
     back-to-back runs in one process (tests, notebooks) must not leak
-    the previous run's call/byte totals into the next stats() blob."""
-    global _mesh, _injected
+    the previous run's call/byte totals into the next stats() blob.
+    Also shuts down a ``jax.distributed`` client that THIS process's
+    lifecycle layer created, so a later ``init()`` can bring up a fresh
+    world under a new size (an externally initialized world is left
+    alone)."""
+    global _mesh, _mesh_fingerprint, _injected
     _mesh = None
+    _mesh_fingerprint = None
     _injected = None
     reset_collective_stats()
+    from . import distributed
+    distributed.shutdown_owned()

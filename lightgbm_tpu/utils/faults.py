@@ -14,7 +14,8 @@ Spec grammar (env ``LIGHTGBM_TPU_FAULTS`` or config
 
 ``SITE`` is a registered site name (``chunk/oom``, ``grad/nonfinite``,
 ``snapshot/io``, ``train/kill``, ``collective/allgather``,
-``oocore/h2d``, ``oocore/admit``).  ``@START``
+``collective/reduce_scatter``, ``collective/barrier``, ``dist/init``,
+``dist/preempt``, ``oocore/h2d``, ``oocore/admit``).  ``@START``
 is the 0-based occurrence (or explicit index, e.g. iteration) at which
 the fault starts firing; default 0.  ``xCOUNT`` is how many
 occurrences fire; default 1, ``x*`` means every occurrence from START
@@ -53,7 +54,11 @@ KNOWN_SITES = frozenset([
     "grad/nonfinite",    # scores poisoned with NaN before the boost step
     "snapshot/io",       # snapshot write raises OSError
     "train/kill",        # CLI training loop dies between iterations
-    "collective/allgather",  # first attempt of allgather_obj fails
+    "collective/allgather",  # one attempt of allgather_obj fails
+    "collective/reduce_scatter",  # grower collective dispatch fails
+    "collective/barrier",    # cross-host barrier entry fails
+    "dist/init",         # jax.distributed.initialize handshake fails
+    "dist/preempt",      # host receives a preemption notice (SIGTERM)
     "oocore/h2d",        # bin-matrix host->device transfer raises OOM
     "oocore/admit",      # admission check decides the matrix won't fit
 ])

@@ -1,0 +1,127 @@
+"""Shared retry policy: attempts / exponential backoff / jitter /
+per-attempt timeout.
+
+The reference hand-rolls its retry loops per call site (socket connect
+retries in linkers_socket.cpp:116-143, allreduce re-sends); here the
+policy lives in one place so the collective layer
+(``parallel/network.py``), the distributed init handshake
+(``parallel/distributed.py``) and snapshot IO (``utils/snapshots.py``)
+share identical, *testable* semantics:
+
+  * ``attempts`` total tries (1 = no retry).
+  * exponential backoff between tries (``backoff_s * mult**k``) with a
+    DETERMINISTIC jitter — hashed from the label and attempt index, not
+    drawn from a global RNG, so armed fault specs replay identically
+    and a retrying run's model stays byte-identical.
+  * optional per-attempt wall timeout.  Python cannot cancel a stuck
+    call, so the timed-out worker thread is abandoned (daemonized) —
+    acceptable for the collective paths this guards, where a
+    genuinely wedged DCN call means the process is about to die
+    anyway, and the alternative (hanging forever on a dead host) is
+    the exact failure mode this layer exists to remove.
+
+Failures the caller knows to be non-transient (config/topology errors)
+are excluded via ``fatal`` and propagate immediately.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from .log import log_warning
+
+
+class RetryTimeout(RuntimeError):
+    """One attempt exceeded its per-attempt wall timeout."""
+
+    def __init__(self, label: str, timeout_s: float):
+        self.label = label
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"{label} timed out after {timeout_s:g}s (per-attempt limit)")
+
+
+def _deterministic_jitter(label: str, attempt: int, frac: float,
+                          delay: float) -> float:
+    """Jitter in [0, frac * delay), derived from (label, attempt) so two
+    runs of the same spec sleep identically."""
+    if frac <= 0 or delay <= 0:
+        return 0.0
+    h = hashlib.sha256(f"{label}#{attempt}".encode()).digest()
+    unit = int.from_bytes(h[:8], "big") / float(1 << 64)
+    return unit * frac * delay
+
+
+def call_with_timeout(fn: Callable, timeout_s: Optional[float],
+                      label: str = "call"):
+    """Run ``fn()`` with a wall timeout.  ``None``/``<= 0`` runs inline
+    (no thread).  On timeout raises :class:`RetryTimeout`; the stuck
+    worker thread is abandoned (see module docstring)."""
+    if not timeout_s or timeout_s <= 0:
+        return fn()
+    box: list = [None, None]          # [result, exception]
+    done = threading.Event()
+
+    def run():
+        try:
+            box[0] = fn()
+        except BaseException as e:    # noqa: BLE001 — re-raised below
+            box[1] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name=f"retry-{label}", daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        raise RetryTimeout(label, timeout_s)
+    if box[1] is not None:
+        raise box[1]
+    return box[0]
+
+
+def retry_call(fn: Callable, *,
+               attempts: int = 2,
+               backoff_s: float = 0.05,
+               backoff_mult: float = 2.0,
+               jitter_frac: float = 0.25,
+               timeout_s: Optional[float] = None,
+               fatal: Tuple[Type[BaseException], ...] = (),
+               on_retry: Optional[Callable] = None,
+               label: str = "call",
+               sleep: Callable[[float], None] = time.sleep):
+    """Call ``fn()`` up to ``attempts`` times.
+
+    ``fatal`` exception types propagate immediately (config/topology
+    errors are not transient).  Between tries the loop sleeps
+    ``backoff_s * backoff_mult**k`` plus deterministic jitter, and
+    ``on_retry(attempt_index, exception)`` is invoked once per retry —
+    the hook where call sites record their ``collective_retry`` /
+    ``snapshot_retry`` fault events.  Each attempt is bounded by
+    ``timeout_s`` when given (see :func:`call_with_timeout`).  The last
+    failure propagates unchanged.
+    """
+    attempts = max(1, int(attempts))
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            return call_with_timeout(fn, timeout_s, label=label)
+        except fatal:
+            raise
+        except BaseException as e:    # noqa: BLE001 — policy layer
+            last = e
+            if attempt + 1 >= attempts:
+                raise
+            delay = backoff_s * (backoff_mult ** attempt)
+            delay += _deterministic_jitter(label, attempt, jitter_frac,
+                                           delay)
+            log_warning(
+                f"{label} failed ({type(e).__name__}: {e}); retrying in "
+                f"{delay:.3f}s (attempt {attempt + 2}/{attempts})")
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if delay > 0:
+                sleep(delay)
+    raise last  # pragma: no cover — loop always returns or raises

@@ -80,7 +80,15 @@ def _set_rng_state(rng: np.random.RandomState, keys, meta, cached) -> None:
 def save_snapshot(gbdt, snapshot_file: str, model_text: str) -> None:
     """Write one resumable snapshot: exact-state sidecar first, then the
     model text — both atomically.  ``gbdt.iter_`` must equal the
-    iteration the snapshot file name claims."""
+    iteration the snapshot file name claims.
+
+    The write is retried once through the shared retry policy
+    (``utils/retry.py``): a transient IO failure (NFS hiccup, full-then-
+    pruned disk) costs a ``snapshot_retry`` fault event instead of the
+    snapshot; a persistent one propagates ``OSError`` to the caller,
+    whose job is to decide whether a lost snapshot aborts the run (the
+    CLI continues).  The deterministic ``snapshot/io`` fault site is
+    probed per attempt."""
     bag_keys, bag_meta, bag_cached = _rng_state(gbdt._bag_rng)
     feat_keys, feat_meta, feat_cached = _rng_state(gbdt._feat_rng)
     arrays = {
@@ -98,8 +106,24 @@ def save_snapshot(gbdt, snapshot_file: str, model_text: str) -> None:
     }
     for i, vs in enumerate(gbdt.valid_scores):
         arrays[f"valid_score_{i}"] = np.asarray(vs, dtype=np.float64)
-    _atomic_savez(state_path(snapshot_file), **arrays)
-    atomic_write_text(snapshot_file, model_text)
+
+    def _write():
+        from .faults import FAULTS
+        FAULTS.maybe_raise(
+            "snapshot/io",
+            lambda site: OSError(f"injected IO failure at {site}"))
+        _atomic_savez(state_path(snapshot_file), **arrays)
+        atomic_write_text(snapshot_file, model_text)
+
+    def _on_retry(_k, e):
+        from .telemetry import TELEMETRY
+        TELEMETRY.fault_event("snapshot_retry", site="snapshot/io",
+                              iteration=int(gbdt.iter_), detail=str(e))
+
+    from .retry import retry_call
+    retry_call(_write, attempts=2, backoff_s=0.02,
+               fatal=(LightGBMError,), on_retry=_on_retry,
+               label="snapshot_write")
     # narrate the durable point into the run-health stream: a live
     # monitor can tell how much work a kill would lose
     from .telemetry import HEALTH

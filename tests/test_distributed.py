@@ -1,0 +1,407 @@
+"""Multi-host robustness tests: the shared retry policy, the hardened
+collective seam, distributed launch detection, snapshot election, and —
+slow-marked — real 2-process ``jax.distributed`` runs on the CPU
+backend exercising the ISSUE acceptance criteria: coordinated
+preemption with bit-exact resume, and a dead host tripping the barrier
+timeout with an error naming the missing rank instead of hanging.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.cli import Application
+from lightgbm_tpu.parallel import distributed, network
+from lightgbm_tpu.utils.faults import ENV_FAULTS, FAULTS, InjectedFault
+from lightgbm_tpu.utils.log import LightGBMError
+from lightgbm_tpu.utils.retry import (RetryTimeout, _deterministic_jitter,
+                                      call_with_timeout, retry_call)
+from lightgbm_tpu.utils.telemetry import TELEMETRY
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+_MARKER_VARS = (
+    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+    "SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE", "SLURM_PROCID",
+    "OMPI_COMM_WORLD_RANK",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Marker env vars and fault state must not leak between tests (or
+    in from the machine running the suite)."""
+    for var in _MARKER_VARS + (distributed.ENV_COORDINATOR,
+                               distributed.ENV_NUM_HOSTS,
+                               distributed.ENV_HOST_RANK):
+        monkeypatch.delenv(var, raising=False)
+    TELEMETRY.reset()
+    yield
+    os.environ.pop(ENV_FAULTS, None)
+    FAULTS.configure()
+    network._policy.update(retries=1, timeout_s=120.0, backoff_s=0.05)
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv(ENV_FAULTS, spec)
+    FAULTS.configure()
+
+
+# ------------------------------------------------------------ retry policy
+def test_retry_call_recovers_from_transient():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    retried = []
+    out = retry_call(flaky, attempts=4, backoff_s=0.001,
+                     on_retry=lambda k, e: retried.append((k, str(e))))
+    assert out == "ok"
+    assert len(calls) == 3
+    assert [k for k, _ in retried] == [0, 1]
+
+
+def test_retry_call_exhausts_and_propagates_last():
+    with pytest.raises(OSError, match="always"):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("always")),
+                   attempts=3, backoff_s=0.001)
+
+
+def test_retry_call_fatal_skips_retry():
+    calls = []
+
+    def fatal():
+        calls.append(1)
+        raise LightGBMError("config error")
+
+    with pytest.raises(LightGBMError):
+        retry_call(fatal, attempts=5, backoff_s=0.001,
+                   fatal=(LightGBMError,))
+    assert len(calls) == 1               # not transient: no second try
+
+
+def test_call_with_timeout():
+    assert call_with_timeout(lambda: 42, None) == 42
+    assert call_with_timeout(lambda: 42, 5.0) == 42
+    import time as _time
+    with pytest.raises(RetryTimeout, match="per-attempt limit"):
+        call_with_timeout(lambda: _time.sleep(10), 0.05, label="stuck")
+    # exceptions inside the timed thread re-raise in the caller
+    with pytest.raises(ValueError, match="inner"):
+        call_with_timeout(
+            lambda: (_ for _ in ()).throw(ValueError("inner")), 5.0)
+
+
+def test_jitter_is_deterministic():
+    a = _deterministic_jitter("allgather_obj", 1, 0.25, 0.1)
+    b = _deterministic_jitter("allgather_obj", 1, 0.25, 0.1)
+    assert a == b                        # replayable: no global RNG
+    assert 0.0 <= a < 0.025
+    assert _deterministic_jitter("allgather_obj", 2, 0.25, 0.1) != a
+
+
+# ----------------------------------------------- hardened collective seam
+def test_collective_retries_configurable(monkeypatch):
+    """collective_retries=3 survives three consecutive failures where
+    the historical retry-once would have died."""
+    from lightgbm_tpu.config import Config
+    network.configure(Config.from_params({"collective_retries": "3",
+                                          "collective_timeout_s": "30"}))
+    _arm(monkeypatch, "collective/allgather@0x3")
+    assert network.allgather_obj({"r": 0}) == [{"r": 0}]
+    counts = TELEMETRY.stats()["faults"]["counts"]
+    assert counts["collective_retry"] == 3
+
+
+def test_collective_retries_zero_disables_retry(monkeypatch):
+    from lightgbm_tpu.config import Config
+    network.configure(Config.from_params({"collective_retries": "0"}))
+    _arm(monkeypatch, "collective/allgather")   # single fire
+    with pytest.raises(InjectedFault):
+        network.allgather_obj({"r": 0})
+
+
+def test_config_rejects_bad_collective_knobs():
+    from lightgbm_tpu.config import Config
+    with pytest.raises(ValueError, match="collective_retries"):
+        Config.from_params({"collective_retries": "-1"})
+    with pytest.raises(ValueError, match="collective_timeout_s"):
+        Config.from_params({"collective_timeout_s": "0"})
+    with pytest.raises(ValueError, match="host_rank"):
+        Config.from_params({"coordinator_address": "h:1",
+                            "num_hosts": "2", "host_rank": "2"})
+
+
+def test_snapshot_write_retries_transient_io(tmp_path, rng, monkeypatch):
+    """A single-fire snapshot/io fault is now absorbed by the shared
+    retry (snapshot_retry event, snapshot still written) instead of
+    costing the snapshot."""
+    X = rng.rand(300, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * rng.rand(300)
+    np.savetxt(tmp_path / "train.csv", np.column_stack([y, X]),
+               delimiter=",", fmt="%.6f")
+    monkeypatch.chdir(tmp_path)
+    _arm(monkeypatch, "snapshot/io")          # first write attempt only
+    Application(["task=train", "data=train.csv", "label_column=0",
+                 "objective=regression", "num_iterations=4",
+                 "num_leaves=7", "min_data_in_leaf=5", "verbosity=-1",
+                 "snapshot_freq=2", "output_model=model.txt",
+                 "metrics_out=metrics.json"]).run()
+    assert (tmp_path / "model.txt.snapshot_iter_2").exists()
+    assert (tmp_path / "model.txt.snapshot_iter_4").exists()
+    blob = json.loads((tmp_path / "metrics.json").read_text())
+    counts = blob["faults"]["counts"]
+    assert counts["snapshot_retry"] == 1
+    assert "snapshot_io" not in counts        # nothing was lost
+
+
+# ------------------------------------------------- mesh/dispose regression
+class _FakeDev:
+    def __init__(self, i, proc=0):
+        self.id = i
+        self.process_index = proc
+
+
+def test_mesh_rebuilds_when_device_set_changes(monkeypatch):
+    import jax
+    network.dispose()
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a: [_FakeDev(i) for i in range(4)])
+    m1 = network.init()
+    assert m1.devices.size == 4
+    assert network.mesh() is m1              # unchanged world: cached
+    # a fresh jax.distributed world after dispose(): different device
+    # identity/order — mesh() must rebuild, not reuse stale ordering
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a: [_FakeDev(i, proc=i % 2)
+                                    for i in range(8)])
+    m2 = network.mesh()
+    assert m2 is not m1
+    assert m2.devices.size == 8              # spanned-all meshes re-span
+    network.dispose()
+
+
+def test_dispose_shuts_down_owned_distributed_client(monkeypatch):
+    calls = []
+    monkeypatch.setattr(distributed, "_state", distributed._State())
+    distributed._state.owned = True
+    import jax
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: calls.append(1))
+    network.dispose()
+    assert calls == [1]
+    assert not distributed._state.owned
+    # an adopted (externally initialized) world is never torn down
+    network.dispose()
+    assert calls == [1]
+
+
+# ------------------------------------------- launch detection / binning_world
+@pytest.mark.parametrize("var,val,fatal", [
+    ("SLURM_JOB_NUM_NODES", "1", False),      # single node: serial is right
+    ("SLURM_JOB_NUM_NODES", "2", True),
+    ("SLURM_JOB_NUM_NODES", "weird", True),   # unparsable: assume multi
+    ("OMPI_COMM_WORLD_SIZE", "1", False),
+    ("OMPI_COMM_WORLD_SIZE", "4", True),
+    ("TPU_WORKER_HOSTNAMES", "host-0", False),  # single-host pod slice
+    ("TPU_WORKER_HOSTNAMES", "host-0,host-1", True),
+    ("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234", True),
+    ("COORDINATOR_ADDRESS", "10.0.0.1:1234", True),
+    ("MEGASCALE_COORDINATOR_ADDRESS", "10.0.0.1:1234", True),
+])
+def test_binning_world_launch_markers(monkeypatch, var, val, fatal):
+    """With the jax distributed-state API unavailable, binning_world
+    must refuse to silently run serial when a multi-process launch
+    marker is present — and must NOT die on single-node markers."""
+    import jax._src.distributed
+    monkeypatch.setattr(jax._src.distributed, "global_state", object())
+    monkeypatch.setenv(var, val)
+    if fatal:
+        with pytest.raises(LightGBMError, match=var):
+            network.binning_world()
+    else:
+        assert network.binning_world() == (1, 0)
+
+
+def test_binning_world_no_markers_warns_serial(monkeypatch):
+    import jax._src.distributed
+    monkeypatch.setattr(jax._src.distributed, "global_state", object())
+    assert network.binning_world() == (1, 0)
+
+
+def test_detect_launch_env_and_config(monkeypatch):
+    assert distributed.detect_launch(None) is None
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params({"coordinator_address": "10.0.0.1:9999",
+                              "num_hosts": "4", "host_rank": "2"})
+    assert distributed.detect_launch(cfg) == ("10.0.0.1:9999", 4, 2)
+    # env fallbacks win over config (launcher-controlled)
+    monkeypatch.setenv(distributed.ENV_COORDINATOR, "10.0.0.2:1111")
+    monkeypatch.setenv(distributed.ENV_NUM_HOSTS, "2")
+    monkeypatch.setenv(distributed.ENV_HOST_RANK, "1")
+    assert distributed.detect_launch(cfg) == ("10.0.0.2:1111", 2, 1)
+
+
+def test_detect_launch_infers_rank_from_slurm(monkeypatch):
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params({"coordinator_address": "10.0.0.1:9999"})
+    monkeypatch.setenv("SLURM_JOB_NUM_NODES", "2")
+    monkeypatch.setenv("SLURM_PROCID", "1")
+    assert distributed.detect_launch(cfg) == ("10.0.0.1:9999", 2, 1)
+
+
+def test_detect_launch_partial_spec_is_actionable():
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params({"coordinator_address": "10.0.0.1:9999"})
+    with pytest.raises(LightGBMError, match="num_hosts"):
+        distributed.detect_launch(cfg)
+
+
+# ----------------------------------------------------- election / barrier
+def test_elect_common_iteration():
+    elect = distributed.elect_common_iteration
+    assert elect([[2, 4, 6], [4, 6], [2, 4]]) == 4
+    assert elect([[2, 4], [6]]) == 0          # nothing shared
+    assert elect([[], [2]]) == 0
+    assert elect([]) == 0
+
+
+def test_local_snapshot_manifest_requires_sidecar(tmp_path):
+    model = str(tmp_path / "m.txt")
+    for it in (2, 4, 6):
+        (tmp_path / f"m.txt.snapshot_iter_{it}").write_text("x")
+        if it != 6:                           # 6 is torn: model, no state
+            (tmp_path / f"m.txt.snapshot_iter_{it}.state.npz").write_bytes(
+                b"x")
+    assert distributed.local_snapshot_manifest(model) == [2, 4]
+
+
+def test_single_process_noops():
+    assert not distributed.is_active()
+    assert distributed.barrier("anything") == 0.0
+    assert distributed.negotiate_preempt_target(7) == 7
+    path, it = distributed.elect_snapshot("/nonexistent/m.txt")
+    assert path is None and it == 0
+
+
+# ---------------------------------------------- 2-process acceptance (slow)
+def _write_csv(path, seed, n=300):
+    r = np.random.RandomState(seed)
+    X = r.rand(n, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * r.rand(n)
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+
+
+def _fleet_argv(extra=()):
+    # relative paths + per-rank cwd: identical argv across runs keeps
+    # the saved model byte-comparable (parameters section included)
+    return [sys.executable, "-m", "lightgbm_tpu", "task=train",
+            "data=train.csv", "label_column=0", "objective=regression",
+            "num_iterations=8", "num_leaves=7", "min_data_in_leaf=5",
+            "verbosity=1", "snapshot_freq=2", "tpu_boost_chunk=1",
+            "seed=7", "collective_timeout_s=60",
+            "output_model=model.txt", "metrics_out=metrics.json",
+            "health_out=health.jsonl", *extra]
+
+
+def _run_fleet(dirs, argvs, timeout_s=240.0):
+    from launch_multihost import launch
+    logs = [open(os.path.join(d, "run.log"), "a") for d in dirs]
+    try:
+        run = launch(argvs, cwds=[str(d) for d in dirs], stdouts=logs)
+        return run.wait(timeout_s=timeout_s)
+    finally:
+        for fh in logs:
+            fh.close()
+
+
+@pytest.mark.slow
+def test_preempt_and_resume_bitexact_across_hosts(tmp_path):
+    """ISSUE acceptance: dist/preempt on one host drains BOTH hosts to
+    one synchronized snapshot (exit 75); restarting with resume=true
+    elects that snapshot on both hosts and the final models are
+    byte-identical to an uninterrupted 2-host run."""
+    seed = 1234
+    dirs = {}
+    for run_name in ("a", "b"):
+        for r in (0, 1):
+            d = tmp_path / f"{run_name}{r}"
+            d.mkdir()
+            _write_csv(d / "train.csv", seed)
+            dirs[run_name, r] = d
+
+    # uninterrupted reference fleet
+    codes = _run_fleet([dirs["a", 0], dirs["a", 1]],
+                       [_fleet_argv(), _fleet_argv()])
+    assert codes == [0, 0]
+
+    # rank 0 is preempted at iteration 3: both ranks must drain to the
+    # same agreed iteration, snapshot, and leave with the preempt code
+    codes = _run_fleet(
+        [dirs["b", 0], dirs["b", 1]],
+        [_fleet_argv(["fault_injection=dist/preempt@3"]), _fleet_argv()])
+    assert codes == [distributed.PREEMPT_EXIT_CODE,
+                     distributed.PREEMPT_EXIT_CODE]
+    for r in (0, 1):
+        assert not (dirs["b", r] / "model.txt").exists()
+
+    # both hosts must hold a common snapshot generation; the restart
+    # elects it, resumes, and finishes bit-exactly
+    codes = _run_fleet(
+        [dirs["b", 0], dirs["b", 1]],
+        [_fleet_argv(["resume=true"]), _fleet_argv(["resume=true"])])
+    assert codes == [0, 0]
+    for r in (0, 1):
+        log = (dirs["b", r] / "run.log").read_text()
+        assert "elected snapshot iteration" in log
+        assert ((dirs["b", r] / "model.txt").read_bytes()
+                == (dirs["a", r] / "model.txt").read_bytes())
+
+
+@pytest.mark.slow
+def test_dead_host_trips_barrier_timeout_naming_rank(tmp_path):
+    """ISSUE acceptance: a permanently-dead host surfaces as a barrier
+    timeout naming the missing rank — an actionable error, not a hang."""
+    dirs = []
+    for r in (0, 1):
+        d = tmp_path / f"d{r}"
+        d.mkdir()
+        _write_csv(d / "train.csv", 99)
+        dirs.append(d)
+    # rank 1 dies at iteration 3 (train/kill); rank 0's next snapshot
+    # barrier must expire within collective_timeout_s naming rank 1
+    codes = _run_fleet(
+        dirs,
+        [_fleet_argv(["collective_timeout_s=10"]),
+         _fleet_argv(["collective_timeout_s=10",
+                      "fault_injection=train/kill@3"])])
+    assert codes[0] != 0 and codes[1] != 0
+    log0 = (dirs[0] / "run.log").read_text()
+    assert "missing rank(s) [1]" in log0
+    assert "barrier 'snapshot' timed out" in log0
+
+
+@pytest.mark.slow
+def test_launch_multihost_cli(tmp_path):
+    """The tool's CLI mode: {rank} substitution + per-rank env."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "launch_multihost.py"),
+         "--hosts", "2", "--",
+         sys.executable, "-c",
+         "import os; print('R', os.environ['LIGHTGBM_TPU_HOST_RANK'], "
+         "'{rank}')"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert "rank 0: exit 0" in out.stdout
+    assert "rank 1: exit 0" in out.stdout

@@ -11,6 +11,12 @@
 # (docs/ROBUSTNESS.md) — is exercised in CI-shaped form with per-group
 # process isolation.
 #
+# A third pass runs the multi-host suite (tests/test_distributed.py,
+# including its slow-marked 2-process fleets) over the collective/* and
+# dist/* sites at world=2: hardened allgather retries, barrier timeouts
+# naming the dead rank, and the dist/preempt drain -> synchronized
+# snapshot -> bit-exact resume cycle.
+#
 #   tools/fault_matrix.sh [extra pytest args...]
 #
 # FAULT_MATRIX_CHUNK is deliberately NOT LIGHTGBM_TPU_-prefixed: the test
@@ -35,4 +41,11 @@ for chunk in 1 4; do
     fi
   done
 done
+
+echo "=== fault matrix: multi-host (world=2) sites=collective/*,dist/* ==="
+if ! JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_distributed.py -q -p no:cacheprovider \
+    "$@"; then
+  status=1
+fi
 exit ${status}

@@ -152,6 +152,27 @@ def generate() -> str:
         "  testing; the `LIGHTGBM_TPU_FAULTS` env var overrides per-site.",
         "  Runtime-only: never serialized into the model.  See",
         "  docs/ROBUSTNESS.md for the grammar and the site list.",
+        "- `coordinator_address` / `num_hosts` / `host_rank` — explicit",
+        "  multi-host launch spec for `jax.distributed.initialize`",
+        "  (`host:port`, world size, this process's rank; `host_rank=-1`",
+        "  auto-detects from SLURM/OpenMPI launcher variables).  The",
+        "  `LIGHTGBM_TPU_COORDINATOR_ADDRESS` / `_NUM_HOSTS` /",
+        "  `_HOST_RANK` env vars (what `tools/launch_multihost.py` sets)",
+        "  take priority; a partial spec is a loud error.  An externally",
+        "  initialized world is adopted, never re-initialized.",
+        "  Runtime-only: never serialized into the model.  See",
+        "  docs/ROBUSTNESS.md (multi-host recovery).",
+        "- `collective_retries` — attempts beyond the first for every",
+        "  cross-host collective seam (object allgather, the",
+        "  pre-dispatch reduce-scatter probe, distributed init); default",
+        "  `1` preserves the historical retry-once.  `0` disables",
+        "  retries.  Each retry is a `collective_retry` fault event.",
+        "  Runtime-only: never serialized into the model.",
+        "- `collective_timeout_s` — per-attempt budget (seconds, default",
+        "  `120`) for KV-store collectives and the cross-host barriers at",
+        "  snapshot/resume/preempt boundaries.  An expired barrier raises",
+        "  an error naming the missing rank(s) instead of hanging the",
+        "  fleet.  Runtime-only: never serialized into the model.",
         "",
     ]
     return "\n".join(lines)
