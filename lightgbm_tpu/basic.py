@@ -528,6 +528,49 @@ class Booster:
         return self.gbdt.predict(X, num_iteration=num_iteration,
                                  raw_score=raw_score, pred_leaf=pred_leaf)
 
+    def refit(self, data, label, weight=None, decay_rate: float = None
+              ) -> "Booster":
+        """Re-estimate every leaf's output on fresh (data, label) without
+        changing the tree structure (reference ``Booster.refit``): each
+        leaf blends its old value with the gradient-optimal one,
+        ``new = decay * old + (1 - decay) * opt``.  Lets a served model
+        absorb new data without a retrain; returns self."""
+        from .core.metadata import Metadata
+        from .models.refit import refit_model
+        if not self.gbdt.models:
+            raise LightGBMError("cannot refit a model with no trees")
+        leaf_preds = np.asarray(self.predict(data, pred_leaf=True),
+                                dtype=np.int32)
+        if leaf_preds.ndim == 1:
+            leaf_preds = leaf_preds[:, None]
+        md = Metadata(leaf_preds.shape[0])
+        md.init(leaf_preds.shape[0])
+        md.set_label(np.asarray(label))
+        if weight is not None:
+            md.set_weights(np.asarray(weight))
+        config = self.config
+        if decay_rate is not None:
+            import copy
+            config = copy.copy(config)
+            config.refit_decay_rate = float(decay_rate)
+        refit_model(self.gbdt, md, leaf_preds, config)
+        return self
+
+    def serve(self, model_id: str = None, num_iteration: int = -1,
+              session=None, **overrides):
+        """A compiled micro-batching prediction handle for this model
+        (lightgbm_tpu/serve, docs/SERVING.md).  Knobs come from this
+        booster's ``serve_*`` params unless overridden; pass an existing
+        :class:`~lightgbm_tpu.serve.ServeSession` to co-host several
+        models in one device pack and one queue."""
+        from .serve import ServeHandle, ServeSession
+        owns = session is None
+        if owns:
+            session = ServeSession.from_config(self.config, **overrides)
+        mid = session.load(self, model_id=model_id,
+                           num_iteration=num_iteration)
+        return ServeHandle(session, mid, owns_session=owns)
+
     # ---------------------------------------------------------------- model
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":

@@ -274,6 +274,24 @@ _PARAMS: Dict[str, _P] = {
     # fixed-order row-blocks per dispatch window.  Bit-identical models
     # either way.  Runtime-only: never serialized into the model
     "data_in_hbm": _P("auto"),
+    # --- prediction service (lightgbm_tpu/serve, docs/SERVING.md) ---
+    # how Booster.predict routes: "auto" = compiled stacked-tensor
+    # routing (models/device_predict.py) when an accelerator is
+    # attached, host tree walk otherwise; "on" = always the device
+    # path (useful for parity testing on CPU); "off" = always the
+    # host walk.  Output is bit-identical either way.  Runtime-only
+    "predict_device": _P("auto"),
+    # rows per serve dispatch AND the cap a micro-batching queue
+    # drain coalesces up to; larger batches amortize dispatch
+    # overhead at the price of padding small traffic up to a bucket
+    "serve_max_batch": _P(256),
+    # how long (ms) the serve queue holds the oldest pending request
+    # hoping to coalesce more rows into the same dispatch; 0 =
+    # dispatch-per-request (lowest latency, most dispatches)
+    "serve_max_delay_ms": _P(2.0),
+    # give-up budget for one queued serve request; a stuck dispatch
+    # surfaces as a named ServeError instead of a hang
+    "serve_queue_timeout_s": _P(30.0),
 }
 
 # runtime-only knobs excluded from a saved model's ``parameters:``
@@ -285,7 +303,10 @@ RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection",
                                  "profile_window", "data_in_hbm",
                                  "coordinator_address", "num_hosts",
                                  "host_rank", "collective_retries",
-                                 "collective_timeout_s"])
+                                 "collective_timeout_s",
+                                 "predict_device", "serve_max_batch",
+                                 "serve_max_delay_ms",
+                                 "serve_queue_timeout_s"])
 
 # alias -> canonical name
 ALIAS_TABLE: Dict[str, str] = {}
@@ -485,6 +506,17 @@ class Config:
                 f"[0, num_hosts={self.num_hosts}) when "
                 "coordinator_address is set (or -1 to auto-detect)")
         self.data_in_hbm = dib
+        pd = str(self.predict_device).strip().lower() or "auto"
+        if pd not in ("auto", "on", "off"):
+            raise ValueError("predict_device must be one of auto, on, off "
+                             f"(got {self.predict_device!r})")
+        self.predict_device = pd
+        if self.serve_max_batch < 1:
+            raise ValueError("serve_max_batch must be >= 1")
+        if self.serve_max_delay_ms < 0:
+            raise ValueError("serve_max_delay_ms must be >= 0")
+        if self.serve_queue_timeout_s <= 0:
+            raise ValueError("serve_queue_timeout_s must be > 0")
 
     # -- accessors --
     def to_dict(self) -> Dict[str, Any]:

@@ -35,13 +35,10 @@ class TreeStack(NamedTuple):
     max_depth: int             # static bound on routing steps
 
 
-def stack_trees(trees: List, num_features: int = -1) -> TreeStack:
-    """Stack host Tree objects (with inner thresholds) into a TreeStack.
-
-    ``num_features``, when given, validates that every split references a
-    feature inside the bin matrix (out-of-range splits would otherwise
-    become silent clipped gathers inside the jitted predict).
-    """
+def stack_trees_host(trees: List, num_features: int = -1):
+    """Numpy side of :func:`stack_trees`: (fields..., max_depth) without
+    the device upload — serve/registry.py packs several models' host
+    stacks into shared [M, ...] buffers before a single upload."""
     T = len(trees)
     for i, t in enumerate(trees):
         if not getattr(t, "bins_aligned", True):
@@ -84,9 +81,72 @@ def stack_trees(trees: List, num_features: int = -1) -> TreeStack:
                 cb[i, node, : min(len(words), 8)] = words[:8]
                 tb[i, node] = 0
         depth = max(depth, t.max_depth)
+    return sf, tb, dt, lc, rc, cb, lv, nl, int(depth)
+
+
+def stack_trees(trees: List, num_features: int = -1) -> TreeStack:
+    """Stack host Tree objects (with inner thresholds) into a TreeStack.
+
+    ``num_features``, when given, validates that every split references a
+    feature inside the bin matrix (out-of-range splits would otherwise
+    become silent clipped gathers inside the jitted predict).
+    """
+    sf, tb, dt, lc, rc, cb, lv, nl, depth = stack_trees_host(trees,
+                                                             num_features)
     return TreeStack(jnp.asarray(sf), jnp.asarray(tb), jnp.asarray(dt),
                      jnp.asarray(lc), jnp.asarray(rc), jnp.asarray(cb),
                      jnp.asarray(lv), jnp.asarray(nl), int(depth))
+
+
+def _tree_leaves(stack: TreeStack, tree_idx, bins: jax.Array,
+                 fmeta_num_bin: jax.Array, fmeta_default_bin: jax.Array,
+                 feat_group, feat_offset) -> jax.Array:
+    """Leaf index of every row under tree ``tree_idx``: [N] i32."""
+    n = bins.shape[0]
+    sf = stack.split_feature[tree_idx]
+    tb = stack.threshold_bin[tree_idx]
+    dt = stack.decision_type[tree_idx]
+    lc = stack.left_child[tree_idx]
+    rc = stack.right_child[tree_idx]
+    cb = stack.cat_bitset[tree_idx]
+
+    def step(_, node):
+        internal = node >= 0
+        safe = jnp.maximum(node, 0)
+        f = sf[safe]
+        col = f if feat_group is None else feat_group[f]
+        fv = jnp.take_along_axis(
+            bins, col[:, None].astype(jnp.int32), axis=1)[:, 0] \
+            .astype(jnp.int32)
+        if feat_group is not None:
+            off = feat_offset[f]
+            in_range = (fv >= off) & (fv < off + fmeta_num_bin[f])
+            fv = jnp.where(in_range, fv - off, fmeta_default_bin[f])
+        d = dt[safe]
+        is_cat = (d & 1) > 0
+        mt = (d >> 2) & 3
+        dl = (d & 2) > 0
+        is_missing = (((mt == MISSING_ZERO)
+                       & (fv == fmeta_default_bin[f]))
+                      | ((mt == MISSING_NAN)
+                         & (fv == fmeta_num_bin[f] - 1)))
+        num_left = jnp.where(is_missing, dl, fv <= tb[safe])
+        # negative bin = "category never seen in training" sentinel from
+        # predict-time binning (training bins are always >= 0): the host
+        # float walk sends unseen/negative/NaN categories right
+        word = cb[safe, jnp.clip(fv // 32, 0, 7)]
+        cat_left = (((word >> (fv % 32).astype(jnp.uint32)) & 1) > 0) \
+            & (fv >= 0)
+        go_left = jnp.where(is_cat, cat_left, num_left)
+        nxt = jnp.where(go_left, lc[safe], rc[safe])
+        return jnp.where(internal, nxt, node)
+
+    # single-leaf trees start terminal at node -1 (= leaf ~(-1) = 0)
+    start = jnp.where(stack.num_leaves[tree_idx] <= 1,
+                      jnp.full(n, -1, dtype=jnp.int32),
+                      jnp.zeros(n, dtype=jnp.int32))
+    node = lax.fori_loop(0, stack.max_depth + 1, step, start)
+    return jnp.maximum(~node, 0)
 
 
 def predict_binned_ensemble(stack: TreeStack, bins: jax.Array,
@@ -102,52 +162,36 @@ def predict_binned_ensemble(stack: TreeStack, bins: jax.Array,
     values meaning "f at its default bin"."""
     n = bins.shape[0]
 
-    def route_one_tree(carry, tree_idx):
-        total = carry
-        sf = stack.split_feature[tree_idx]
-        tb = stack.threshold_bin[tree_idx]
-        dt = stack.decision_type[tree_idx]
-        lc = stack.left_child[tree_idx]
-        rc = stack.right_child[tree_idx]
-        cb = stack.cat_bitset[tree_idx]
-        lv = stack.leaf_value[tree_idx]
-
-        def step(_, node):
-            internal = node >= 0
-            safe = jnp.maximum(node, 0)
-            f = sf[safe]
-            col = f if feat_group is None else feat_group[f]
-            fv = jnp.take_along_axis(
-                bins, col[:, None].astype(jnp.int32), axis=1)[:, 0] \
-                .astype(jnp.int32)
-            if feat_group is not None:
-                off = feat_offset[f]
-                in_range = (fv >= off) & (fv < off + fmeta_num_bin[f])
-                fv = jnp.where(in_range, fv - off, fmeta_default_bin[f])
-            d = dt[safe]
-            is_cat = (d & 1) > 0
-            mt = (d >> 2) & 3
-            dl = (d & 2) > 0
-            is_missing = (((mt == MISSING_ZERO)
-                           & (fv == fmeta_default_bin[f]))
-                          | ((mt == MISSING_NAN)
-                             & (fv == fmeta_num_bin[f] - 1)))
-            num_left = jnp.where(is_missing, dl, fv <= tb[safe])
-            word = cb[safe, jnp.clip(fv // 32, 0, 7)]
-            cat_left = ((word >> (fv % 32).astype(jnp.uint32)) & 1) > 0
-            go_left = jnp.where(is_cat, cat_left, num_left)
-            nxt = jnp.where(go_left, lc[safe], rc[safe])
-            return jnp.where(internal, nxt, node)
-
-        # single-leaf trees start terminal at node -1 (= leaf ~(-1) = 0)
-        start = jnp.where(stack.num_leaves[tree_idx] <= 1,
-                          jnp.full(n, -1, dtype=jnp.int32),
-                          jnp.zeros(n, dtype=jnp.int32))
-        node = lax.fori_loop(0, stack.max_depth + 1, step, start)
-        leaf = jnp.maximum(~node, 0)
-        return total + lv[leaf], None
+    def route_one_tree(total, tree_idx):
+        leaf = _tree_leaves(stack, tree_idx, bins, fmeta_num_bin,
+                            fmeta_default_bin, feat_group, feat_offset)
+        return total + stack.leaf_value[tree_idx][leaf], None
 
     init = jnp.zeros(n, dtype=jnp.float32)
     total, _ = lax.scan(route_one_tree, init,
                         jnp.arange(stack.split_feature.shape[0]))
     return total
+
+
+def predict_binned_leaves(stack: TreeStack, bins: jax.Array,
+                          fmeta_num_bin: jax.Array,
+                          fmeta_default_bin: jax.Array,
+                          feat_group: jax.Array = None,
+                          feat_offset: jax.Array = None) -> jax.Array:
+    """Per-tree leaf assignment for binned rows: [T, N] i32.
+
+    Routing is identical to :func:`predict_binned_ensemble`; returning
+    the leaf INDEX instead of the f32 leaf-value sum lets callers gather
+    the float64 leaf values on the host and accumulate tree-by-tree in
+    the exact order (and precision) of the host walk
+    (``GBDT._raw_predict``) — device-routed predictions become
+    bit-identical to the host fallback instead of merely close."""
+
+    def route_one_tree(_, tree_idx):
+        leaf = _tree_leaves(stack, tree_idx, bins, fmeta_num_bin,
+                            fmeta_default_bin, feat_group, feat_offset)
+        return 0, leaf
+
+    _, leaves = lax.scan(route_one_tree, 0,
+                         jnp.arange(stack.split_feature.shape[0]))
+    return leaves

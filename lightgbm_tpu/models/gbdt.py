@@ -54,6 +54,27 @@ class _PendingChunk(NamedTuple):
     wall_s: Optional[float] = None
 
 
+# batch-predict routing seams, one per static routing depth: the
+# TreeStack's max_depth is the fori_loop bound and must stay a python
+# int for AOT compilation, so it cannot ride through the jit arguments
+_ROUTE_SEAMS: Dict[int, Any] = {}
+
+
+def _route_seam(max_depth: int):
+    fn = _ROUTE_SEAMS.get(max_depth)
+    if fn is None:
+        from .device_predict import predict_binned_leaves
+
+        def leaves_fn(stack, bins, num_bin, default_bin):
+            return predict_binned_leaves(
+                stack._replace(max_depth=max_depth), bins, num_bin,
+                default_bin)
+
+        fn = cost_jit(f"predict/route[d{max_depth}]", jax.jit(leaves_fn))
+        _ROUTE_SEAMS[max_depth] = fn
+    return fn
+
+
 def _maybe_print_seg_stats(stats) -> None:
     """Render a grower's counter output when LIGHTGBM_TPU_SEG_STATS asks
     for it (stats is () for growers that emit none, e.g. the fused one).
@@ -2025,6 +2046,94 @@ class GBDT:
                 out[k] += self.models[it * C + k].predict_raw(X)
         return out
 
+    def _device_route_ok(self) -> bool:
+        """Whether batch prediction may use the compiled stacked-tensor
+        route (models/device_predict.py) instead of the host tree walk.
+        Gated by the ``predict_device`` knob ("auto" = accelerator only —
+        on CPU the jit round-trip would cost more than the walk), and
+        requires the training BinMappers (file-loaded boosters without a
+        bound dataset fall back) plus bin-aligned trees.  Per-row early
+        stopping (pred_early_stop) is host-only by design."""
+        pd = str(getattr(self.config, "predict_device", "off"))
+        if pd == "off":
+            return False
+        if pd == "auto":
+            try:
+                if jax.default_backend() == "cpu":
+                    return False
+            except Exception:
+                return False
+        ds = getattr(self, "train_set", None)
+        if ds is None or not getattr(ds, "bin_mappers", None) \
+                or len(getattr(ds, "used_feature_indices", ())) == 0:
+            return False
+        cfg = self.config
+        C = self.num_tree_per_iteration
+        es_type_ok = (C > 1 or (self.objective is not None
+                                and getattr(self.objective, "name", "")
+                                in ("binary", "cross_entropy", "xentropy")))
+        if (bool(cfg.pred_early_stop) and int(cfg.pred_early_stop_freq) > 0
+                and es_type_ok):
+            return False
+        return all(getattr(t, "bins_aligned", True) for t in self.models)
+
+    def _device_raw_predict(self, X: np.ndarray,
+                            num_iteration: int = -1) -> np.ndarray:
+        """[C, N] f64 raw scores via device routing, bit-identical to
+        ``_raw_predict``: bins come from the exact host ``value_to_bin``,
+        the device returns per-tree leaf INDICES, and the float64 leaf
+        values are gathered host-side in the host walk's accumulation
+        order.  Rows are padded to a power-of-two bucket so repeated
+        predict calls reuse a handful of executables."""
+        from .device_predict import stack_trees
+        ds = self.train_set
+        used = np.asarray(ds.used_feature_indices)
+        C = self.num_tree_per_iteration
+        n_iter = self.iter_ if num_iteration <= 0 else min(num_iteration,
+                                                           self.iter_)
+        trees = self.models[: n_iter * C]
+        N = X.shape[0]
+        bins = np.empty((N, len(used)), dtype=np.int32)
+        for j, f in enumerate(used):
+            m = ds.bin_mappers[int(f)]
+            col = X[:, int(f)]
+            b = m.value_to_bin(col)
+            if m.is_categorical:
+                # unseen categories -> -1 sentinel (value_to_bin's
+                # num_bin-1 aliases a real bin); the router sends
+                # negative categorical bins right like the float walk
+                iv = np.where(np.isfinite(col), col, -1).astype(np.int64)
+                if m.categorical_2_bin:
+                    cats = np.fromiter(m.categorical_2_bin.keys(),
+                                       dtype=np.int64)
+                    seen = np.isin(iv, cats) & (iv >= 0)
+                else:
+                    seen = np.zeros(len(iv), dtype=bool)
+                b = np.where(seen, b, -1)
+            bins[:, j] = b
+        bucket = 8
+        while bucket < N:
+            bucket <<= 1
+        if bucket > N:
+            bins = np.concatenate(
+                [bins, np.zeros((bucket - N, bins.shape[1]),
+                                dtype=np.int32)])
+        stack = stack_trees(trees, len(used))
+        num_bin = jnp.asarray([ds.bin_mappers[int(f)].num_bin
+                               for f in used], dtype=jnp.int32)
+        default_bin = jnp.asarray([ds.bin_mappers[int(f)].default_bin
+                                   for f in used], dtype=jnp.int32)
+        fn = _route_seam(stack.max_depth)
+        leaves = np.asarray(fn(stack._replace(max_depth=None),
+                               jnp.asarray(bins), num_bin,
+                               default_bin))[:, :N]
+        out = np.zeros((C, N), dtype=np.float64)
+        for k in range(C):
+            out[k] += self.init_scores[k]
+        for t, tree in enumerate(trees):
+            out[t % C] += tree.leaf_value[leaves[t]]
+        return out
+
     def predict(self, X: np.ndarray, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False) -> np.ndarray:
@@ -2040,7 +2149,10 @@ class GBDT:
             for i in range(n_iter * C):
                 leaves[:, i] = self.models[i].apply_raw(X)
             return leaves
-        raw = self._raw_predict(X, num_iteration)
+        if self._device_route_ok():
+            raw = self._device_raw_predict(X, num_iteration)
+        else:
+            raw = self._raw_predict(X, num_iteration)
         if getattr(self, "average_output", False):
             n_iter = self.iter_ if num_iteration <= 0 else min(num_iteration,
                                                                self.iter_)

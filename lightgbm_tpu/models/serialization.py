@@ -260,6 +260,16 @@ class LoadedBoosting:
         from .gbdt import GBDT
         return GBDT._raw_predict(self, X, num_iteration, start_iteration)
 
+    def _device_route_ok(self):
+        # always False here (no train_set -> no bin mappers to bin
+        # predict inputs with), but routed through the one impl
+        from .gbdt import GBDT
+        return GBDT._device_route_ok(self)
+
+    def _device_raw_predict(self, X, num_iteration=-1):
+        from .gbdt import GBDT
+        return GBDT._device_raw_predict(self, X, num_iteration)
+
     def predict(self, X, num_iteration=-1, raw_score=False, pred_leaf=False,
                 pred_contrib=False):
         from .gbdt import GBDT

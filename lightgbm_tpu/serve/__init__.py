@@ -1,0 +1,131 @@
+"""lightgbm_tpu.serve — compiled, micro-batching, multi-model prediction.
+
+Layering (each file usable on its own):
+
+  registry.py   multi-model residency: shared [M, T, ...] device pack
+                under the HBM budget, admission control, eviction
+  binning.py    on-device binning of raw float requests (tables built
+                from the training BinMappers, uploaded once per model)
+  predictor.py  executable cache keyed (model_id, batch bucket);
+                pow2 shape bucketing, CostJit-compiled, host f64 gather
+  queue.py      request micro-batching with per-request futures and the
+                serve_max_delay_ms / serve_max_batch knob
+
+``ServeSession`` wires the four together; ``Booster.serve()``
+(basic.py) is the one-liner entry point returning a handle bound to
+that booster's model.  See docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+
+from .predictor import MIN_BUCKET, BucketedPredictor
+from .queue import MicroBatchQueue
+from .registry import (ModelRegistry, ServeAdmissionError, ServeError,
+                       SERVE_ADMIT_FRACTION)
+
+__all__ = [
+    "ModelRegistry", "BucketedPredictor", "MicroBatchQueue",
+    "ServeSession", "ServeHandle", "ServeError", "ServeAdmissionError",
+    "SERVE_ADMIT_FRACTION", "MIN_BUCKET",
+]
+
+
+class ServeSession:
+    """One registry + predictor + queue; hosts any number of models."""
+
+    def __init__(self, max_batch: int = 256, max_delay_ms: float = 2.0,
+                 queue_timeout_s: float = 30.0,
+                 admit_fraction: float = SERVE_ADMIT_FRACTION):
+        self.registry = ModelRegistry(max_batch=max_batch,
+                                      admit_fraction=admit_fraction)
+        self.predictor = BucketedPredictor(self.registry,
+                                           max_batch=max_batch)
+        self.queue = MicroBatchQueue(self.predictor,
+                                     max_delay_ms=max_delay_ms,
+                                     max_batch=max_batch,
+                                     queue_timeout_s=queue_timeout_s)
+
+    @classmethod
+    def from_config(cls, config, **overrides):
+        """Knobs from a Config (serve_max_batch, serve_max_delay_ms,
+        serve_queue_timeout_s), keyword overrides winning.  Overrides
+        accept both the constructor names (``max_batch``) and the
+        config-parameter spellings (``serve_max_batch``)."""
+        kw = {}
+        if config is not None:
+            kw = {"max_batch": config.serve_max_batch,
+                  "max_delay_ms": config.serve_max_delay_ms,
+                  "queue_timeout_s": config.serve_queue_timeout_s}
+        for k, v in overrides.items():
+            kw[k[6:] if k.startswith("serve_") else k] = v
+        return cls(**kw)
+
+    def load(self, booster, model_id: str = None,
+             num_iteration: int = -1) -> str:
+        return self.registry.load(booster, model_id=model_id,
+                                  num_iteration=num_iteration)
+
+    def evict(self, model_id: str) -> None:
+        self.registry.evict(model_id)
+
+    def submit(self, model_id: str, X, raw_score: bool = False) -> Future:
+        return self.queue.submit(model_id, X, raw_score=raw_score)
+
+    def predict(self, model_id: str, X, raw_score: bool = False,
+                timeout: float = None):
+        """Micro-batched prediction (blocks on the request's future)."""
+        return self.queue.predict(model_id, X, raw_score=raw_score,
+                                  timeout=timeout)
+
+    def predict_direct(self, model_id: str, X, raw_score: bool = False):
+        """Bypass the queue: same compiled bucketed path, synchronous."""
+        return self.predictor.predict(model_id, X, raw_score=raw_score)
+
+    def close(self):
+        self.queue.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class ServeHandle:
+    """A session bound to one model id — what ``Booster.serve`` returns.
+
+    ``handle.session`` is the underlying :class:`ServeSession`; load
+    more boosters into it to share the device pack and the queue."""
+
+    def __init__(self, session: ServeSession, model_id: str,
+                 owns_session: bool = True):
+        self.session = session
+        self.model_id = model_id
+        self._owns = owns_session
+
+    def predict(self, X, raw_score: bool = False, timeout: float = None):
+        return self.session.predict(self.model_id, X,
+                                    raw_score=raw_score, timeout=timeout)
+
+    def predict_direct(self, X, raw_score: bool = False):
+        return self.session.predict_direct(self.model_id, X,
+                                           raw_score=raw_score)
+
+    def submit(self, X, raw_score: bool = False) -> Future:
+        return self.session.submit(self.model_id, X, raw_score=raw_score)
+
+    def close(self):
+        if self._owns:
+            self.session.close()
+        else:
+            self.session.evict(self.model_id)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

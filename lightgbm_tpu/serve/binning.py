@@ -1,0 +1,142 @@
+"""On-device binning of raw float requests at predict time.
+
+Training quantizes features once on the host (core/binning.py
+``BinMapper.value_to_bin``); a prediction service cannot afford a host
+pass per request, so the per-feature bin bounds are uploaded ONCE per
+model and every request batch is binned on device: one vmapped
+``searchsorted`` over the padded ``[F, max_bin]`` upper-bound table,
+with the reference missing semantics (``MISSING_NAN`` routes NaN to the
+trailing NaN bin, ``MISSING_ZERO`` falls out naturally because zero
+lands in ``default_bin``) and categorical lookup as a second
+searchsorted over the sorted (category, bin) table.
+
+The device result matches ``value_to_bin`` bit-for-bit on every value
+that is not within one float32 ulp of a bin boundary: bounds are
+midpoints between observed training values, so real feature values sit
+strictly inside their bins and the f32 round-trip cannot move them.
+One deliberate difference: unseen categories bin to -1 instead of
+``value_to_bin``'s num_bin-1 (which aliases a real category's bin), so
+routing can match the host float walk's unseen -> right rule.
+
+Tables are plain numpy here; serve/registry.py stacks the tables of
+every resident model into the shared ``[M, F, ...]`` device pack and
+serve/predictor.py fuses ``bin_rows`` with the tree routing into one
+compiled executable per (model, batch bucket).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..core.binning import MISSING_NAN
+
+# categorical pad sentinel: larger than any int32 category, keeps the
+# padded tail sorted so searchsorted never lands on a pad slot for a
+# real category
+_CAT_PAD = np.int32(2**31 - 1)
+
+
+def build_tables(bin_mappers: List, used_feature_indices) -> Dict[str, np.ndarray]:
+    """Per-used-feature binning tables for one model (host numpy).
+
+    Keys (F = number of used features):
+      src_col    [F] i32  original column in the raw request matrix
+      bounds     [F, B] f32  numerical upper bounds, +inf padded; the
+                 searchable prefix is ``value_to_bin``'s
+                 ``ub[:n_search-1]`` so a plain searchsorted over the
+                 padded row reproduces the host result exactly
+      num_bin    [F] i32
+      default_bin[F] i32
+      missing_type [F] i32
+      is_cat     [F] bool
+      cat_vals   [F, C] i32  sorted category values, _CAT_PAD padded
+      cat_bins   [F, C] i32  bin of the matching category slot
+    """
+    used = np.asarray(used_feature_indices, dtype=np.int32)
+    F = len(used)
+    mappers = [bin_mappers[int(f)] for f in used]
+    n_bounds = 1
+    n_cats = 1
+    for m in mappers:
+        if m.is_categorical:
+            n_cats = max(n_cats, len(m.bin_2_categorical))
+        else:
+            n_search = m.num_bin - (1 if m.missing_type == MISSING_NAN else 0)
+            n_bounds = max(n_bounds, max(n_search - 1, 0))
+    bounds = np.full((F, n_bounds), np.inf, dtype=np.float32)
+    cat_vals = np.full((F, n_cats), _CAT_PAD, dtype=np.int32)
+    cat_bins = np.zeros((F, n_cats), dtype=np.int32)
+    num_bin = np.zeros(F, dtype=np.int32)
+    default_bin = np.zeros(F, dtype=np.int32)
+    missing_type = np.zeros(F, dtype=np.int32)
+    is_cat = np.zeros(F, dtype=bool)
+    for j, m in enumerate(mappers):
+        num_bin[j] = m.num_bin
+        default_bin[j] = m.default_bin
+        missing_type[j] = m.missing_type
+        is_cat[j] = m.is_categorical
+        if m.is_categorical:
+            if m.categorical_2_bin:
+                cats = np.fromiter(m.categorical_2_bin.keys(), dtype=np.int64)
+                bins_ = np.fromiter(m.categorical_2_bin.values(),
+                                    dtype=np.int64)
+                order = np.argsort(cats)
+                k = len(cats)
+                cat_vals[j, :k] = cats[order].astype(np.int32)
+                cat_bins[j, :k] = bins_[order].astype(np.int32)
+        else:
+            n_search = m.num_bin - (1 if m.missing_type == MISSING_NAN
+                                    else 0)
+            k = max(n_search - 1, 0)
+            if k:
+                bounds[j, :k] = np.asarray(m.bin_upper_bound[:k],
+                                           dtype=np.float32)
+    return {"src_col": used, "bounds": bounds, "num_bin": num_bin,
+            "default_bin": default_bin, "missing_type": missing_type,
+            "is_cat": is_cat, "cat_vals": cat_vals, "cat_bins": cat_bins}
+
+
+def tables_nbytes(tables: Dict[str, np.ndarray]) -> int:
+    return int(sum(int(a.nbytes) for a in tables.values()))
+
+
+def bin_rows(tables, X):
+    """Jittable: raw float rows ``[B, n_raw_features]`` -> unbundled
+    bins ``[B, F_used]`` i32 (feed tree routing with ``feat_group=None``).
+
+    ``tables`` holds the (device) arrays from :func:`build_tables` —
+    per-model slices when the registry packs multiple models.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    Xu = jnp.take(X, tables["src_col"], axis=1).astype(jnp.float32)
+
+    def one_feature(bounds_f, cats_f, catbins_f, nb_f, mt_f,
+                    iscat_f, col):
+        nan = jnp.isnan(col)
+        v = jnp.where(nan, jnp.float32(0.0), col)
+        nbin = jnp.searchsorted(bounds_f, v, side="left").astype(jnp.int32)
+        nbin = jnp.where(nan & (mt_f == MISSING_NAN), nb_f - 1, nbin)
+        # categorical: non-finite -> -1 -> miss; float truncates toward
+        # zero exactly like the host int cast.  Misses bin to -1 (not
+        # value_to_bin's num_bin-1, which aliases a REAL category's bin):
+        # the router treats negative categorical bins as "not in set",
+        # matching the host float walk's unseen/negative/NaN -> right
+        ivf = jnp.where(jnp.isfinite(col), col, jnp.float32(-1.0))
+        iv = jnp.clip(ivf, -1.0, 2.0**30).astype(jnp.int32)
+        pos = jnp.clip(jnp.searchsorted(cats_f, iv), 0,
+                       cats_f.shape[0] - 1)
+        hit = (cats_f[pos] == iv) & (iv >= 0)
+        cbin = jnp.where(hit, catbins_f[pos],
+                         jnp.int32(-1)).astype(jnp.int32)
+        return jnp.where(iscat_f, cbin, nbin)
+
+    return jax.vmap(one_feature,
+                    in_axes=(0, 0, 0, 0, 0, 0, 1),
+                    out_axes=1)(
+        tables["bounds"], tables["cat_vals"], tables["cat_bins"],
+        tables["num_bin"], tables["missing_type"],
+        tables["is_cat"], Xu)

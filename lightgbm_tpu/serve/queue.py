@@ -1,0 +1,172 @@
+"""Request micro-batching: the latency-vs-throughput knob.
+
+Callers submit small row batches and get a Future; a worker thread
+drains the queue into per-model dispatches, waiting at most
+``max_delay_ms`` past the oldest pending request (or until
+``max_batch`` rows have accumulated) before calling the bucketed
+predictor.  Coalescing requests into one padded dispatch trades a
+bounded amount of added latency for fewer, fuller executables — the
+``serve_max_delay_ms=0`` setting degenerates to dispatch-per-request.
+
+Failure behavior is explicit: an injected ``serve/enqueue`` fault or a
+predictor error becomes a named exception on the affected futures
+(never a hang), and ``predict`` applies ``queue_timeout_s`` so a stuck
+dispatch surfaces as a give-up that names the site.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
+
+import numpy as np
+
+from ..utils.faults import FAULTS
+from ..utils.telemetry import TELEMETRY
+from .predictor import BucketedPredictor
+from .registry import ServeError
+
+
+class _Request:
+    __slots__ = ("model_id", "raw_score", "X", "future", "t_enqueue")
+
+    def __init__(self, model_id, raw_score, X):
+        self.model_id = model_id
+        self.raw_score = raw_score
+        self.X = X
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class MicroBatchQueue:
+    """Single-worker micro-batching front of a :class:`BucketedPredictor`."""
+
+    def __init__(self, predictor: BucketedPredictor,
+                 max_delay_ms: float = 2.0, max_batch: int = 256,
+                 queue_timeout_s: float = 30.0):
+        self.predictor = predictor
+        self.max_delay_s = max(float(max_delay_ms), 0.0) / 1000.0
+        self.max_batch = int(max_batch)
+        self.queue_timeout_s = float(queue_timeout_s)
+        self._pending = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(target=self._run,
+                                        name="serve-batcher", daemon=True)
+        self._worker.start()
+
+    # ----------------------------------------------------------- clients
+    def submit(self, model_id: str, X, raw_score: bool = False) -> Future:
+        """Enqueue one request; resolves to Booster.predict-shaped rows."""
+        if self._closed:
+            raise ServeError("serve queue is closed")
+        FAULTS.maybe_raise(
+            "serve/enqueue",
+            lambda site: ServeError(
+                f"injected fault at {site}: request for {model_id} "
+                f"rejected at enqueue"))
+        X = np.ascontiguousarray(np.atleast_2d(np.asarray(X)),
+                                 dtype=np.float32)
+        req = _Request(model_id, bool(raw_score), X)
+        with self._cond:
+            if self._closed:
+                raise ServeError("serve queue is closed")
+            self._pending.append(req)
+            self._cond.notify()
+        TELEMETRY.counter_add("serve/requests")
+        return req.future
+
+    def predict(self, model_id: str, X, raw_score: bool = False,
+                timeout: float = None):
+        fut = self.submit(model_id, X, raw_score=raw_score)
+        budget = self.queue_timeout_s if timeout is None else float(timeout)
+        try:
+            return fut.result(timeout=budget)
+        except FutureTimeout:
+            raise ServeError(
+                f"serve request for {model_id} gave up after {budget:.1f}s "
+                f"waiting on the batch queue (serve_queue_timeout_s)")
+
+    def close(self):
+        """Stop the worker; pending futures fail with a named error."""
+        with self._cond:
+            self._closed = True
+            leftovers = list(self._pending)
+            self._pending.clear()
+            self._cond.notify_all()
+        for req in leftovers:
+            req.future.set_exception(ServeError("serve queue closed "
+                                                "before dispatch"))
+        self._worker.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------ worker
+    def _take_batch(self):
+        """Wait for work, honor the delay window, then drain every pending
+        request that matches the oldest one's (model, raw) key up to
+        ``max_batch`` rows.  Returns a list of requests or None on close."""
+        with self._cond:
+            while not self._pending and not self._closed:
+                self._cond.wait()
+            if not self._pending:
+                return None
+            head = self._pending[0]
+            deadline = head.t_enqueue + self.max_delay_s
+            while not self._closed:
+                rows = sum(r.X.shape[0] for r in self._pending
+                           if r.model_id == head.model_id
+                           and r.raw_score == head.raw_score)
+                remaining = deadline - time.perf_counter()
+                if rows >= self.max_batch or remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            batch, keep, rows = [], deque(), 0
+            for r in self._pending:
+                if (r.model_id == head.model_id
+                        and r.raw_score == head.raw_score
+                        and rows < self.max_batch):
+                    batch.append(r)
+                    rows += r.X.shape[0]
+                else:
+                    keep.append(r)
+            self._pending = keep
+            return batch
+
+    def _run(self):
+        while True:
+            try:
+                batch = self._take_batch()
+            except Exception:
+                continue
+            if batch is None:
+                return
+            t_dispatch = time.perf_counter()
+            for r in batch:
+                TELEMETRY.record_dispatch("serve/queue_wait",
+                                          r.t_enqueue, t_dispatch)
+            X = batch[0].X if len(batch) == 1 else \
+                np.concatenate([r.X for r in batch])
+            try:
+                res = self.predictor.predict(batch[0].model_id, X,
+                                             raw_score=batch[0].raw_score)
+                slices = []
+                done = 0
+                for r in batch:
+                    n = r.X.shape[0]
+                    slices.append(res[done: done + n])
+                    done += n
+            except Exception as exc:
+                for r in batch:
+                    r.future.set_exception(exc)
+                continue
+            for r, out in zip(batch, slices):
+                r.future.set_result(out)

@@ -1,0 +1,307 @@
+"""Multi-model hosting under an HBM budget.
+
+Every resident model's tree stack and binning tables are packed into
+ONE set of shared device buffers (``[M, T, nodes]`` / ``[M, F, len]``,
+models padded to the pack maxima) so residency is a single accountable
+allocation.  Admission mirrors the training-side out-of-core check
+(``GBDT._resolve_data_tier``): the hypothetical packed working set —
+pack bytes + the largest compiled-executable working set on record +
+the request activation for one max-size batch — is compared against the
+device allocator's reported capacity (``TELEMETRY.device_memory_budget``)
+BEFORE anything is uploaded.  Every decision lands in the telemetry
+faults section as a ``serve_admit`` event; a rejection raises
+:class:`ServeAdmissionError` naming the budget, the shortfall and the
+current residents so the operator knows exactly what to evict.
+
+Backends without allocator stats (CPU) admit everything, same as the
+training check.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..models.device_predict import stack_trees_host
+from ..utils.log import LightGBMError
+from ..utils.telemetry import TELEMETRY
+from .binning import _CAT_PAD, build_tables, tables_nbytes
+
+# same headroom fraction as the training admission check (models/gbdt.py)
+SERVE_ADMIT_FRACTION = 0.9
+
+
+class ServeError(LightGBMError):
+    """Base error for the prediction service."""
+
+
+class ServeAdmissionError(ServeError):
+    """A model load would not fit under the device HBM budget."""
+
+
+class ResidentModel:
+    """Host-side state of one admitted model (device state lives in the
+    shared pack)."""
+
+    __slots__ = ("model_id", "trees", "num_tree_per_iteration",
+                 "init_scores", "objective", "max_feature_idx",
+                 "average_output", "tables", "stack", "max_depth",
+                 "nbytes")
+
+    def __init__(self, model_id, trees, num_tree_per_iteration, init_scores,
+                 objective, max_feature_idx, average_output, tables, stack,
+                 max_depth, nbytes):
+        self.model_id = model_id
+        self.trees = trees
+        self.num_tree_per_iteration = num_tree_per_iteration
+        self.init_scores = init_scores
+        self.objective = objective
+        self.max_feature_idx = max_feature_idx
+        self.average_output = average_output
+        self.tables = tables          # host numpy binning tables
+        self.stack = stack            # host numpy tree-stack fields
+        self.max_depth = max_depth
+        self.nbytes = nbytes          # unpadded host bytes (reporting)
+
+
+def _extract(booster, num_iteration: int = -1) -> tuple:
+    """(trees, mappers, used_indices, C, init_scores, objective,
+    max_feature_idx, average_output) of a Booster, validated for binned
+    serving."""
+    gbdt = booster.gbdt
+    if hasattr(gbdt, "_flush_pending"):
+        gbdt._flush_pending()
+    C = gbdt.num_tree_per_iteration
+    n_iter = len(gbdt.models) // max(C, 1)
+    if num_iteration is None or num_iteration < 0:
+        num_iteration = (booster.best_iteration
+                         if booster.best_iteration > 0 else n_iter)
+    n_iter = min(max(num_iteration, 0), n_iter) or n_iter
+    trees = list(gbdt.models[: n_iter * C])
+    if not trees:
+        raise ServeError("cannot serve a model with no trees")
+    for i, t in enumerate(trees):
+        if not getattr(t, "bins_aligned", True):
+            raise ServeError(
+                f"tree {i} was loaded from a model file and its bin "
+                f"thresholds are not aligned with any dataset; load the "
+                f"model into a training-capable booster "
+                f"(serialization.load_trees_into) before serving")
+    ds = getattr(gbdt, "train_set", None)
+    if ds is None or not getattr(ds, "bin_mappers", None):
+        raise ServeError(
+            "serving needs the model's BinMappers for on-device binning; "
+            "this booster carries no training dataset (file-loaded "
+            "models must be re-bound to a dataset first)")
+    return (trees, ds.bin_mappers, ds.used_feature_indices, C,
+            list(gbdt.init_scores), booster.objective,
+            gbdt.max_feature_idx, bool(getattr(gbdt, "average_output",
+                                               False)))
+
+
+# (field, dtype, pad value) of the packed tree stack; leaf values stay on
+# the host (the predictor gathers them in float64 for bit-parity with the
+# host walk), so they are deliberately NOT part of the device pack
+_STACK_FIELDS = (
+    ("split_feature", np.int32, 0),
+    ("threshold_bin", np.int32, 0),
+    ("decision_type", np.int32, 0),
+    ("left_child", np.int32, -1),
+    ("right_child", np.int32, -1),
+    ("cat_bitset", np.uint32, 0),
+    ("num_leaves", np.int32, 1),
+)
+
+_TABLE_PADS = {"src_col": 0, "bounds": np.inf, "num_bin": 1,
+               "default_bin": 0, "missing_type": 0, "is_cat": False,
+               "cat_vals": _CAT_PAD, "cat_bins": 0}
+
+
+class ModelRegistry:
+    """Admission-checked residency of N models in shared device buffers.
+
+    ``pack()`` returns the current device arrays; ``pack_version``
+    changes whenever they are rebuilt (load/evict), which invalidates
+    every compiled serve executable that closed over the previous
+    shapes (serve/predictor.py re-keys on the version).
+    """
+
+    def __init__(self, max_batch: int = 256,
+                 admit_fraction: float = SERVE_ADMIT_FRACTION):
+        self._lock = threading.RLock()
+        self._models: Dict[str, ResidentModel] = {}
+        self._order: List[str] = []          # pack row per model_id
+        self._pack = None                    # device arrays, lazily built
+        self.pack_version = 0
+        self.max_batch = int(max_batch)
+        self.admit_fraction = float(admit_fraction)
+
+    # ------------------------------------------------------------ loading
+    def load(self, booster, model_id: Optional[str] = None,
+             num_iteration: int = -1) -> str:
+        """Admit one Booster; returns its model_id.  Raises
+        :class:`ServeAdmissionError` when the packed working set would
+        exceed the HBM budget."""
+        (trees, mappers, used, C, init_scores, objective, max_fi,
+         avg_out) = _extract(booster, num_iteration)
+        with self._lock:
+            if model_id is None:
+                model_id = f"model{len(self._order)}"
+            if model_id in self._models:
+                raise ServeError(f"model_id {model_id!r} is already "
+                                 f"resident; evict it first")
+            tables = build_tables(mappers, used)
+            stack = stack_trees_host(trees, len(used))
+            max_depth = stack[-1]
+            nbytes = (sum(int(np.asarray(a).nbytes) for a in stack[:-1])
+                      + tables_nbytes(tables))
+            entry = ResidentModel(model_id, trees, C, init_scores,
+                                  objective, max_fi, avg_out, tables,
+                                  stack[:-1], max_depth, nbytes)
+            self._admit_or_raise(entry)
+            self._models[model_id] = entry
+            self._order.append(model_id)
+            self._pack = None
+            self.pack_version += 1
+            return model_id
+
+    def evict(self, model_id: str) -> None:
+        with self._lock:
+            if model_id not in self._models:
+                raise ServeError(f"model_id {model_id!r} is not resident")
+            del self._models[model_id]
+            self._order.remove(model_id)
+            self._pack = None
+            self.pack_version += 1
+            TELEMETRY.fault_event(
+                "serve_admit", site="serve/admit",
+                detail=f"evicted {model_id}; residents="
+                       f"{','.join(self._order) or '<none>'}")
+
+    # ---------------------------------------------------------- admission
+    def _packed_nbytes(self, entries) -> int:
+        """Bytes of the shared device pack holding ``entries`` (padded
+        to the common maxima) — pure host arithmetic, nothing uploaded."""
+        if not entries:
+            return 0
+        M = len(entries)
+        T = max(e.stack[0].shape[0] for e in entries)
+        Mn = max(e.stack[0].shape[1] for e in entries)
+        total = M * T * Mn * 4 * 5      # sf/tb/dt/lc/rc i32
+        total += M * T * Mn * 8 * 4     # cat_bitset u32 words
+        total += M * T * 4              # num_leaves
+        F = max(e.tables["src_col"].shape[0] for e in entries)
+        B = max(e.tables["bounds"].shape[1] for e in entries)
+        Cc = max(e.tables["cat_vals"].shape[1] for e in entries)
+        total += M * F * B * 4          # bounds f32
+        total += M * F * Cc * 4 * 2     # cat_vals + cat_bins i32
+        total += M * F * (4 * 4 + 1)    # src_col/num_bin/default_bin/
+        return total                    # missing_type i32 + is_cat bool
+
+    def _admit_or_raise(self, entry: ResidentModel) -> None:
+        hypothetical = list(self._models.values()) + [entry]
+        pack_bytes = self._packed_nbytes(hypothetical)
+        budget = TELEMETRY.device_memory_budget()
+        if budget is None:
+            TELEMETRY.fault_event(
+                "serve_admit", site="serve/admit",
+                detail=f"admitted {entry.model_id} (~{entry.nbytes} B, "
+                       f"pack ~{pack_bytes} B); no allocator stats on "
+                       f"this backend — budget check skipped")
+            return
+        # request activation for one max-size batch of the widest model:
+        # raw floats in, per-tree leaves out, bins in between
+        F_raw = max(e.max_feature_idx + 1 for e in hypothetical)
+        F_used = max(e.tables["src_col"].shape[0] for e in hypothetical)
+        T = max(len(e.trees) for e in hypothetical)
+        act = self.max_batch * (4 * F_raw + 4 * F_used + 4 * T)
+        need = pack_bytes + act + TELEMETRY.cost_working_set()
+        limit = int(self.admit_fraction * budget)
+        if need <= limit:
+            TELEMETRY.fault_event(
+                "serve_admit", site="serve/admit",
+                detail=f"admitted {entry.model_id}: working set "
+                       f"~{need} B within {limit} B "
+                       f"({self.admit_fraction:.0%} of {budget} B HBM)")
+            return
+        residents = ", ".join(
+            f"{m.model_id}(~{m.nbytes}B)" for m in self._models.values()) \
+            or "<none>"
+        detail = (f"rejected {entry.model_id}: estimated working set "
+                  f"~{need} B exceeds {limit} B "
+                  f"({self.admit_fraction:.0%} of the {budget} B reported "
+                  f"HBM budget); residents: {residents}")
+        TELEMETRY.fault_event("serve_admit", site="serve/admit",
+                              detail=detail)
+        raise ServeAdmissionError(
+            f"serve admission: {detail}; evict a resident model "
+            f"(ModelRegistry.evict) or raise the budget")
+
+    # --------------------------------------------------------------- pack
+    def entry(self, model_id: str) -> ResidentModel:
+        with self._lock:
+            e = self._models.get(model_id)
+            if e is None:
+                raise ServeError(
+                    f"model_id {model_id!r} is not resident; loaded: "
+                    f"{', '.join(self._order) or '<none>'}")
+            return e
+
+    def row_of(self, model_id: str) -> int:
+        with self._lock:
+            return self._order.index(model_id)
+
+    def residents(self) -> Dict[str, int]:
+        with self._lock:
+            return {mid: self._models[mid].nbytes for mid in self._order}
+
+    def pack(self) -> Dict[str, "object"]:
+        """The shared device buffers, (re)built on demand after a
+        load/evict.  One upload per rebuild; every serve executable
+        takes these arrays as runtime arguments, so N models share one
+        residency."""
+        import jax.numpy as jnp
+        with self._lock:
+            if self._pack is not None:
+                return self._pack
+            entries = [self._models[mid] for mid in self._order]
+            if not entries:
+                raise ServeError("no models resident; load one first")
+            M = len(entries)
+            T = max(e.stack[0].shape[0] for e in entries)
+            Mn = max(e.stack[0].shape[1] for e in entries)
+            out = {}
+            for name, dtype, fill in _STACK_FIELDS:
+                if name == "cat_bitset":
+                    shape = (M, T, Mn, 8)
+                elif name == "num_leaves":
+                    shape = (M, T)
+                else:
+                    shape = (M, T, Mn)
+                buf = np.full(shape, fill, dtype=dtype)
+                for m, e in enumerate(entries):
+                    a = e.stack[{"split_feature": 0, "threshold_bin": 1,
+                                 "decision_type": 2, "left_child": 3,
+                                 "right_child": 4, "cat_bitset": 5,
+                                 "num_leaves": 7}[name]]
+                    buf[m][tuple(slice(0, s) for s in a.shape)] = a
+                out[name] = jnp.asarray(buf)
+            F = max(e.tables["src_col"].shape[0] for e in entries)
+            B = max(e.tables["bounds"].shape[1] for e in entries)
+            Cc = max(e.tables["cat_vals"].shape[1] for e in entries)
+            for key in entries[0].tables:
+                shape = {"bounds": (M, F, B), "cat_vals": (M, F, Cc),
+                         "cat_bins": (M, F, Cc)}.get(key, (M, F))
+                buf = np.full(shape, _TABLE_PADS[key],
+                              dtype=entries[0].tables[key].dtype)
+                for m, e in enumerate(entries):
+                    a = e.tables[key]
+                    buf[m][tuple(slice(0, s) for s in a.shape)] = a
+                out["tab_" + key] = jnp.asarray(buf)
+            self._pack = out
+            TELEMETRY.gauge_set("serve/pack_bytes",
+                                sum(int(v.nbytes) for v in out.values()))
+            TELEMETRY.gauge_set("serve/resident_models", M)
+            return self._pack

@@ -61,6 +61,8 @@ KNOWN_SITES = frozenset([
     "dist/preempt",      # host receives a preemption notice (SIGTERM)
     "oocore/h2d",        # bin-matrix host->device transfer raises OOM
     "oocore/admit",      # admission check decides the matrix won't fit
+    "serve/compile",     # serve executable build fails (named give-up)
+    "serve/enqueue",     # serve request rejected at enqueue
 ])
 
 

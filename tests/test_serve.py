@@ -1,0 +1,407 @@
+"""Prediction-service tests (lightgbm_tpu/serve + the predict routing).
+
+The load-bearing contract everywhere: the serve path — device binning of
+raw floats, bucketed compiled routing, host float64 leaf gather — is
+BIT-identical to ``Booster.predict``, across missing types, categorical
+bitset splits (in- and out-of-vocabulary) and multiclass.  On top of
+that: padded rows are inert, bucket reuse never recompiles, multi-model
+packs stay correct through eviction, admission rejects over-budget
+loads with an actionable error, fault sites give up by name instead of
+hanging, and Booster.refit re-estimates leaves like a from-scratch fit.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve import (MicroBatchQueue, ModelRegistry,
+                                ServeAdmissionError, ServeError,
+                                ServeSession)
+from lightgbm_tpu.utils.faults import FAULTS
+from lightgbm_tpu.utils.telemetry import TELEMETRY, TelemetryRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    TELEMETRY.reset()
+    TELEMETRY.set_config_level(1)
+    TELEMETRY.install_jax_listeners()
+    yield
+    FAULTS.configure()
+
+
+def _fake_mem(monkeypatch, bytes_limit):
+    """Pretend the device reports ``bytes_limit`` of HBM; returns the
+    mutable stats dict so a test can shrink the budget mid-flight."""
+    ms = {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+          "largest_alloc_size": 0, "bytes_limit": int(bytes_limit)}
+    monkeypatch.setattr(TelemetryRegistry, "_device_memory_stats",
+                        lambda self: dict(ms))
+    return ms
+
+
+def _make_mixed(rng, n=600, f=8):
+    """NaN-missing, zero-missing and two categorical columns."""
+    X = rng.normal(size=(n, f))
+    X[:, 3] = rng.randint(0, 6, size=n)           # categorical
+    X[:, 4] = rng.randint(0, 11, size=n)          # categorical
+    X[rng.rand(n) < 0.2, 1] = np.nan              # MISSING_NAN column
+    X[:, 2] = np.where(rng.rand(n) < 0.4, 0.0, X[:, 2])  # MISSING_ZERO
+    y = (np.nan_to_num(X[:, 0] + X[:, 1]) + (X[:, 3] % 2)
+         + 0.5 * (X[:, 4] % 3 == 0) > 0.8).astype(np.float64)
+    return X, y
+
+
+def _train(rng, objective="binary", num_class=1, rounds=12):
+    X, y = _make_mixed(rng)
+    params = {"objective": objective, "verbose": -1, "num_leaves": 15}
+    if num_class > 1:
+        params["num_class"] = num_class
+        y = np.minimum(y + (X[:, 0] > 1.0), num_class - 1)
+    ds = lgb.Dataset(X, y, categorical_feature=[3, 4])
+    return lgb.train(params, ds, num_boost_round=rounds), X, y
+
+
+def _queries(rng, X, n=77):
+    """Query rows exercising every corner: training rows, NaN, exact
+    zeros, and OUT-of-vocabulary categories (unseen during training)."""
+    Xq = X[rng.choice(len(X), n, replace=False)].copy()
+    Xq[rng.rand(n) < 0.3, 1] = np.nan
+    Xq[rng.rand(n) < 0.3, 2] = 0.0
+    oov = rng.rand(n) < 0.25
+    Xq[oov, 3] = rng.choice([-1, 6, 7, 99], size=int(oov.sum()))
+    return Xq
+
+
+# ------------------------------------------------------- bit-identity
+def test_serve_bit_identical_binary(rng):
+    bst, X, _ = _train(rng)
+    Xq = _queries(rng, X)
+    ref = bst.predict(Xq)
+    with ServeSession(max_batch=64, max_delay_ms=0.0) as sess:
+        mid = sess.load(bst)
+        np.testing.assert_array_equal(ref, sess.predict_direct(mid, Xq))
+        np.testing.assert_array_equal(ref, sess.predict(mid, Xq))
+        raw = sess.predict_direct(mid, Xq, raw_score=True)
+        np.testing.assert_array_equal(bst.predict(Xq, raw_score=True), raw)
+
+
+def test_serve_bit_identical_multiclass(rng):
+    bst, X, _ = _train(rng, objective="multiclass", num_class=3, rounds=6)
+    Xq = _queries(rng, X)
+    ref = bst.predict(Xq)
+    assert ref.shape == (len(Xq), 3)
+    with ServeSession(max_batch=32, max_delay_ms=0.0) as sess:
+        mid = sess.load(bst)
+        np.testing.assert_array_equal(ref, sess.predict_direct(mid, Xq))
+
+
+def test_booster_serve_handle(rng):
+    bst, X, _ = _train(rng)
+    Xq = _queries(rng, X, n=20)
+    with bst.serve(serve_max_delay_ms=0.0) as handle:
+        np.testing.assert_array_equal(bst.predict(Xq),
+                                      handle.predict(Xq))
+        fut = handle.submit(Xq[:5])
+        np.testing.assert_array_equal(bst.predict(Xq[:5]),
+                                      fut.result(timeout=30))
+
+
+# --------------------------------------------------- shape bucketing
+def test_padded_rows_inert_across_buckets(rng):
+    """The same rows predicted inside different-size batches (hence
+    different pad counts and buckets) give identical outputs."""
+    bst, X, _ = _train(rng)
+    Xq = _queries(rng, X, n=50)
+    with ServeSession(max_batch=64, max_delay_ms=0.0) as sess:
+        mid = sess.load(bst)
+        full = sess.predict_direct(mid, Xq)          # bucket 64
+        for cut in (1, 5, 9, 17, 33):                # buckets 8..64
+            part = sess.predict_direct(mid, Xq[:cut])
+            np.testing.assert_array_equal(full[:cut], part)
+    g = TELEMETRY.stats()["gauges"]
+    assert "serve/pad_ratio" in g and 0.0 <= g["serve/pad_ratio"] < 1.0
+
+
+def test_bucket_reuse_zero_recompiles(rng):
+    bst, X, _ = _train(rng)
+    Xq = _queries(rng, X, n=48)
+    with ServeSession(max_batch=64, max_delay_ms=0.0) as sess:
+        mid = sess.load(bst)
+        sess.predict_direct(mid, Xq)                 # compiles bucket 64
+        c0 = dict(TELEMETRY.stats()["counters"])
+        for i in range(5):                           # same bucket again
+            sess.predict_direct(mid, Xq[: 48 - i])
+        c1 = TELEMETRY.stats()["counters"]
+        assert c1.get("compile/retraces", 0) == c0.get(
+            "compile/retraces", 0)
+        assert c1["serve/batches"] == c0["serve/batches"] + 5
+
+
+def test_serve_counters(rng):
+    bst, X, _ = _train(rng)
+    with ServeSession(max_batch=32, max_delay_ms=0.0) as sess:
+        mid = sess.load(bst)
+        sess.predict(mid, X[:10])
+        sess.predict(mid, X[:3])
+    c = TELEMETRY.stats()["counters"]
+    assert c["serve/requests"] == 2
+    assert c["serve/rows"] == 13
+    assert c["serve/padded_rows"] >= (16 - 10) + (8 - 3)
+
+
+# ------------------------------------------------------ micro-batching
+def test_queue_coalesces_requests(rng):
+    bst, X, _ = _train(rng)
+    ref = bst.predict(X[:32])
+    with ServeSession(max_batch=64, max_delay_ms=150.0) as sess:
+        mid = sess.load(bst)
+        sess.predict(mid, X[:1])                     # compile first
+        TELEMETRY.reset()
+        futs = [sess.submit(mid, X[i * 8:(i + 1) * 8]) for i in range(4)]
+        outs = [f.result(timeout=30) for f in futs]
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(ref[i * 8:(i + 1) * 8], out)
+    c = TELEMETRY.stats()["counters"]
+    assert c["serve/requests"] == 4
+    # the 150ms window coalesced the burst into one padded dispatch
+    assert c["serve/batches"] == 1
+
+
+def test_queue_interleaves_models(rng):
+    b1, X, _ = _train(rng)
+    b2, _, _ = _train(rng, rounds=5)
+    with ServeSession(max_batch=32, max_delay_ms=0.0) as sess:
+        m1, m2 = sess.load(b1, model_id="a"), sess.load(b2, model_id="b")
+        f1 = sess.submit(m1, X[:8])
+        f2 = sess.submit(m2, X[:8])
+        np.testing.assert_array_equal(b1.predict(X[:8]),
+                                      f1.result(timeout=30))
+        np.testing.assert_array_equal(b2.predict(X[:8]),
+                                      f2.result(timeout=30))
+
+
+def test_queue_close_fails_pending(rng):
+    bst, X, _ = _train(rng)
+    sess = ServeSession(max_batch=16, max_delay_ms=0.0)
+    mid = sess.load(bst)
+    sess.predict(mid, X[:4])
+    sess.close()
+    with pytest.raises(ServeError, match="closed"):
+        sess.submit(mid, X[:4])
+
+
+# ------------------------------------------------------------ admission
+def test_admission_rejects_over_budget(rng, monkeypatch):
+    bst, X, _ = _train(rng)
+    _fake_mem(monkeypatch, 10_000)                   # 10 kB "HBM"
+    reg = ModelRegistry(max_batch=64)
+    with pytest.raises(ServeAdmissionError) as ei:
+        reg.load(bst, model_id="big")
+    msg = str(ei.value)
+    assert "10000" in msg and "budget" in msg and "residents" in msg
+    ev = TELEMETRY.stats()["faults"]["events"]
+    admits = [e for e in ev if e.get("kind") == "serve_admit"]
+    assert admits and "rejected big" in admits[-1]["detail"]
+
+
+def test_admission_names_residents(rng, monkeypatch):
+    bst, X, _ = _train(rng, rounds=4)
+    big, _, _ = _train(rng, rounds=60)
+    ms = _fake_mem(monkeypatch, 1 << 30)
+    reg = ModelRegistry(max_batch=64)
+    reg.load(bst, model_id="resident0")              # admits under 1 GiB
+    ms["bytes_limit"] = 10_000                       # budget collapses
+    with pytest.raises(ServeAdmissionError, match="resident0"):
+        reg.load(big, model_id="big")
+    assert "resident0" in reg.residents()
+    assert "big" not in reg.residents()
+
+
+def test_admission_and_eviction_lifecycle(rng, monkeypatch):
+    bst, X, _ = _train(rng, rounds=4)
+    _fake_mem(monkeypatch, 1 << 30)
+    sess = ServeSession(max_batch=16, max_delay_ms=0.0)
+    try:
+        mid = sess.load(bst, model_id="m")
+        ref = sess.predict_direct(mid, X[:8])
+        sess.evict(mid)
+        with pytest.raises(ServeError, match="not resident"):
+            sess.predict_direct(mid, X[:8])
+        mid2 = sess.load(bst, model_id="m")          # re-admit
+        np.testing.assert_array_equal(ref, sess.predict_direct(mid2,
+                                                               X[:8]))
+    finally:
+        sess.close()
+    ev = [e for e in TELEMETRY.stats()["faults"]["events"]
+          if e.get("kind") == "serve_admit"]
+    details = " | ".join(e["detail"] for e in ev)
+    assert "admitted m" in details and "evicted m" in details
+
+
+def test_multi_model_pack_correct_after_evict(rng):
+    b1, X, _ = _train(rng)
+    b2, _, _ = _train(rng, rounds=5)
+    b3, _, _ = _train(rng, objective="multiclass", num_class=3, rounds=4)
+    Xq = X[:20]
+    with ServeSession(max_batch=32, max_delay_ms=0.0) as sess:
+        ids = [sess.load(b, model_id=f"m{i}")
+               for i, b in enumerate((b1, b2, b3))]
+        for b, mid in zip((b1, b2, b3), ids):
+            np.testing.assert_array_equal(b.predict(Xq),
+                                          sess.predict_direct(mid, Xq))
+        sess.evict(ids[1])                           # repack
+        np.testing.assert_array_equal(b1.predict(Xq),
+                                      sess.predict_direct(ids[0], Xq))
+        np.testing.assert_array_equal(b3.predict(Xq),
+                                      sess.predict_direct(ids[2], Xq))
+
+
+# ---------------------------------------------------------- fault sites
+def test_fault_enqueue_named_giveup(rng):
+    bst, X, _ = _train(rng)
+    with ServeSession(max_batch=16, max_delay_ms=0.0) as sess:
+        mid = sess.load(bst)
+        FAULTS.configure("serve/enqueue")
+        with pytest.raises(ServeError, match="serve/enqueue"):
+            sess.predict(mid, X[:4])
+        # the site healed (count=1): the queue keeps serving
+        np.testing.assert_array_equal(bst.predict(X[:4]),
+                                      sess.predict(mid, X[:4]))
+
+
+def test_fault_compile_named_giveup_no_hang(rng):
+    bst, X, _ = _train(rng)
+    with ServeSession(max_batch=16, max_delay_ms=0.0,
+                      queue_timeout_s=30.0) as sess:
+        mid = sess.load(bst)
+        FAULTS.configure("serve/compile")
+        # the injected compile failure propagates to the request future
+        # as a NAMED error (never a hang), then the site heals
+        with pytest.raises(ServeError, match="serve/compile"):
+            sess.predict(mid, X[:4])
+        np.testing.assert_array_equal(bst.predict(X[:4]),
+                                      sess.predict(mid, X[:4]))
+
+
+def test_fault_queue_timeout_named_giveup(rng):
+    bst, X, _ = _train(rng)
+    with ServeSession(max_batch=16, max_delay_ms=0.0) as sess:
+        mid = sess.load(bst)
+        # a predictor wedged mid-dispatch: the request gives up by name
+        ev = threading.Event()
+        sess.predictor.predict = lambda *a, **k: ev.wait(20)
+        try:
+            with pytest.raises(ServeError, match="gave up"):
+                sess.queue.predict(mid, X[:4], timeout=0.3)
+        finally:
+            ev.set()
+
+
+# ----------------------------------------------------- predict routing
+def test_predict_device_route_bit_identical(rng):
+    bst, X, _ = _train(rng)
+    Xq = _queries(rng, X)
+    off = bst.predict(Xq)
+    bst.config.predict_device = "on"
+    on = bst.predict(Xq)
+    np.testing.assert_array_equal(off, on)
+
+
+def test_predict_device_route_multiclass(rng):
+    bst, X, _ = _train(rng, objective="multiclass", num_class=3, rounds=5)
+    Xq = _queries(rng, X)
+    off = bst.predict(Xq)
+    bst.config.predict_device = "on"
+    np.testing.assert_array_equal(off, bst.predict(Xq))
+
+
+def test_predict_device_route_reuses_executable(rng):
+    bst, X, _ = _train(rng)
+    bst.config.predict_device = "on"
+    bst.predict(X[:40])                              # compile bucket 64
+    c0 = TELEMETRY.stats()["counters"].get("compile/retraces", 0)
+    bst.predict(X[:50])                              # same bucket
+    assert TELEMETRY.stats()["counters"].get("compile/retraces",
+                                             0) == c0
+
+
+def test_predict_device_auto_is_host_on_cpu(rng):
+    """predict_device=auto must not engage the jit path on CPU-only
+    backends (dispatch overhead would swamp the walk)."""
+    bst, X, _ = _train(rng, rounds=3)
+    assert bst.config.predict_device == "auto"
+    assert not bst.gbdt._device_route_ok()
+    bst.config.predict_device = "on"
+    assert bst.gbdt._device_route_ok()
+
+
+# ----------------------------------------------------------------- refit
+def test_refit_parity_from_scratch_leaf_estimate(rng):
+    """decay=0 refit == from-scratch leaf re-estimate: for one L2 tree,
+    the refitted leaf value must equal shrinkage * mean residual of the
+    rows landing in that leaf (the gradient-optimal L2 leaf)."""
+    X = rng.rand(400, 4)
+    y = X[:, 0] * 2 + 0.1 * rng.rand(400)
+    params = {"objective": "regression", "verbose": -1, "num_leaves": 7,
+              "min_data_in_leaf": 5, "lambda_l2": 0.0}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=1)
+    X2 = rng.rand(300, 4)
+    y2 = X2[:, 0] * 2 + 0.1 * rng.rand(300)
+    leaves = bst.predict(X2, pred_leaf=True).ravel()
+    bst.refit(X2, y2, decay_rate=0.0)
+    tree = bst.gbdt.models[0]
+    init = bst.gbdt.init_scores[0]
+    for leaf in np.unique(leaves):
+        sel = leaves == leaf
+        resid = np.mean(y2[sel].astype(np.float32) - np.float32(init))
+        expect = tree.shrinkage * resid
+        assert abs(tree.leaf_value[leaf] - expect) < 5e-4
+
+
+def test_refit_decay_one_is_identity(rng):
+    bst, X, y = _train(rng, rounds=5)
+    before = bst.predict(X[:50])
+    lv0 = [t.leaf_value.copy() for t in bst.gbdt.models]
+    bst.refit(X, y, decay_rate=1.0)
+    for t, lv in zip(bst.gbdt.models, lv0):
+        np.testing.assert_array_equal(t.leaf_value, lv)
+    np.testing.assert_array_equal(before, bst.predict(X[:50]))
+
+
+def test_refit_moves_toward_new_labels(rng):
+    bst, X, y = _train(rng)
+    rng2 = np.random.RandomState(7)
+    X2, _ = _make_mixed(rng2, n=500)
+    y2 = 1.0 - (np.nan_to_num(X2[:, 0]) > 0)         # contrarian labels
+    before = float(np.mean((bst.predict(X2) - y2) ** 2))
+    bst.refit(X2, y2, decay_rate=0.1)
+    after = float(np.mean((bst.predict(X2) - y2) ** 2))
+    assert after < before
+
+
+# --------------------------------------------------------------- errors
+def test_serve_rejects_wrong_width(rng):
+    bst, X, _ = _train(rng)
+    with ServeSession(max_batch=16, max_delay_ms=0.0) as sess:
+        mid = sess.load(bst)
+        with pytest.raises(ServeError, match="features"):
+            sess.predict_direct(mid, X[:4, :5])
+
+
+def test_serve_duplicate_model_id(rng):
+    bst, _, _ = _train(rng, rounds=3)
+    reg = ModelRegistry()
+    reg.load(bst, model_id="m")
+    with pytest.raises(ServeError, match="already"):
+        reg.load(bst, model_id="m")
+
+
+def test_registry_unknown_model_names_loaded(rng):
+    bst, _, _ = _train(rng, rounds=3)
+    reg = ModelRegistry()
+    reg.load(bst, model_id="alpha")
+    with pytest.raises(ServeError, match="alpha"):
+        reg.entry("beta")

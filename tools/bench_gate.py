@@ -9,10 +9,16 @@ previous ``--window`` records, and the gate fails (exit 1) when
 
   * wall time regresses more than ``--wall-tol`` (default +15%),
   * peak HBM regresses more than ``--hbm-tol`` (default +20%),
-  * the quality gate flips from held to failed, or
+  * the quality gate flips from held to failed,
   * measured dispatch latency (``dispatch_mean_s``, recorded by runs
     with ``device_timing=`` on) regresses more than ``--latency-tol``
-    (default +20%).
+    (default +20%), or
+  * serve tail latency (``p99_s``, recorded by bench_serve.py) regresses
+    more than ``--latency-tol`` over the trailing median.
+
+Serve records (bench_serve.py) carry ``qps``/``p50_s``/``p99_s`` and no
+training ``value``/``unit``/``peak_hbm_bytes`` — every gate skips fields
+a record does not have, so mixed trajectories gate cleanly.
 
 A missing/empty trajectory, a config with no prior history, or records
 without comparable fields all PASS with a "no history" notice — the
@@ -141,6 +147,23 @@ def evaluate(records, window=5, wall_tol=0.15, hbm_tol=0.20,
                 notes.append(f"{config}: dispatch latency "
                              f"{lat * 1e3:.3f}ms vs median "
                              f"{lat_base * 1e3:.3f}ms — ok")
+        # serve tail latency (bench_serve.py records): p99 is the
+        # service-level promise, so it gates where mean would forgive a
+        # fat tail
+        p99 = newest.get("p99_s")
+        p99_base = _median([r["p99_s"] for r in history
+                            if isinstance(r.get("p99_s"), (int, float))
+                            and r["p99_s"] > 0])
+        if (isinstance(p99, (int, float)) and p99 > 0
+                and p99_base is not None):
+            if p99 / p99_base > 1.0 + latency_tol:
+                failures.append(
+                    f"{config}: serve p99 {p99 * 1e3:.3f}ms regressed "
+                    f"{p99 / p99_base - 1.0:+.1%} over median "
+                    f"{p99_base * 1e3:.3f}ms (tol +{latency_tol:.0%})")
+            else:
+                notes.append(f"{config}: serve p99 {p99 * 1e3:.3f}ms vs "
+                             f"median {p99_base * 1e3:.3f}ms — ok")
     return failures, notes
 
 
@@ -200,6 +223,24 @@ def self_test():
             {"config": "c", "value": 10.2, "unit": "s",
              "quality_ok": True, "peak_hbm_bytes": 1000,
              "dispatch_mean_s": None})),
+    ]
+    shist = [{"config": "serve-s-b16-d0", "qps": 1000.0 - 5 * i,
+              "p50_s": 0.001, "p99_s": 0.004 + 0.0001 * i,
+              "quality_ok": True} for i in range(4)]
+
+    def sverdict(newest):
+        failures, _ = evaluate(shist + [newest])
+        return bool(failures)
+
+    checks += [
+        ("serve record w/o training fields passes", not sverdict(
+            {"config": "serve-s-b16-d0", "qps": 990.0, "p50_s": 0.001,
+             "p99_s": 0.0041, "quality_ok": True})),
+        ("serve p99 regression fails", sverdict(
+            {"config": "serve-s-b16-d0", "qps": 990.0, "p50_s": 0.001,
+             "p99_s": 0.009, "quality_ok": True})),
+        ("serve first record passes", not evaluate(
+            [{"config": "serve-new", "qps": 5.0, "p99_s": 0.1}])[0]),
     ]
     bad = [name for name, ok in checks if not ok]
     for name, ok in checks:

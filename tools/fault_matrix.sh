@@ -17,6 +17,11 @@
 # naming the dead rank, and the dist/preempt drain -> synchronized
 # snapshot -> bit-exact resume cycle.
 #
+# A fourth pass runs the serving suite (tests/test_serve.py) over the
+# serve/compile and serve/enqueue sites: an armed site must surface as a
+# NAMED give-up on the affected request futures — never a hang — and the
+# queue must keep serving afterwards.
+#
 #   tools/fault_matrix.sh [extra pytest args...]
 #
 # FAULT_MATRIX_CHUNK is deliberately NOT LIGHTGBM_TPU_-prefixed: the test
@@ -46,6 +51,13 @@ echo "=== fault matrix: multi-host (world=2) sites=collective/*,dist/* ==="
 if ! JAX_PLATFORMS=cpu \
     python -m pytest tests/test_distributed.py -q -p no:cacheprovider \
     "$@"; then
+  status=1
+fi
+
+echo "=== fault matrix: serve sites=serve/compile,serve/enqueue ==="
+if ! JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_serve.py -q -p no:cacheprovider \
+    -k "fault" "$@"; then
   status=1
 fi
 exit ${status}

@@ -173,6 +173,28 @@ def generate() -> str:
         "  snapshot/resume/preempt boundaries.  An expired barrier raises",
         "  an error naming the missing rank(s) instead of hanging the",
         "  fleet.  Runtime-only: never serialized into the model.",
+        "- `predict_device` — where `Booster.predict` routes the tree",
+        "  walk (default `auto`): `auto` uses the compiled device router",
+        "  only when an accelerator backend is attached (on CPU the jit",
+        "  dispatch overhead swamps the host walk), `on` forces it",
+        "  everywhere (parity testing), `off` keeps the host float walk.",
+        "  Both paths are bit-identical: the device returns per-tree leaf",
+        "  INDICES and the float64 leaf-value accumulation stays on the",
+        "  host in reference order.  Runtime-only: never serialized into",
+        "  the model.  See docs/SERVING.md.",
+        "- `serve_max_batch` — prediction-service micro-batch row cap",
+        "  (default `256`): requests drained from the serve queue are",
+        "  coalesced up to this many rows per compiled dispatch, and it",
+        "  bounds the bucket ladder (8, 16, ... up to the cap) the",
+        "  executable cache compiles.  See docs/SERVING.md.",
+        "- `serve_max_delay_ms` — how long (default `2.0`) the serve",
+        "  queue holds an under-full batch open hoping for co-batchable",
+        "  requests; `0` dispatches immediately.  The knob IS the",
+        "  latency-vs-throughput tradeoff — BENCH_SERVE.json measures",
+        "  both settings.",
+        "- `serve_queue_timeout_s` — end-to-end budget (default `30`)",
+        "  for a blocking `ServeSession.predict` call; expiry raises a",
+        "  named give-up instead of hanging the caller.",
         "",
     ]
     return "\n".join(lines)

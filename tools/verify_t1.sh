@@ -10,9 +10,19 @@
 #                                             covers the wall/HBM/quality
 #                                             checks AND the measured
 #                                             dispatch-latency gate)
+#        bash tools/verify_t1.sh --serve-smoke (also run one tiny
+#                                             bench_serve cell: trains a
+#                                             toy model, pushes requests
+#                                             through the compiled
+#                                             micro-batching queue and
+#                                             bit-checks vs Booster.predict;
+#                                             writes no artifacts)
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "$1" = "--with-gate" ]; then
     python tools/bench_gate.py --self-test || exit 1
+fi
+if [ "$1" = "--serve-smoke" ]; then
+    timeout -k 10 330 env BENCH_SKIP_TPU=1 python tools/bench_serve.py --smoke || exit 1
 fi
 exit $rc
