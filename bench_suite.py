@@ -492,6 +492,18 @@ def _append_trajectory(results: list) -> None:
             tname = max(tlabels, key=lambda k: tlabels[k].get(
                 "total_s", 0.0)) if tlabels else None
             tentry = tlabels.get(tname) or {}
+            # full per-label digest (count/mean/p99 per jit seam) so a
+            # regression in a NON-heaviest seam is still visible in the
+            # trajectory, plus the histogram-pass rollup bench_gate.py
+            # latency-gates (heaviest label naming the hist kernels)
+            dlabels = {k: {"count": v.get("count"),
+                           "mean_s": v.get("mean_s"),
+                           "p99_s": v.get("p99_s")}
+                       for k, v in sorted(tlabels.items())} or None
+            hname = max((k for k in tlabels if "hist" in k),
+                        key=lambda k: tlabels[k].get("total_s", 0.0),
+                        default=None)
+            hentry = tlabels.get(hname) or {}
             # spill A/B records carry their resident-vs-spill deltas into
             # the trajectory; absent on every other config
             extra = {k: r[k] for k in ("resident_value",
@@ -515,6 +527,10 @@ def _append_trajectory(results: list) -> None:
                 "dispatch_label": tname,
                 "dispatch_mean_s": tentry.get("mean_s"),
                 "dispatch_p99_s": tentry.get("p99_s"),
+                "dispatch_labels": dlabels,
+                "hist_pass_label": hname,
+                "hist_pass_mean_s": hentry.get("mean_s"),
+                "hist_pass_p99_s": hentry.get("p99_s"),
                 "measured_flops_per_s": timing.get(
                     "measured_flops_per_s"),
                 **extra,

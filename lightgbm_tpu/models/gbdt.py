@@ -82,11 +82,24 @@ def _maybe_print_seg_stats(stats) -> None:
     blocks on the device, so recording stays gated on the same env knob
     that opts into per-iteration synchronization."""
     if stats and seg_stats_enabled():
-        rows = np.asarray(stats[0]).reshape(-1, 6)
+        from .grower_seg import SEG_STATS_SLOTS
+        rows = np.asarray(stats[0]).reshape(-1, SEG_STATS_SLOTS)
         TELEMETRY.counter_add("seg/scanned_blocks",
                               int(rows[:, 0].sum()))
         TELEMETRY.counter_add("seg/compactions", int(rows[:, 1].sum()))
         TELEMETRY.counter_add("seg/grid_steps", int(rows[:, 2].sum()))
+        # quantization / staging counters stay 0 on paths that never
+        # quantize or stage — record only live events so trace_report's
+        # hist section renders n/a instead of misleading zero rates
+        if rows[:, 6].sum():
+            TELEMETRY.counter_add("hist/quant_rescales", len(rows))
+            TELEMETRY.counter_add("hist/quant_clips",
+                                  int(rows[:, 6].sum()))
+        if rows[:, 8].sum():
+            TELEMETRY.counter_add("hist/stage_hits",
+                                  int(rows[:, 7].sum()))
+            TELEMETRY.counter_add("hist/stage_lookups",
+                                  int(rows[:, 8].sum()))
         print_seg_stats(stats[0])
 
 

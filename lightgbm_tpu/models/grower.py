@@ -368,6 +368,20 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
     L = p.num_leaves
     B = num_bins
     sp = p.split
+    # packed int16 accumulator stream: resolved ONCE at build time (env
+    # inside the jitted grow would poison the jit cache) — self-check
+    # gated with automatic fallback to the f32 channel path.  The plain
+    # grower quantizes per LEAF inside leaf_histogram_pallas, so the
+    # rescale scales are naturally per-leaf.
+    packed_acc = False
+    qbits = 8
+    if p.feature_major:
+        from ..ops.pallas_histogram import (packed_acc_bits,
+                                            packed_acc_decisions,
+                                            packed_acc_enabled)
+        packed_acc = packed_acc_enabled()
+        qbits = packed_acc_bits()
+        packed_acc_decisions["plain"] = packed_acc
 
     def hist_of(bins, grad, hess, member, G, H, C, fmeta):
         hist_bins = bins
@@ -384,7 +398,8 @@ def make_grow_tree(num_bins: int, params: GrowerParams,
         if p.feature_major:
             from ..ops.pallas_histogram import leaf_histogram_pallas
             out = leaf_histogram_pallas(hist_bins, grad, hess, member, B,
-                                        p.row_chunk, packed4=p.packed4)
+                                        p.row_chunk, packed4=p.packed4,
+                                        packed_acc=packed_acc, bits=qbits)
             if p.num_columns:
                 out = out[: p.num_columns]
         else:

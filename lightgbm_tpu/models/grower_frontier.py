@@ -35,12 +35,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import os as _os
+
 from ..ops.pallas_histogram import (_segment_buckets, frontier_width,
                                     fused_route_decisions,
                                     fused_route_policy, histogram_frontier,
                                     histogram_frontier_routed, null_route,
                                     pack_channels, pack_route,
-                                    segment_grid_size, unpack_hist)
+                                    packed_acc_bits, packed_acc_decisions,
+                                    packed_acc_enabled,
+                                    quantize_pack_channels,
+                                    segment_grid_size, unpack_hist,
+                                    unpack_hist_packed)
 from ..ops.split import (NEG_INF, FeatureMeta, best_split,
                          expand_group_hist)
 from .grower import (GrowerParams, _node_feature_mask, mono_handoff)
@@ -48,12 +54,92 @@ from .grower_seg import (COMPACT_WASTE, _COMPACT_MUT, _SegState,
                          _unpermute, apply_route, compact_state,
                          cond_narrow, fresh_state, stripe_histogram)
 
+# build-time decision, keyed "frontier" — benches read whether the
+# round-carry stage actually ran (env gate + self-check + serial-only
+# make the bare env value misleading)
+hist_stage_decisions: dict = {}
+
+_HIST_STAGE_CHECK: bool | None = None
+
+
+def hist_stage_enabled() -> bool:
+    """Whether frontier rounds should keep the round's parent/child
+    histograms in the small ``[2K, G, B, 3]`` carry stage instead of
+    gather/scatter against the full ``[L, G, B, 3]`` leaf_hist twice per
+    round (``LIGHTGBM_TPU_HIST_STAGE``).
+
+    Default OFF — no variant flips to default without a v5e number.
+    ``1/on`` runs the one-shot bit-identity self-check (staged vs
+    unstaged grow of the same tree) and falls back when it fails;
+    ``force`` bypasses the check for on-chip A/B plumbing.  Serial-only
+    either way: the distributed wrappers keep the direct carry."""
+    global _HIST_STAGE_CHECK
+    env = _os.environ.get("LIGHTGBM_TPU_HIST_STAGE", "").lower()
+    if env in ("", "0", "off", "false"):
+        return False
+    if env == "force":
+        return True
+    if _HIST_STAGE_CHECK is None:
+        try:
+            _HIST_STAGE_CHECK = _hist_stage_self_check()
+        except Exception:
+            import sys
+            import traceback
+            sys.stderr.write("hist-stage self-check raised:\n"
+                             + traceback.format_exc()[-2000:] + "\n")
+            _HIST_STAGE_CHECK = False
+    return _HIST_STAGE_CHECK
+
+
+def _hist_stage_self_check() -> bool:
+    """Round-carry staging must be BIT-identical: grow the same tree
+    staged and unstaged (explicit ``hist_stage=`` overrides, so the env
+    gate is bypassed and no recursion happens) and compare every tree
+    array and the returned leaf_id exactly."""
+    import numpy as np
+
+    from ..ops.split import SplitParams
+
+    rng = np.random.default_rng(23)
+    n, F, B, L, rb, k = 1024, 4, 16, 8, 256, 3
+    binsT = jnp.asarray(rng.integers(0, B, (F, n)), jnp.uint8)
+    grad = jnp.asarray(
+        (-(np.asarray(binsT)[0] >= B // 2).astype(np.float32)
+         - 0.5 * (np.asarray(binsT)[1] % 3 == 0)
+         + 0.1 * rng.standard_normal(n)), jnp.float32)
+    hess = jnp.ones(n, jnp.float32)
+    member = jnp.asarray((rng.random(n) < 0.9).astype(np.float32))
+    fmeta = FeatureMeta(
+        num_bin=jnp.full(F, B, jnp.int32),
+        missing_type=jnp.zeros(F, jnp.int32),
+        default_bin=jnp.zeros(F, jnp.int32),
+        is_cat=jnp.zeros(F, bool),
+        monotone=jnp.zeros(F, jnp.int32),
+        penalty=jnp.ones(F, jnp.float32))
+    fmask = jnp.ones(F, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    params = GrowerParams(num_leaves=L, hist_backend="pallas",
+                          split=SplitParams(min_data_in_leaf=2.0))
+
+    outs = []
+    for staged in (False, True):
+        grow = make_grow_tree_frontier(B, params, rb, batch_k=k,
+                                       hist_stage=staged)
+        outs.append(grow(binsT, grad, hess, member, fmeta, fmask, key))
+    (tree_a, lid_a, _), (tree_b, lid_b, _) = outs
+    if not np.array_equal(np.asarray(lid_a), np.asarray(lid_b)):
+        return False
+    for fa, fb in zip(jax.tree_util.tree_leaves(tree_a),
+                      jax.tree_util.tree_leaves(tree_b)):
+        if not np.array_equal(np.asarray(fa), np.asarray(fb)):
+            return False
+    return True
 
 
 def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                             block_rows: int, batch_k: int = 0,
                             gain_ratio: float = 0.0,
-                            comm=None, wrap=None):
+                            comm=None, wrap=None, hist_stage=None):
     """Build the jitted frontier-batched grower.
 
     Same call contract as make_grow_tree_segment:
@@ -77,14 +163,33 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
     # a ratio above 1 would gate out even the round-best leaf and hang
     # the growth loop; config validates, this clamp guards direct callers
     gain_ratio = min(max(float(gain_ratio), 0.0), 1.0)
+    # packed int16 accumulator stream (build-time decision — env inside
+    # the jitted grow would poison the jit cache).  One quantize per
+    # TREE; every unpack happens BEFORE the batch collectives, so
+    # distributed reductions only ever see real-unit histograms.
+    packed_acc = packed_acc_enabled()
+    qbits = packed_acc_bits()
+    packed_acc_decisions["frontier"] = packed_acc
     # fused route+histogram: OFF in auto for K > 1 (see
     # fused_route_policy — the K=16 fusion measured slower on-chip);
     # feature-parallel stripes always keep the unfused pair — the
     # histogram scans a column slice, the route needs the full matrix.
+    # The packed stream keeps the unfused pair too (docs/KERNELS.md):
+    # the on-chip A/B isolates one variant at a time.
     fused_route = (fused_route_policy(K, p.num_columns or 64, B, rb,
                                       p.packed4)
-                   and comm.column_block is None)
+                   and comm.column_block is None
+                   and not packed_acc)
     fused_route_decisions["frontier"] = fused_route
+    # round-carry leaf-hist staging: serial-only (the distributed
+    # wrappers' reduce/stripe hooks read the full carry); an explicit
+    # ``hist_stage=`` (the self-check) bypasses the env gate
+    serial = (comm.reduce_hist_batch is None and comm.column_block is None
+              and not comm.no_subtract)
+    if hist_stage is None:
+        hist_stage = hist_stage_enabled()
+    hist_stage = bool(hist_stage) and serial
+    hist_stage_decisions["frontier"] = hist_stage
     from ..ops.pallas_histogram import route_kernel_available
     route_kernel = route_kernel_available()
 
@@ -137,7 +242,12 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         if fpad:
             binsT = jnp.pad(binsT, ((0, fpad), (0, 0)))
 
-        w8 = pack_channels(grad, hess, member)
+        if packed_acc:
+            w8, qscales, qclips = quantize_pack_channels(
+                grad, hess, member, bits=qbits)
+        else:
+            w8 = pack_channels(grad, hess, member)
+            qscales, qclips = None, jnp.int32(0)
         G0 = jnp.sum(grad * member)
         H0 = jnp.sum(hess * member)
         C0 = jnp.sum(member)
@@ -175,7 +285,8 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                 out = histogram_frontier(st.binsT, st.w8, st.leaf_id,
                                          block_list, n_blocks, targets, B,
                                          rb, packed4=p.packed4)
-            h = unpack_hist(out[:, :G_cols])
+            h = (unpack_hist_packed(out[:, :G_cols], qscales)
+                 if packed_acc else unpack_hist(out[:, :G_cols]))
             if comm.reduce_hist_batch is not None:
                 h = comm.reduce_hist_batch(h, fmeta)
             return st, h
@@ -274,7 +385,8 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             )
             return st
 
-        def round_body(st: _SegState) -> _SegState:
+        def round_body(carry):
+            st, stage_ids, stage_hist, s_hits, s_looks = carry
             base = st.num_leaves
             budget = L - base
             gains_top, leaves_top = lax.top_k(st.best_f32[:, 0], K)
@@ -312,7 +424,32 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             def apply_one(j, s):
                 return apply_split(s, leaves_top[j], new_leaves[j],
                                    nodes[j])
-            parent_hist = st.leaf_hist[leaves_top]          # [K, G, B, 3]
+            if hist_stage:
+                # round-carry staging: flush LAST round's staged children
+                # into the full carry first (a later round may split a
+                # leaf that left the stage), then look the round's K
+                # parents up in the stage.  Best-first growth mostly
+                # splits just-created children, so the common case reads
+                # the small [2K, G, B, 3] stage instead of gathering from
+                # the [L, G, B, 3] carry — and the cond's outputs are
+                # only the small parent batch, so the miss path costs one
+                # gather, not a carried-copy of the full leaf_hist.
+                st = st._replace(leaf_hist=st.leaf_hist.at[
+                    jnp.where(stage_ids >= 0, stage_ids, L)].set(
+                        stage_hist, mode="drop"))
+                m = ((stage_ids[None, :] == leaves_top[:, None])
+                     & (stage_ids[None, :] >= 0))            # [K, 2K]
+                hit = jnp.any(m, axis=1)
+                pos = jnp.argmax(m, axis=1)
+                all_hit = jnp.all(hit | ~valid)
+                parent_hist = lax.cond(
+                    all_hit,
+                    lambda: stage_hist[jnp.where(hit, pos, 0)],
+                    lambda: st.leaf_hist[leaves_top])       # [K, G, B, 3]
+                s_hits = s_hits + jnp.sum((hit & valid).astype(jnp.int32))
+                s_looks = s_looks + jnp.sum(valid.astype(jnp.int32))
+            else:
+                parent_hist = st.leaf_hist[leaves_top]      # [K, G, B, 3]
             # ``valid`` is prefix-clamped above, so the popcount IS the
             # prefix length
             n_valid = jnp.sum(valid).astype(jnp.int32)
@@ -373,14 +510,29 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             hist_right = jnp.where(sel, hist_large, hist_small)
             idx_l = jnp.where(valid, leaves_top, L)
             idx_r = jnp.where(valid, new_leaves, L)
-            st = st._replace(
-                leaf_hist=st.leaf_hist
-                .at[idx_l].set(hist_left, mode="drop")
-                .at[idx_r].set(hist_right, mode="drop"),
-                scanned_since=st.scanned_since + scanned,
-                scanned_total=st.scanned_total + scanned,
-                grid_total=st.grid_total + grid_inc,
-            )
+            if hist_stage:
+                # the children stay in the stage this round; the flush at
+                # the top of the NEXT round persists them (a fresh stage
+                # entry shadows any stale carry slot until then)
+                stage_ids = jnp.where(
+                    jnp.concatenate([valid, valid]),
+                    jnp.concatenate([leaves_top, new_leaves]),
+                    jnp.int32(-1))
+                stage_hist = jnp.concatenate([hist_left, hist_right])
+                st = st._replace(
+                    scanned_since=st.scanned_since + scanned,
+                    scanned_total=st.scanned_total + scanned,
+                    grid_total=st.grid_total + grid_inc,
+                )
+            else:
+                st = st._replace(
+                    leaf_hist=st.leaf_hist
+                    .at[idx_l].set(hist_left, mode="drop")
+                    .at[idx_r].set(hist_right, mode="drop"),
+                    scanned_since=st.scanned_since + scanned,
+                    scanned_total=st.scanned_total + scanned,
+                    grid_total=st.grid_total + grid_inc,
+                )
 
             # 4) scan all 2K children in one vmapped pass
             leaves2 = jnp.concatenate([idx_l, idx_r])
@@ -404,7 +556,7 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             # 5) adaptive compaction, same rule as the strict grower
             st = cond_narrow(st.scanned_since >= limit_blocks,
                              compact, st, _COMPACT_MUT)
-            return st
+            return st, stage_ids, stage_hist, s_hits, s_looks
 
         limit_blocks = min(max(1, int(COMPACT_WASTE * max_blocks)),
                            2**31 - 1)
@@ -436,13 +588,25 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         def cond(st):
             return (st.num_leaves < L) & (jnp.max(st.best_f32[:, 0]) > 0.0)
 
-        st = lax.while_loop(cond, round_body, st)
+        if hist_stage:
+            # root pre-staged at slot 0 (it is also in leaf_hist[0], so
+            # the first round's flush rewrites identical values)
+            stage_ids0 = jnp.full(2 * K, -1, jnp.int32).at[0].set(0)
+            stage_hist0 = jnp.zeros((2 * K, G_cols, B, 3),
+                                    jnp.float32).at[0].set(root_hist)
+        else:
+            stage_ids0 = jnp.zeros(0, jnp.int32)
+            stage_hist0 = jnp.zeros((0, G_cols, B, 3), jnp.float32)
+        carry = (st, stage_ids0, stage_hist0, jnp.int32(0), jnp.int32(0))
+        carry = lax.while_loop(lambda c: cond(c[0]), round_body, carry)
+        st, _sid, _shist, s_hits, s_looks = carry
         leaf_id_orig = _unpermute(st.order, st.leaf_id)
         # counters as a third jit output with stable arity (axon rejects
         # in-jit host callbacks); printing is env-gated at call sites
         stats = jnp.stack([st.scanned_total, st.num_sorts, st.grid_total,
                            jnp.int32(max_blocks), jnp.int32(K),
-                           jnp.int32(0)])
+                           jnp.int32(0), qclips.astype(jnp.int32),
+                           s_hits, s_looks])
         return st.tree, leaf_id_orig, stats
 
     if wrap is not None:

@@ -43,9 +43,12 @@ from ..ops.pallas_histogram import (NUM_CHANNELS, _segment_buckets,
                                     histogram_segment,
                                     histogram_segment_routed, null_route,
                                     pack_channels, pack_route,
+                                    packed_acc_bits, packed_acc_decisions,
+                                    packed_acc_enabled,
+                                    quantize_pack_channels,
                                     route_kernel_available, route_window,
                                     segment_grid_size, unpack_hist,
-                                    unpack_nibble)
+                                    unpack_hist_packed, unpack_nibble)
 from ..ops.split import (NEG_INF, FeatureMeta, best_split, expand_group_hist,
                          reconstruct_feature_column)
 from .grower import (CommHooks, GrowerParams, TreeArrays,
@@ -70,10 +73,18 @@ import os as _os
 COMPACT_WASTE = float(_os.environ.get("LIGHTGBM_TPU_COMPACT_WASTE", "9.0"))
 
 
+# the growers' third jit output: i32 counter vector, one row per device
+# under the data-parallel wrappers.  Fixed width so every grower/wrapper
+# agrees; slots [quant_clips, stage_hits, stage_lookups] stay 0 on paths
+# that don't quantize / don't stage.
+SEG_STATS_SLOTS = 9
+
+
 def seg_stats_enabled() -> bool:
-    """When LIGHTGBM_TPU_SEG_STATS is set, growers return a third output
-    of i32 counters [scanned_blocks, compactions, grid_steps, max_blocks,
-    K, 0] (one row per device under the data-parallel wrappers)."""
+    """When LIGHTGBM_TPU_SEG_STATS is set, the counters the growers
+    return — [scanned_blocks, compactions, grid_steps, max_blocks, K,
+    reserved, quant_clips, stage_hits, stage_lookups] — are printed
+    per tree."""
     return bool(_os.environ.get("LIGHTGBM_TPU_SEG_STATS"))
 
 
@@ -90,15 +101,22 @@ def print_seg_stats(stats) -> None:
 
     import numpy as np
 
-    rows = np.asarray(stats).reshape(-1, 6)
-    for d, (scanned, sorts, grid, max_blocks, k, _r) in enumerate(rows):
+    rows = np.asarray(stats).reshape(-1, SEG_STATS_SLOTS)
+    for d, (scanned, sorts, grid, max_blocks, k, _r, clips, shits,
+            slooks) in enumerate(rows):
         dev = f" dev{d}" if len(rows) > 1 else ""
         nb = max(int(max_blocks), 1)
+        extra = ""
+        if clips:
+            extra += f", quant clips {int(clips)}"
+        if slooks:
+            extra += (f", stage hits {int(shits)}/{int(slooks)} "
+                      f"({shits / max(int(slooks), 1):.0%})")
         sys.stderr.write(
             f"seg stats{dev}: scanned {int(scanned)} blocks "
             f"({scanned / nb:.1f} N-equivalents), "
             f"grid {int(grid)} steps ({grid / nb:.1f} N-equivalents), "
-            f"{int(sorts)} compactions, K={int(k)}\n")
+            f"{int(sorts)} compactions, K={int(k)}{extra}\n")
     sys.stderr.flush()
 
 
@@ -312,17 +330,25 @@ def compact_state(st: _SegState, L: int, rb: int) -> _SegState:
     segments and confinement intervals reset to them.  Shared by the
     strict and frontier growers (identical _SegState layout)."""
     W = st.binsT.shape[0] // 4
-    if W + 5 <= _MAX_SORT_OPERANDS:
+    # packed-accumulator stream: w8 is the [2, N] i32 quantized pair /
+    # bitcast-member words — already sort-payload-shaped, so it rides the
+    # variadic sort directly (2 operands vs the f32 path's 3 halfword
+    # packs) and needs no re-pack after
+    packed_w = st.w8.dtype == jnp.int32
+    wrows = st.w8.shape[0] if packed_w else 3
+    if W + 2 + wrows <= _MAX_SORT_OPERANDS:
         operands = ((st.leaf_id,)
                     + tuple(_pack_bins_words(st.binsT))
-                    + tuple(_pack_w8_words(st.w8))
+                    + (tuple(st.w8) if packed_w
+                       else tuple(_pack_w8_words(st.w8)))
                     + (st.order,))
         sorted_ops = lax.sort(operands, num_keys=1, is_stable=True)
         lid = sorted_ops[0]
         binsT = _unpack_bins_words(jnp.stack(sorted_ops[1:1 + W]),
                                    st.binsT.dtype)
-        w8 = _unpack_w8_words(jnp.stack(sorted_ops[1 + W:1 + W + 3]))
-        order = sorted_ops[1 + W + 3]
+        wsorted = jnp.stack(sorted_ops[1 + W:1 + W + wrows])
+        w8 = wsorted if packed_w else _unpack_w8_words(wsorted)
+        order = sorted_ops[1 + W + wrows]
     else:
         # wide-feature path: 2-operand stable sort for the permutation,
         # then one gather per array (columns move as whole vectors)
@@ -331,12 +357,16 @@ def compact_state(st: _SegState, L: int, rb: int) -> _SegState:
             (st.leaf_id, jnp.arange(n, dtype=jnp.int32)),
             num_keys=1, is_stable=True)
         binsT = jnp.take(st.binsT, perm, axis=1)
-        # channels 6-7 are structurally zero (pack_channels) — move only
-        # the live ones, refill the rest (same trim the sort path makes)
-        w8 = jnp.concatenate(
-            [jnp.take(st.w8[:6], perm, axis=1),
-             jnp.zeros((st.w8.shape[0] - 6, st.w8.shape[1]),
-                       st.w8.dtype)])
+        if packed_w:
+            w8 = jnp.take(st.w8, perm, axis=1)
+        else:
+            # channels 6-7 are structurally zero (pack_channels) — move
+            # only the live ones, refill the rest (same trim the sort
+            # path makes)
+            w8 = jnp.concatenate(
+                [jnp.take(st.w8[:6], perm, axis=1),
+                 jnp.zeros((st.w8.shape[0] - 6, st.w8.shape[1]),
+                           st.w8.dtype)])
         order = jnp.take(st.order, perm)
     leaves = jnp.arange(L, dtype=jnp.int32)
     starts = jnp.searchsorted(lid, leaves, side="left").astype(jnp.int32)
@@ -432,20 +462,34 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
     L = p.num_leaves
     B = num_bins
     rb = block_rows
+    # packed int16 accumulator stream (build-time decision — env inside
+    # the jitted grow would poison the jit cache).  Quantization is per
+    # TREE here (one stream for the whole grow); the per-leaf rescale
+    # the unpack applies is the shared [2] scales vector.  Distributed-
+    # safe: every unpack happens BEFORE comm.reduce_hist, so collectives
+    # only ever see real-unit histograms.
+    packed_acc = packed_acc_enabled()
+    qbits = packed_acc_bits()
+    packed_acc_decisions["segment"] = packed_acc
     # fused route+histogram: the split's leaf_id update rides the
     # smaller-child histogram pass instead of separate XLA passes over
     # the same blocks (self-checked on the live backend at build time).
     # Feature-parallel stripes (column_block) keep the unfused pair: the
     # histogram scans a column SLICE while the route needs the full
     # matrix (the winning split may live on another shard's stripe).
+    # The packed stream keeps the unfused pair too: packed+fused has no
+    # on-chip number yet (docs/KERNELS.md), so the A/B isolates one
+    # variant at a time.
     fused_route = (fused_route_policy(1, p.num_columns or 64, B, rb,
                                       p.packed4)
-                   and comm.column_block is None)
+                   and comm.column_block is None
+                   and not packed_acc)
     fused_route_decisions["segment"] = fused_route
     route_kernel = route_kernel_available()
 
-    def hist_leaf(st: _SegState, leaf, G_cols, fmeta=None):
-        """Returns (hist [G,B,3], blocks scanned)."""
+    def hist_leaf(st: _SegState, leaf, G_cols, fmeta=None, scales=None):
+        """Returns (hist [G,B,3], blocks scanned).  ``scales`` is the
+        packed stream's [2] rescale vector (None on the f32 path)."""
         lo = st.leaf_lo[leaf]
         n_blk = st.leaf_hi[leaf] - lo
         if comm.column_block is not None:
@@ -471,7 +515,8 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         else:
             out = histogram_segment(st.binsT, st.w8, st.leaf_id, lo,
                                     n_blk, leaf, B, rb, packed4=p.packed4)
-        h = unpack_hist(out[:G_cols])
+        h = (unpack_hist_packed(out[:G_cols], scales)
+             if scales is not None else unpack_hist(out[:G_cols]))
         if comm.reduce_hist is not None:
             h = comm.reduce_hist(h, None, None, None, fmeta)
         return h, n_blk
@@ -563,7 +608,12 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         def grid_of(nb):
             return segment_grid_size(bucket_arr, nb)
 
-        w8 = pack_channels(grad, hess, member)
+        if packed_acc:
+            w8, qscales, qclips = quantize_pack_channels(
+                grad, hess, member, bits=qbits)
+        else:
+            w8 = pack_channels(grad, hess, member)
+            qscales, qclips = None, jnp.int32(0)
         G0 = jnp.sum(grad * member)
         H0 = jnp.sum(hess * member)
         C0 = jnp.sum(member)
@@ -642,14 +692,16 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                 # voting-parallel: each call's election masks differ, so
                 # parent-minus-smaller is invalid (CommHooks doc) — build
                 # BOTH children from data over the same interval
-                hist_left, _b1 = hist_leaf(st, leaf, G_cols, fmeta)
-                hist_right, _b2 = hist_leaf(st, new_leaf, G_cols, fmeta)
+                hist_left, _b1 = hist_leaf(st, leaf, G_cols, fmeta,
+                                            qscales)
+                hist_right, _b2 = hist_leaf(st, new_leaf, G_cols, fmeta,
+                                            qscales)
                 blk = _b1 + _b2
                 grid_blk = grid_of(_b1) + grid_of(_b2)
             else:
                 if not fused_route:
                     hist_small, blk = hist_leaf(st, smaller, G_cols,
-                                                fmeta)
+                                                fmeta, qscales)
                 grid_blk = grid_of(blk)
                 hist_parent = st.leaf_hist[leaf]
                 hist_large = hist_parent - hist_small
@@ -761,7 +813,7 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
                          G0, H0, C0, fmeta, p)
         if root_hist is None:
             root_hist, root_blk = hist_leaf(st, jnp.int32(0), G_cols,
-                                            fmeta)
+                                            fmeta, qscales)
         else:
             # external batched pass: charge the same scan cost so the
             # adaptive-compaction accounting is unchanged
@@ -779,7 +831,8 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
         # on LIGHTGBM_TPU_SEG_STATS at the call sites
         stats = jnp.stack([st.scanned_total, st.num_sorts, st.grid_total,
                            jnp.int32(max_blocks), jnp.int32(1),
-                           jnp.int32(0)])
+                           jnp.int32(0), qclips.astype(jnp.int32),
+                           jnp.int32(0), jnp.int32(0)])
         return st.tree, leaf_id_orig, stats
 
     if wrap is not None:

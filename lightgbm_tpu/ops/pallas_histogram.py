@@ -33,6 +33,26 @@ Two kernels share the inner body:
 The 8 weight channels are ``[g_hi, g_lo, h_hi, h_lo, member, 0, 0, 0]``;
 ``unpack_hist`` folds a kernel output ``[F, B, 8]`` back to the
 ``[F, B, 3]`` (sum_grad, sum_hess, count) layout the split scan consumes.
+
+Two env-gated variant fronts ride the same kernels (docs/KERNELS.md has
+the full catalogue and measured verdicts):
+
+  * ``LIGHTGBM_TPU_PACKED_ACC``: a packed int16 accumulator stream
+    (``quantize_pack_channels``) — grad/hess stochastically rounded to
+    int16 and packed into ONE i32 lane, halving both the weight-stream
+    HBM DMA and the accumulator channel width (the arxiv 1806.11248 /
+    1706.08359 lever).  Kernels detect the i32 dtype (it is part of the
+    jit avals, so no new static args) and widen to ``PACKED_CHANNELS``
+    bf16 lanes in VMEM; ``unpack_hist_packed`` rescales at unpack.  The
+    count channel stays exact.
+  * ``LIGHTGBM_TPU_ONEHOT_BUILD``: alternative one-hot constructions
+    (``gather``: row-gather from an eye tile; ``twolevel``: two half-
+    width compares multiplied) — bit-identical to the iota build by
+    construction (same matmul, same accumulation order).
+
+Both are auto-gated by one-shot self-checks on the live backend (the
+``LIGHTGBM_TPU_FUSED_ROUTE`` pattern) with clean fallback to the f32 /
+iota path, and neither flips to default without a v5e number.
 """
 
 from __future__ import annotations
@@ -53,6 +73,9 @@ _TPUCompilerParams = getattr(pltpu, "CompilerParams", None) \
 import os as _os
 
 NUM_CHANNELS = 8
+# channel width of the packed-accumulator stream once widened in VMEM:
+# [g_q, h_q, member, 0] — half the 8-channel hi/lo path
+PACKED_CHANNELS = 4
 DEFAULT_BLOCK_ROWS = 16384
 # inner sub-chunk of a row block: the one-hot [fblk*B, CHUNK] lives in
 # VMEM only for the duration of one matmul.  Env-tunable (read at
@@ -146,7 +169,97 @@ def unpack_hist(out: jax.Array) -> jax.Array:
     return jnp.stack([g, h, c], axis=-1)
 
 
-def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
+def packed_acc_bits() -> int:
+    """Quantization width for the packed accumulator
+    (``LIGHTGBM_TPU_PACKED_BITS``, default 8, clamped to [2, 15]).
+
+    8 bits is the exactness sweet spot: quantized ints up to +-127 are
+    EXACT in the bf16 lanes the MXU contracts (8 mantissa bits), so the
+    only error is the stochastic rounding itself.  Widths above 8 trade
+    that in-matmul exactness for resolution (bf16 rounds ints > 256) —
+    the self-check bound still holds but the verdict belongs on-chip."""
+    try:
+        bits = int(_os.environ.get("LIGHTGBM_TPU_PACKED_BITS", "8"))
+    except ValueError:
+        bits = 8
+    return max(2, min(bits, 15))
+
+
+def quantize_pack_channels(grad: jax.Array, hess: jax.Array,
+                           member: jax.Array, key=None, bits: int = 8):
+    """[N] f32 grad/hess/member -> ``([2, N] i32, [2] f32 scales, clips)``
+    packed weight stream for the packed-accumulator kernels.
+
+    Row 0 packs the stochastically-rounded int16 pair — grad*member in
+    the high halfword, hess*member in the low — so the weight stream is
+    8 bytes/row instead of 16; row 1 carries the member bits (f32
+    bitcast) so the count channel stays exact.  ``scales`` rescales the
+    summed quantized lanes back to real units at unpack: quantization is
+    per CALL, so the rescale is per tree (segment/frontier growers, one
+    quantize per grow) or per leaf (plain grower).  Stochastic rounding
+    keeps every per-bin sum unbiased; ``clips`` counts saturated lanes
+    (|q| == qmax, the rows quantized at the coarsest step) for the
+    ``hist/quant_clips`` telemetry counter.
+    """
+    gm = grad * member
+    hm = hess * member
+    qmax = float(2 ** (bits - 1) - 1)
+    gscale = jnp.maximum(jnp.max(jnp.abs(gm)), 1e-30) / qmax
+    hscale = jnp.maximum(jnp.max(jnp.abs(hm)), 1e-30) / qmax
+    if key is None:
+        # deterministic data-derived key: the rounding only needs per-row
+        # uniforms decorrelated from the values, and deriving the fold
+        # from the gradient bits gives fresh draws every tree without
+        # threading a PRNG key through the growers
+        seed = jnp.sum(lax.bitcast_convert_type(
+            gm[:8].astype(jnp.float32), jnp.int32).astype(jnp.uint32))
+        key = jax.random.fold_in(jax.random.PRNGKey(0x517CC1B7), seed)
+    kg, kh = jax.random.split(key)
+
+    def _q(x, scale, k):
+        t = x / scale
+        fl = jnp.floor(t)
+        up = jax.random.uniform(k, t.shape) < (t - fl)
+        return jnp.clip(fl + up.astype(jnp.float32),
+                        -qmax, qmax).astype(jnp.int32)
+
+    gq = _q(gm, gscale, kg)
+    hq = _q(hm, hscale, kh)
+    clips = (jnp.sum((jnp.abs(gq) >= qmax).astype(jnp.int32))
+             + jnp.sum((jnp.abs(hq) >= qmax).astype(jnp.int32)))
+    w2 = jnp.stack([
+        (gq << 16) | (hq & 0xFFFF),
+        lax.bitcast_convert_type(member.astype(jnp.float32), jnp.int32)])
+    return w2, jnp.stack([gscale, hscale]), clips
+
+
+def unpack_hist_packed(out: jax.Array, scales: jax.Array) -> jax.Array:
+    """[..., B, PACKED_CHANNELS] packed-accumulator sums -> [..., B, 3]
+    real-unit (sum_grad, sum_hess, count); ``scales`` is
+    quantize_pack_channels's [2] rescale pair."""
+    g = out[..., 0] * scales[0]
+    h = out[..., 1] * scales[1]
+    return jnp.stack([g, h, out[..., 2]], axis=-1)
+
+
+def _packed_wrows(wb: jax.Array) -> jax.Array:
+    """[2, chunk] i32 packed stream block -> [PACKED_CHANNELS, chunk]
+    bf16 rows [g_q, h_q, member, 0] for the shared matmul.
+
+    Arithmetic shifts sign-extend the int16 halves (v5e-safe: plain i32
+    VPU ops, no narrow iota/compare); i32 -> f32 -> bf16 are supported
+    single-step converts, and the member lane takes the same f32 -> bf16
+    rounding as pack_channels so counts match the 8-channel path
+    bitwise."""
+    wq = wb[0:1]
+    gq = (wq >> 16).astype(jnp.float32).astype(jnp.bfloat16)
+    hq = ((wq << 16) >> 16).astype(jnp.float32).astype(jnp.bfloat16)
+    m = lax.bitcast_convert_type(wb[1:2], jnp.float32).astype(jnp.bfloat16)
+    return jnp.concatenate([gq, hq, m, jnp.zeros_like(m)], axis=0)
+
+
+def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False,
+                      onehot_build="iota"):
     """Shared inner body: one [F, rb] bin block into the [F*B, 8]
     accumulator, one combined-one-hot matmul per (chunk, fblock).
 
@@ -160,6 +273,21 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
     equivalent of the reference's Dense4bitsBin (dense_nbits_bin.hpp:42):
     half the HBM bin-stream DMA for narrow-bin datasets; unpacking is two
     VPU ops per block.
+
+    ``onehot_build`` picks the one-hot construction (the measured ~18 ms
+    VPU bound of the 12.4 ms/pass baseline).  All three builds produce
+    the SAME [nf*B, chunk] matrix feeding the SAME dot_general, so the
+    f32 accumulation order — and therefore the output bits — cannot
+    differ:
+
+      * ``iota``  — compare-vs-broadcasted-iota (the baseline);
+      * ``gather``— one eye(B) bf16 tile built in VMEM, one row-gather
+        of the chunk's bin indices, one sublane transpose (nf*chunk
+        gather rows instead of nf*B*chunk compares);
+      * ``twolevel`` — split the bin index into high/low halves and
+        multiply two half-width compare one-hots (nf*(Bh+Bl)*chunk
+        compares instead of nf*B*chunk; power-of-two B only, falls
+        back to iota statically otherwise).
     """
     Fp, rb = binsT_ref.shape
     F = Fp * 2 if packed4 else Fp
@@ -189,6 +317,10 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
     cmp_dtype = {"bf16": jnp.bfloat16, "i16": jnp.int16}.get(
         _env, jnp.int32)
 
+    build = onehot_build
+    if build == "twolevel" and (B & (B - 1) or B < 4):
+        build = "iota"   # two-level needs a power-of-two bin count
+
     def one_chunk(c, carry):
         wc = wfn(c, chunk)                                  # [8, chunk]
         for p0 in range(0, Fp, fblk):
@@ -201,23 +333,42 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
                 b = jnp.stack([bi & 15, bi >> 4], axis=1).reshape(
                     2 * np_, chunk)
             nf = b.shape[0]
-            # narrow compare dtypes: v5e has no 16-bit iota ("16-bit
-            # iota not supported by hardware") and no direct u8->bf16
-            # convert — build both sides from i32/f32 with supported
-            # single-step converts
-            iota32 = lax.broadcasted_iota(jnp.int32, (nf, B, chunk), 1)
-            if cmp_dtype == jnp.bfloat16:
-                b = b.astype(jnp.int32).astype(jnp.float32).astype(
+            if build == "gather":
+                eye = jnp.eye(B, dtype=jnp.bfloat16)
+                oh = jnp.take(eye, b.astype(jnp.int32).reshape(-1),
+                              axis=0)                  # [nf*chunk, B]
+                onehot = oh.reshape(nf, chunk, B).transpose(
+                    0, 2, 1).reshape(nf * B, chunk)
+            elif build == "twolevel":
+                s = (B.bit_length() - 1) // 2
+                Bl = 1 << s
+                Bh = B // Bl
+                bi = b.astype(jnp.int32)
+                ih = lax.broadcasted_iota(jnp.int32, (nf, Bh, chunk), 1)
+                il = lax.broadcasted_iota(jnp.int32, (nf, Bl, chunk), 1)
+                oh_hi = ((bi >> s)[:, None, :] == ih).astype(jnp.bfloat16)
+                oh_lo = ((bi & (Bl - 1))[:, None, :] == il).astype(
                     jnp.bfloat16)
-                iota = iota32.astype(jnp.float32).astype(jnp.bfloat16)
-            elif cmp_dtype == jnp.int16:
-                b = b.astype(jnp.int32).astype(jnp.int16)
-                iota = iota32.astype(jnp.int16)
+                onehot = (oh_hi[:, :, None, :]
+                          * oh_lo[:, None, :, :]).reshape(nf * B, chunk)
             else:
-                b = b.astype(cmp_dtype)
-                iota = iota32
-            onehot = (b[:, None, :] == iota).astype(
-                jnp.bfloat16).reshape(nf * B, chunk)
+                # narrow compare dtypes: v5e has no 16-bit iota ("16-bit
+                # iota not supported by hardware") and no direct u8->bf16
+                # convert — build both sides from i32/f32 with supported
+                # single-step converts
+                iota32 = lax.broadcasted_iota(jnp.int32, (nf, B, chunk), 1)
+                if cmp_dtype == jnp.bfloat16:
+                    b = b.astype(jnp.int32).astype(jnp.float32).astype(
+                        jnp.bfloat16)
+                    iota = iota32.astype(jnp.float32).astype(jnp.bfloat16)
+                elif cmp_dtype == jnp.int16:
+                    b = b.astype(jnp.int32).astype(jnp.int16)
+                    iota = iota32.astype(jnp.int16)
+                else:
+                    b = b.astype(cmp_dtype)
+                    iota = iota32
+                onehot = (b[:, None, :] == iota).astype(
+                    jnp.bfloat16).reshape(nf * B, chunk)
             f0 = (2 * p0 if packed4 else p0)
             acc_ref[f0 * B:(f0 + nf) * B] += lax.dot_general(
                 onehot, wc, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -227,10 +378,12 @@ def _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4=False):
     lax.fori_loop(0, rb // chunk, one_chunk, 0)
 
 
-def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref, *, num_bins, packed4):
+def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref, *, num_bins, packed4,
+                onehot_build="iota"):
     # w_ref may carry MULTIPLE 8-channel sets ([8*C, rb]): the matmul
     # output widens to 8*C and each set accumulates independently — used
-    # to histogram all C class-trees' roots in one pass (multiclass)
+    # to histogram all C class-trees' roots in one pass (multiclass).
+    # An i32 w_ref is the packed-accumulator stream ([2, rb] -> 4 lanes).
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -238,9 +391,11 @@ def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref, *, num_bins, packed4):
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def wfn(c, chunk):
-        return w_ref[:, pl.ds(c * chunk, chunk)]
+        wc = w_ref[:, pl.ds(c * chunk, chunk)]
+        return _packed_wrows(wc) if w_ref.dtype == jnp.int32 else wc
 
-    _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
+    _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4,
+                      onehot_build)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -248,7 +403,7 @@ def _kernel_all(binsT_ref, w_ref, out_ref, acc_ref, *, num_bins, packed4):
 
 
 def _kernel_segment(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref, *,
-                    num_bins, packed4):
+                    num_bins, packed4, onehot_build="iota"):
     # sref: prefetched [3] i32 = (start_block, n_blocks, target_leaf)
     i = pl.program_id(0)
 
@@ -260,10 +415,13 @@ def _kernel_segment(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref, *,
     def _():
         def wfn(c, chunk):
             wc = w_ref[:, pl.ds(c * chunk, chunk)]
+            if w_ref.dtype == jnp.int32:
+                wc = _packed_wrows(wc)
             lc = lid_ref[:, pl.ds(c * chunk, chunk)]
             return wc * (lc == sref[2]).astype(jnp.bfloat16)
 
-        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
+        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4,
+                          onehot_build)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -276,7 +434,51 @@ def _interpret_default() -> bool:
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "block_rows", "interpret",
-                                    "packed4"))
+                                    "packed4", "onehot_build"))
+def _histogram_all(binsT: jax.Array, w8: jax.Array, num_bins: int,
+                   block_rows: int = 0,
+                   interpret: bool | None = None,
+                   packed4: bool = False,
+                   onehot_build: str = "iota") -> jax.Array:
+    F, n = binsT.shape
+    F_log = 2 * F if packed4 else F
+    CH = int(w8.shape[0])
+    if w8.dtype == jnp.int32:
+        # packed-accumulator stream: single channel set only (the
+        # multiclass batched-roots path keeps the f32 channels)
+        assert CH == 2, CH
+        C, och = 1, PACKED_CHANNELS
+    else:
+        assert CH % NUM_CHANNELS == 0, CH
+        C = CH // NUM_CHANNELS
+        och = CH
+    if block_rows <= 0:
+        block_rows = pick_block_rows(F_log, num_bins)
+    if interpret is None:
+        interpret = _interpret_default()
+    assert n % block_rows == 0, (n, block_rows)
+    out = pl.pallas_call(
+        functools.partial(_kernel_all, num_bins=num_bins, packed4=packed4,
+                          onehot_build=onehot_build),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, och),
+                                       jnp.float32),
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec((F, block_rows), lambda i: (0, i)),
+            pl.BlockSpec((CH, block_rows), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((F_log * num_bins, och),
+                               lambda i: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, och), jnp.float32)],
+        interpret=interpret,
+    )(binsT, w8)
+    if C == 1:
+        return out.reshape(F_log, num_bins, och)
+    # [F*B, C*8] -> [C, F, B, 8]
+    return out.reshape(F_log, num_bins, C, NUM_CHANNELS).transpose(
+        2, 0, 1, 3)
+
+
 def histogram_all(binsT: jax.Array, w8: jax.Array, num_bins: int,
                   block_rows: int = 0,
                   interpret: bool | None = None,
@@ -286,41 +488,19 @@ def histogram_all(binsT: jax.Array, w8: jax.Array, num_bins: int,
 
     ``w8`` may stack C independent 8-channel sets (multiclass batched
     roots: every class-tree's root histogram in ONE pass — C x fewer
-    full-data scans, and 8*C output columns fill more of the MXU tile).
-    Npad must be a multiple of ``block_rows``; pad rows must carry zero
-    weight channels (the bin values there may be anything).  With
-    ``packed4`` the bins hold two <=16-bin features per byte and F here
-    means PHYSICAL rows; the output has 2F logical features.
+    full-data scans, and 8*C output columns fill more of the MXU tile),
+    or be the [2, Npad] i32 packed-accumulator stream
+    (quantize_pack_channels; output [F, B, PACKED_CHANNELS], rescale via
+    unpack_hist_packed).  Npad must be a multiple of ``block_rows``; pad
+    rows must carry zero weight channels (the bin values there may be
+    anything).  With ``packed4`` the bins hold two <=16-bin features per
+    byte and F here means PHYSICAL rows; the output has 2F logical
+    features.  The one-hot build (LIGHTGBM_TPU_ONEHOT_BUILD) is resolved
+    HERE, outside the jitted dispatch, so an env change can never be
+    masked by a stale jit cache entry.
     """
-    F, n = binsT.shape
-    F_log = 2 * F if packed4 else F
-    CH = int(w8.shape[0])
-    assert CH % NUM_CHANNELS == 0, CH
-    C = CH // NUM_CHANNELS
-    if block_rows <= 0:
-        block_rows = pick_block_rows(F_log, num_bins)
-    if interpret is None:
-        interpret = _interpret_default()
-    assert n % block_rows == 0, (n, block_rows)
-    out = pl.pallas_call(
-        functools.partial(_kernel_all, num_bins=num_bins, packed4=packed4),
-        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, CH),
-                                       jnp.float32),
-        grid=(n // block_rows,),
-        in_specs=[
-            pl.BlockSpec((F, block_rows), lambda i: (0, i)),
-            pl.BlockSpec((CH, block_rows), lambda i: (0, i)),
-        ],
-        out_specs=pl.BlockSpec((F_log * num_bins, CH),
-                               lambda i: (0, 0)),
-        scratch_shapes=[pltpu.VMEM((F_log * num_bins, CH), jnp.float32)],
-        interpret=interpret,
-    )(binsT, w8)
-    if C == 1:
-        return out.reshape(F_log, num_bins, NUM_CHANNELS)
-    # [F*B, C*8] -> [C, F, B, 8]
-    return out.reshape(F_log, num_bins, C, NUM_CHANNELS).transpose(
-        2, 0, 1, 3)
+    return _histogram_all(binsT, w8, num_bins, block_rows, interpret,
+                          packed4, onehot_build_mode())
 
 
 def _segment_buckets(max_blocks: int) -> list:
@@ -373,17 +553,20 @@ def segment_grid_size(bucket_arr: jax.Array, n_blocks) -> jax.Array:
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "block_rows", "grid_blocks",
-                                    "interpret", "packed4"))
+                                    "interpret", "packed4", "onehot_build"))
 def _histogram_segment_fixed(binsT: jax.Array, w8: jax.Array,
                              leaf_id: jax.Array, start_block: jax.Array,
                              n_blocks: jax.Array, target_leaf: jax.Array,
                              num_bins: int, block_rows: int,
                              grid_blocks: int,
                              interpret: bool | None = None,
-                             packed4: bool = False) -> jax.Array:
+                             packed4: bool = False,
+                             onehot_build: str = "iota") -> jax.Array:
     """One static-grid variant; grid_blocks must be >= n_blocks."""
     F, n = binsT.shape
     F_log = 2 * F if packed4 else F
+    CHW = int(w8.shape[0])
+    och = PACKED_CHANNELS if w8.dtype == jnp.int32 else NUM_CHANNELS
     if interpret is None:
         interpret = _interpret_default()
     max_blocks = n // block_rows
@@ -400,23 +583,23 @@ def _histogram_segment_fixed(binsT: jax.Array, w8: jax.Array,
         grid=(grid_blocks,),
         in_specs=[
             pl.BlockSpec((F, block_rows), im_data),
-            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((CHW, block_rows), im_data),
             pl.BlockSpec((1, block_rows), im_data),
         ],
-        out_specs=pl.BlockSpec((F_log * num_bins, NUM_CHANNELS),
+        out_specs=pl.BlockSpec((F_log * num_bins, och),
                                lambda i, s: (0, 0)),
-        scratch_shapes=[pltpu.VMEM((F_log * num_bins, NUM_CHANNELS),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, och),
                                    jnp.float32)],
     )
     out = pl.pallas_call(
         functools.partial(_kernel_segment, num_bins=num_bins,
-                          packed4=packed4),
-        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, NUM_CHANNELS),
+                          packed4=packed4, onehot_build=onehot_build),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, och),
                                        jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
     )(scalars, binsT, w8, leaf_id.reshape(1, -1))
-    return out.reshape(F_log, num_bins, NUM_CHANNELS)
+    return out.reshape(F_log, num_bins, och)
 
 
 # Validated on-chip 2026-07-31 (ONCHIP_LOG.md "dyn-grid lowering check"
@@ -443,17 +626,20 @@ def dyn_grid_enabled() -> bool:
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "block_rows", "interpret",
-                                    "packed4"))
+                                    "packed4", "onehot_build"))
 def _histogram_segment_dyn(binsT: jax.Array, w8: jax.Array,
                            leaf_id: jax.Array, start_block: jax.Array,
                            n_blocks: jax.Array, target_leaf: jax.Array,
                            num_bins: int, block_rows: int,
                            interpret: bool | None = None,
-                           packed4: bool = False) -> jax.Array:
+                           packed4: bool = False,
+                           onehot_build: str = "iota") -> jax.Array:
     """Dynamic-grid variant: the grid is the traced interval length, so
     every step is in-range (no remapping, no skipped steps)."""
     F, n = binsT.shape
     F_log = 2 * F if packed4 else F
+    CHW = int(w8.shape[0])
+    och = PACKED_CHANNELS if w8.dtype == jnp.int32 else NUM_CHANNELS
     if interpret is None:
         interpret = _interpret_default()
     max_blocks = n // block_rows
@@ -471,23 +657,23 @@ def _histogram_segment_dyn(binsT: jax.Array, w8: jax.Array,
         grid=(grid_n,),
         in_specs=[
             pl.BlockSpec((F, block_rows), im_data),
-            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((CHW, block_rows), im_data),
             pl.BlockSpec((1, block_rows), im_data),
         ],
-        out_specs=pl.BlockSpec((F_log * num_bins, NUM_CHANNELS),
+        out_specs=pl.BlockSpec((F_log * num_bins, och),
                                lambda i, s: (0, 0)),
-        scratch_shapes=[pltpu.VMEM((F_log * num_bins, NUM_CHANNELS),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, och),
                                    jnp.float32)],
     )
     out = pl.pallas_call(
         functools.partial(_kernel_segment, num_bins=num_bins,
-                          packed4=packed4),
-        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, NUM_CHANNELS),
+                          packed4=packed4, onehot_build=onehot_build),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, och),
                                        jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
     )(scalars, binsT, w8, leaf_id.reshape(1, -1))
-    return out.reshape(F_log, num_bins, NUM_CHANNELS)
+    return out.reshape(F_log, num_bins, och)
 
 
 def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
@@ -510,24 +696,25 @@ def histogram_segment(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
         block_rows = pick_block_rows(2 * F if packed4 else F, num_bins)
     assert n % block_rows == 0, (n, block_rows)
     max_blocks = n // block_rows
+    ob = onehot_build_mode()
     if dyn_grid_enabled():
         return _histogram_segment_dyn(binsT, w8, leaf_id,
                                       jnp.asarray(start_block, jnp.int32),
                                       jnp.asarray(n_blocks, jnp.int32),
                                       target_leaf, num_bins, block_rows,
-                                      interpret, packed4)
+                                      interpret, packed4, ob)
     buckets = _segment_buckets(max_blocks)
     if len(buckets) == 1:
         return _histogram_segment_fixed(binsT, w8, leaf_id, start_block,
                                         n_blocks, target_leaf, num_bins,
                                         block_rows, buckets[0], interpret,
-                                        packed4)
+                                        packed4, ob)
     n_blocks = jnp.asarray(n_blocks, jnp.int32)
     idx = bucket_index(buckets, n_blocks)
     branches = [
         (lambda gb: lambda b, w, l, s0, nb, tl: _histogram_segment_fixed(
             b, w, l, s0, nb, tl, num_bins, block_rows, gb, interpret,
-            packed4))(gb)
+            packed4, ob))(gb)
         for gb in buckets
     ]
     return jax.lax.switch(idx, branches, binsT, w8, leaf_id, start_block,
@@ -565,7 +752,7 @@ def channel_set_capacity(num_features: int, num_bins: int,
 
 
 def _kernel_frontier(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref, *,
-                     num_bins, K, packed4):
+                     num_bins, K, packed4, onehot_build="iota"):
     """K-leaf batched histogram: one [F*B, 8K] accumulator, the one-hot
     matmul's output dim carries K leaves' channel sets — the structural
     fix for the 8-wide output that capped MXU utilization at ~6%
@@ -587,6 +774,8 @@ def _kernel_frontier(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref, *,
     def _():
         def wfn(c, chunk):
             wc = w_ref[:, pl.ds(c * chunk, chunk)]          # [8, chunk]
+            if w_ref.dtype == jnp.int32:
+                wc = _packed_wrows(wc)   # packed stream -> [4, chunk]
             lc = lid_ref[:, pl.ds(c * chunk, chunk)]        # [1, chunk]
             # K is static, so the target loads unroll into K SCALAR reads
             # (Mosaic rejects vector loads from SMEM — sref[2:2+K] lowers
@@ -598,7 +787,8 @@ def _kernel_frontier(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref, *,
                 rows.append(mask * wc)                      # [8, chunk]
             return jnp.concatenate(rows, axis=0)            # [8K, chunk]
 
-        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
+        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4,
+                          onehot_build)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -607,16 +797,20 @@ def _kernel_frontier(sref, binsT_ref, w_ref, lid_ref, out_ref, acc_ref, *,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "block_rows", "grid_blocks",
-                                    "K", "interpret", "packed4"))
+                                    "K", "interpret", "packed4",
+                                    "onehot_build"))
 def _histogram_frontier_fixed(binsT: jax.Array, w8: jax.Array,
                               leaf_id: jax.Array, block_list: jax.Array,
                               n_blocks: jax.Array, targets: jax.Array,
                               num_bins: int, block_rows: int,
                               grid_blocks: int, K: int,
                               interpret: bool | None = None,
-                              packed4: bool = False) -> jax.Array:
+                              packed4: bool = False,
+                              onehot_build: str = "iota") -> jax.Array:
     F, n = binsT.shape
     F_log = 2 * F if packed4 else F
+    CHW = int(w8.shape[0])
+    och = PACKED_CHANNELS if w8.dtype == jnp.int32 else NUM_CHANNELS
     if interpret is None:
         interpret = _interpret_default()
     max_blocks = n // block_rows
@@ -637,39 +831,42 @@ def _histogram_frontier_fixed(binsT: jax.Array, w8: jax.Array,
         grid=(grid_blocks,),
         in_specs=[
             pl.BlockSpec((F, block_rows), im_data),
-            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((CHW, block_rows), im_data),
             pl.BlockSpec((1, block_rows), im_data),
         ],
-        out_specs=pl.BlockSpec((F_log * num_bins, K * NUM_CHANNELS),
+        out_specs=pl.BlockSpec((F_log * num_bins, K * och),
                                lambda i, s: (0, 0)),
-        scratch_shapes=[pltpu.VMEM((F_log * num_bins, K * NUM_CHANNELS),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, K * och),
                                    jnp.float32)],
     )
     out = pl.pallas_call(
         functools.partial(_kernel_frontier, num_bins=num_bins, K=K,
-                          packed4=packed4),
-        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, K * NUM_CHANNELS),
+                          packed4=packed4, onehot_build=onehot_build),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, K * och),
                                        jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
     )(scalars, binsT, w8, leaf_id.reshape(1, -1))
     # [F*B, K*8] -> [K, F, B, 8]
-    return out.reshape(F_log, num_bins, K, NUM_CHANNELS).transpose(
+    return out.reshape(F_log, num_bins, K, och).transpose(
         2, 0, 1, 3)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "block_rows", "K",
-                                    "interpret", "packed4"))
+                                    "interpret", "packed4", "onehot_build"))
 def _histogram_frontier_dyn(binsT: jax.Array, w8: jax.Array,
                             leaf_id: jax.Array, block_list: jax.Array,
                             n_blocks: jax.Array, targets: jax.Array,
                             num_bins: int, block_rows: int, K: int,
                             interpret: bool | None = None,
-                            packed4: bool = False) -> jax.Array:
+                            packed4: bool = False,
+                            onehot_build: str = "iota") -> jax.Array:
     """Dynamic-grid frontier variant: grid == union size, one compile."""
     F, n = binsT.shape
     F_log = 2 * F if packed4 else F
+    CHW = int(w8.shape[0])
+    och = PACKED_CHANNELS if w8.dtype == jnp.int32 else NUM_CHANNELS
     if interpret is None:
         interpret = _interpret_default()
     max_blocks = n // block_rows
@@ -688,23 +885,23 @@ def _histogram_frontier_dyn(binsT: jax.Array, w8: jax.Array,
         grid=(grid_n,),
         in_specs=[
             pl.BlockSpec((F, block_rows), im_data),
-            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((CHW, block_rows), im_data),
             pl.BlockSpec((1, block_rows), im_data),
         ],
-        out_specs=pl.BlockSpec((F_log * num_bins, K * NUM_CHANNELS),
+        out_specs=pl.BlockSpec((F_log * num_bins, K * och),
                                lambda i, s: (0, 0)),
-        scratch_shapes=[pltpu.VMEM((F_log * num_bins, K * NUM_CHANNELS),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, K * och),
                                    jnp.float32)],
     )
     out = pl.pallas_call(
         functools.partial(_kernel_frontier, num_bins=num_bins, K=K,
-                          packed4=packed4),
-        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, K * NUM_CHANNELS),
+                          packed4=packed4, onehot_build=onehot_build),
+        out_shape=jax.ShapeDtypeStruct((F_log * num_bins, K * och),
                                        jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
     )(scalars, binsT, w8, leaf_id.reshape(1, -1))
-    return out.reshape(F_log, num_bins, K, NUM_CHANNELS).transpose(
+    return out.reshape(F_log, num_bins, K, och).transpose(
         2, 0, 1, 3)
 
 
@@ -728,23 +925,24 @@ def histogram_frontier(binsT: jax.Array, w8: jax.Array, leaf_id: jax.Array,
         block_rows = pick_block_rows(2 * F if packed4 else F, num_bins)
     assert n % block_rows == 0, (n, block_rows)
     max_blocks = n // block_rows
+    ob = onehot_build_mode()
     if dyn_grid_enabled():
         return _histogram_frontier_dyn(binsT, w8, leaf_id, block_list,
                                        jnp.asarray(n_blocks, jnp.int32),
                                        targets, num_bins, block_rows, K,
-                                       interpret, packed4)
+                                       interpret, packed4, ob)
     cap = min(int(block_list.shape[0]), max_blocks)
     buckets = _segment_buckets(cap)
     n_blocks = jnp.asarray(n_blocks, jnp.int32)
     if len(buckets) == 1:
         return _histogram_frontier_fixed(
             binsT, w8, leaf_id, block_list, n_blocks, targets, num_bins,
-            block_rows, buckets[0], K, interpret, packed4)
+            block_rows, buckets[0], K, interpret, packed4, ob)
     idx = jnp.sum(jnp.asarray(buckets, jnp.int32) < n_blocks)
     branches = [
         (lambda gb: lambda b, w, l, bl, nb, tg: _histogram_frontier_fixed(
             b, w, l, bl, nb, tg, num_bins, block_rows, gb, K, interpret,
-            packed4))(gb)
+            packed4, ob))(gb)
         for gb in buckets
     ]
     return jax.lax.switch(idx, branches, binsT, w8, leaf_id, block_list,
@@ -846,7 +1044,7 @@ def _route_block_ids(sref, o: int, frow, lid, packed4: bool):
 
 def _kernel_segment_routed(sref, binsT_ref, w_ref, frow_ref, lid_ref,
                            lid_out_ref, out_ref, acc_ref, *,
-                           num_bins, packed4):
+                           num_bins, packed4, onehot_build="iota"):
     # sref: [3 + _ROUTE_WORDS] = (start_block, n_blocks, target_leaf, route)
     i = pl.program_id(0)
 
@@ -864,10 +1062,13 @@ def _kernel_segment_routed(sref, binsT_ref, w_ref, frow_ref, lid_ref,
     def _():
         def wfn(c, chunk):
             wc = w_ref[:, pl.ds(c * chunk, chunk)]
+            if w_ref.dtype == jnp.int32:
+                wc = _packed_wrows(wc)
             lc = lid_out_ref[:, pl.ds(c * chunk, chunk)]
             return wc * (lc == sref[2]).astype(jnp.bfloat16)
 
-        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
+        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4,
+                          onehot_build)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -876,25 +1077,19 @@ def _kernel_segment_routed(sref, binsT_ref, w_ref, frow_ref, lid_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "block_rows", "interpret",
-                                    "packed4"))
-def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
-                             leaf_id: jax.Array, start_block: jax.Array,
-                             n_blocks: jax.Array, target_leaf: jax.Array,
-                             route: jax.Array, num_bins: int,
-                             block_rows: int = 0,
-                             interpret: bool | None = None,
-                             packed4: bool = False):
-    """Apply one split's route to ``leaf_id`` AND histogram ``target_leaf``
-    in a single pass over the confinement interval.
-
-    ``route`` is a [_ROUTE_WORDS] i32 descriptor (pack_route /
-    null_route).  Returns ``(leaf_id', [F, B, 8] hist)`` where the ids
-    are post-route over the whole array (blocks outside the interval
-    keep their values via input/output aliasing).  Dynamic-grid only —
-    callers needing the bucket ladder use the unfused pair.
-    """
+                                    "packed4", "onehot_build"))
+def _histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
+                              leaf_id: jax.Array, start_block: jax.Array,
+                              n_blocks: jax.Array, target_leaf: jax.Array,
+                              route: jax.Array, num_bins: int,
+                              block_rows: int = 0,
+                              interpret: bool | None = None,
+                              packed4: bool = False,
+                              onehot_build: str = "iota"):
     F, n = binsT.shape
     F_log = 2 * F if packed4 else F
+    CHW = int(w8.shape[0])
+    och = PACKED_CHANNELS if w8.dtype == jnp.int32 else NUM_CHANNELS
     if block_rows <= 0:
         block_rows = pick_block_rows(F_log, num_bins)
     assert n % block_rows == 0, (n, block_rows)
@@ -916,41 +1111,66 @@ def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
         grid=(grid_n,),
         in_specs=[
             pl.BlockSpec((F, block_rows), im_data),
-            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((CHW, block_rows), im_data),
             pl.BlockSpec((1, block_rows), im_data),
             pl.BlockSpec((1, block_rows), im_data),
         ],
         out_specs=[
             pl.BlockSpec((1, block_rows), im_data),
-            pl.BlockSpec((F_log * num_bins, NUM_CHANNELS),
+            pl.BlockSpec((F_log * num_bins, och),
                          lambda i, s: (0, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((F_log * num_bins, NUM_CHANNELS),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, och),
                                    jnp.float32)],
     )
     lid_out, hist = pl.pallas_call(
         functools.partial(_kernel_segment_routed, num_bins=num_bins,
-                          packed4=packed4),
+                          packed4=packed4, onehot_build=onehot_build),
         out_shape=[jax.ShapeDtypeStruct((1, n), jnp.int32),
-                   jax.ShapeDtypeStruct((F_log * num_bins, NUM_CHANNELS),
+                   jax.ShapeDtypeStruct((F_log * num_bins, och),
                                         jnp.float32)],
         grid_spec=grid_spec,
         # alias indices include the scalar operand: input 4 is leaf_id
         input_output_aliases={4: 0},
         # the extra frow/lid streams push the double-buffered working
         # set past Mosaic's 16 MB default scoped-vmem limit at
-        # production shapes (measured 17.14 MB, v5e); the chip has
-        # 128 MB
+        # production shapes (measured 17.14 MB, v5e) — auto-sized from
+        # the computed need instead of a hand-set override
         compiler_params=_TPUCompilerParams(
-            vmem_limit_bytes=_FUSED_VMEM_LIMIT),
+            vmem_limit_bytes=fused_vmem_limit(F, num_bins, 1, block_rows,
+                                              packed4)),
         interpret=interpret,
     )(scalars, binsT, w8, frow, leaf_id.reshape(1, -1))
-    return lid_out[0], hist.reshape(F_log, num_bins, NUM_CHANNELS)
+    return lid_out[0], hist.reshape(F_log, num_bins, och)
+
+
+def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
+                             leaf_id: jax.Array, start_block: jax.Array,
+                             n_blocks: jax.Array, target_leaf: jax.Array,
+                             route: jax.Array, num_bins: int,
+                             block_rows: int = 0,
+                             interpret: bool | None = None,
+                             packed4: bool = False):
+    """Apply one split's route to ``leaf_id`` AND histogram ``target_leaf``
+    in a single pass over the confinement interval.
+
+    ``route`` is a [_ROUTE_WORDS] i32 descriptor (pack_route /
+    null_route).  Returns ``(leaf_id', [F, B, 8] hist)`` where the ids
+    are post-route over the whole array (blocks outside the interval
+    keep their values via input/output aliasing); a [2, Npad] i32
+    ``w8`` runs the packed-accumulator stream ([F, B, 4] output).
+    Dynamic-grid only — callers needing the bucket ladder use the
+    unfused pair.
+    """
+    return _histogram_segment_routed(binsT, w8, leaf_id, start_block,
+                                     n_blocks, target_leaf, route,
+                                     num_bins, block_rows, interpret,
+                                     packed4, onehot_build_mode())
 
 
 def _kernel_frontier_routed(sref, binsT_ref, w_ref, frows_ref, lid_ref,
                             lid_out_ref, out_ref, acc_ref, *, num_bins, K,
-                            packed4):
+                            packed4, onehot_build="iota"):
     # frows_ref: [K, rb] — the K split features' bin-row blocks
     # sref: [2 + K + K*_ROUTE_WORDS + n_grid] =
     #   (n_blocks, pad, targets[K], routes[K*19], block_list[n_grid])
@@ -975,6 +1195,8 @@ def _kernel_frontier_routed(sref, binsT_ref, w_ref, frows_ref, lid_ref,
     def _():
         def wfn(c, chunk):
             wc = w_ref[:, pl.ds(c * chunk, chunk)]
+            if w_ref.dtype == jnp.int32:
+                wc = _packed_wrows(wc)
             lc = lid_out_ref[:, pl.ds(c * chunk, chunk)]
             rows = []
             for k in range(K):
@@ -982,7 +1204,8 @@ def _kernel_frontier_routed(sref, binsT_ref, w_ref, frows_ref, lid_ref,
                 rows.append(mask * wc)
             return jnp.concatenate(rows, axis=0)
 
-        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4)
+        _accumulate_block(binsT_ref, wfn, acc_ref, num_bins, packed4,
+                          onehot_build)
 
     @pl.when(i == pl.num_programs(0) - 1)
     def _():
@@ -991,25 +1214,20 @@ def _kernel_frontier_routed(sref, binsT_ref, w_ref, frows_ref, lid_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "block_rows", "K",
-                                    "interpret", "packed4"))
-def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
-                              leaf_id: jax.Array, block_list: jax.Array,
-                              n_blocks: jax.Array, targets: jax.Array,
-                              routes: jax.Array, num_bins: int,
-                              block_rows: int = 0, K: int = 0,
-                              interpret: bool | None = None,
-                              packed4: bool = False):
-    """Frontier variant: apply K splits' routes and histogram the K
-    target leaves in one pass over the union block list.
-
-    ``routes`` is [K, _ROUTE_WORDS] i32 (invalid slots: null_route()).
-    The K split features' bin rows are pre-sliced into one [K, n]
-    operand (see the fused-route header comment).  Returns
-    ``(leaf_id', [K, F, B, 8])``.
-    """
+                                    "interpret", "packed4", "onehot_build"))
+def _histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
+                               leaf_id: jax.Array, block_list: jax.Array,
+                               n_blocks: jax.Array, targets: jax.Array,
+                               routes: jax.Array, num_bins: int,
+                               block_rows: int = 0, K: int = 0,
+                               interpret: bool | None = None,
+                               packed4: bool = False,
+                               onehot_build: str = "iota"):
     F, n = binsT.shape
     K = K or int(targets.shape[0])
     F_log = 2 * F if packed4 else F
+    CHW = int(w8.shape[0])
+    och = PACKED_CHANNELS if w8.dtype == jnp.int32 else NUM_CHANNELS
     if block_rows <= 0:
         block_rows = pick_block_rows(F_log, num_bins)
     assert n % block_rows == 0, (n, block_rows)
@@ -1037,39 +1255,96 @@ def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
         grid=(grid_n,),
         in_specs=[
             pl.BlockSpec((F, block_rows), im_data),
-            pl.BlockSpec((NUM_CHANNELS, block_rows), im_data),
+            pl.BlockSpec((CHW, block_rows), im_data),
             pl.BlockSpec((K, block_rows), im_data),
             pl.BlockSpec((1, block_rows), im_data),
         ],
         out_specs=[
             pl.BlockSpec((1, block_rows), im_data),
-            pl.BlockSpec((F_log * num_bins, K * NUM_CHANNELS),
+            pl.BlockSpec((F_log * num_bins, K * och),
                          lambda i, s: (0, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((F_log * num_bins, K * NUM_CHANNELS),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, K * och),
                                    jnp.float32)],
     )
     lid_out, hist = pl.pallas_call(
         functools.partial(_kernel_frontier_routed, num_bins=num_bins, K=K,
-                          packed4=packed4),
+                          packed4=packed4, onehot_build=onehot_build),
         out_shape=[jax.ShapeDtypeStruct((1, n), jnp.int32),
                    jax.ShapeDtypeStruct((F_log * num_bins,
-                                         K * NUM_CHANNELS), jnp.float32)],
+                                         K * och), jnp.float32)],
         grid_spec=grid_spec,
         # inputs: scalars, binsT, w8, frows, leaf_id
         input_output_aliases={4: 0},
-        # see histogram_segment_routed: the K frow rows + lid streams
+        # see _histogram_segment_routed: the K frow rows + lid streams
         # exceed the 16 MB default scoped-vmem limit at K=16 production
-        # shapes
+        # shapes — auto-sized from the computed need
         compiler_params=_TPUCompilerParams(
-            vmem_limit_bytes=_FUSED_VMEM_LIMIT),
+            vmem_limit_bytes=fused_vmem_limit(F, num_bins, K, block_rows,
+                                              packed4)),
         interpret=interpret,
     )(scalars, binsT, w8, frows, leaf_id.reshape(1, -1))
     return lid_out[0], hist.reshape(F_log, num_bins, K,
-                                    NUM_CHANNELS).transpose(2, 0, 1, 3)
+                                    och).transpose(2, 0, 1, 3)
 
 
-_FUSED_VMEM_LIMIT = 64 * 1024 * 1024  # compiler_params on the fused calls
+def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
+                              leaf_id: jax.Array, block_list: jax.Array,
+                              n_blocks: jax.Array, targets: jax.Array,
+                              routes: jax.Array, num_bins: int,
+                              block_rows: int = 0, K: int = 0,
+                              interpret: bool | None = None,
+                              packed4: bool = False):
+    """Frontier variant: apply K splits' routes and histogram the K
+    target leaves in one pass over the union block list.
+
+    ``routes`` is [K, _ROUTE_WORDS] i32 (invalid slots: null_route()).
+    The K split features' bin rows are pre-sliced into one [K, n]
+    operand (see the fused-route header comment).  Returns
+    ``(leaf_id', [K, F, B, 8])`` ([K, F, B, 4] for a packed i32 ``w8``).
+    """
+    return _histogram_frontier_routed(binsT, w8, leaf_id, block_list,
+                                      n_blocks, targets, routes, num_bins,
+                                      block_rows, K, interpret, packed4,
+                                      onehot_build_mode())
+
+
+_FUSED_VMEM_CAP = 64 * 1024 * 1024  # ceiling for the auto-sized limit
+
+
+def _fused_vmem_est(F_phys: int, num_bins: int, K: int = 1,
+                    block_rows: int = 0, packed4: bool = False) -> int:
+    """Scoped-VMEM working-set estimate (bytes) for the fused kernels.
+
+    DELIBERATELY conservative: ~2x the plain double-buffered sum,
+    calibrated so the measured K=16/F=28/rb=32768 case lands near its
+    real 17.14 MB (v5e).  Shared by the ``fused_route_fits`` veto and
+    the ``fused_vmem_limit`` auto-sizing so the two can never drift."""
+    F_log = 2 * F_phys if packed4 else F_phys
+    if block_rows <= 0:
+        block_rows = pick_block_rows(F_log, num_bins)
+    streams = block_rows * (F_phys + K + 2 * NUM_CHANNELS + 8)
+    out = F_log * num_bins * K * NUM_CHANNELS * 4
+    return 2 * (3 * streams + 3 * out)
+
+
+def fused_vmem_limit(F_phys: int, num_bins: int, K: int = 1,
+                     block_rows: int = 0, packed4: bool = False) -> int:
+    """Auto-sized ``vmem_limit_bytes`` for the fused kernels: 2x the
+    conservative working-set estimate, MB-rounded, clamped to
+    [16 MB, 64 MB] — the derived replacement for the former hand-set
+    64 MB override (the K=16/F=28 case gets ~34 MB; small shapes keep
+    Mosaic's 16 MB default).  Recorded as the ``hist/vmem_limit_bytes``
+    gauge at dispatch so traces show what the compiler was given."""
+    mb = 1024 * 1024
+    est = 2 * _fused_vmem_est(F_phys, num_bins, K, block_rows, packed4)
+    limit = int(min(max(-(-est // mb) * mb, 16 * mb), _FUSED_VMEM_CAP))
+    try:
+        from ..utils.telemetry import TELEMETRY
+        TELEMETRY.gauge_set("hist/vmem_limit_bytes", limit)
+    except Exception:
+        pass
+    return limit
 
 
 def fused_route_fits(F_phys: int, num_bins: int, K: int = 1,
@@ -1077,18 +1352,11 @@ def fused_route_fits(F_phys: int, num_bins: int, K: int = 1,
     """Whether the fused kernels' scoped-VMEM working set fits at this
     shape.  The small-shape self-check can't see production-shape OOMs
     (measured: K=16, F=28, rb=32768 needs 17.14 MB against Mosaic's
-    16 MB default — hence the 64 MB compiler_params), so the auto
-    policy consults this; the estimate is DELIBERATELY conservative
-    (~2x the plain double-buffered sum, calibrated so the measured
-    case lands near its real 17.14 MB) and LIGHTGBM_TPU_FUSED_ROUTE=1
+    16 MB default), so the auto policy consults this conservative
+    estimate against the auto-limit ceiling; LIGHTGBM_TPU_FUSED_ROUTE=1
     bypasses it for A/Bs on shapes it vetoes."""
-    F_log = 2 * F_phys if packed4 else F_phys
-    if block_rows <= 0:
-        block_rows = pick_block_rows(F_log, num_bins)
-    streams = block_rows * (F_phys + K + 2 * NUM_CHANNELS + 8)
-    out = F_log * num_bins * K * NUM_CHANNELS * 4
-    est = 2 * (3 * streams + 3 * out)
-    return est <= int(0.9 * _FUSED_VMEM_LIMIT)
+    est = _fused_vmem_est(F_phys, num_bins, K, block_rows, packed4)
+    return est <= int(0.9 * _FUSED_VMEM_CAP)
 
 
 # build-time decisions, keyed "segment"/"frontier" — benches read this to
@@ -1473,12 +1741,267 @@ def _fused_route_self_check() -> bool:
     return True
 
 
+# build-time decisions, keyed "segment"/"frontier"/"plain" — benches and
+# telemetry read this to report whether the packed stream actually ran
+# (the env gate + self-check fallback make the bare env value misleading)
+packed_acc_decisions: dict = {}
+
+_PACKED_ACC_CHECK: bool | None = None
+
+
+def packed_acc_enabled() -> bool:
+    """Whether histogram passes should run the packed int16 accumulator
+    stream (``LIGHTGBM_TPU_PACKED_ACC``).
+
+    Default OFF — no variant flips to default without a v5e number.
+    ``1/on`` runs the one-shot quantization-parity self-check on the
+    live backend and falls back to the f32 channel path when it fails
+    (or fails to lower); ``force`` bypasses the check for on-chip A/B
+    plumbing; ``0/off``/empty disables."""
+    global _PACKED_ACC_CHECK
+    import os
+    env = os.environ.get("LIGHTGBM_TPU_PACKED_ACC", "").lower()
+    if env in ("", "0", "off", "false"):
+        return False
+    if env == "force":
+        return True
+    if _PACKED_ACC_CHECK is None:
+        try:
+            _PACKED_ACC_CHECK = _packed_acc_self_check()
+        except Exception:
+            import sys
+            import traceback
+            sys.stderr.write("packed-acc self-check raised:\n"
+                             + traceback.format_exc()[-2000:] + "\n")
+            _PACKED_ACC_CHECK = False
+    return _PACKED_ACC_CHECK
+
+
+def _packed_acc_self_check() -> bool:
+    """One-shot parity run of the packed-accumulator stream against the
+    f32 channel path on the live backend: count channel EXACT, grad/hess
+    bin sums within the stochastic-rounding bound (scale x (count + 1)
+    per bin), across the all/segment/frontier and packed4 legs — with a
+    fractional-member leg so GOSS/bagging weights stay covered."""
+    import numpy as np
+    rng = np.random.default_rng(13)
+
+    def _fail(leg):
+        import sys
+        sys.stderr.write(f"packed-acc self-check FAILED leg: {leg}\n")
+        return False
+
+    F, B, rb, nblk = 4, 16, 512, 4
+    n = rb * nblk
+    bits = packed_acc_bits()
+    binsT = jnp.asarray(rng.integers(0, B, (F, n)), jnp.uint8)
+    grad = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    hess = jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32)
+    # fractional members exercise the f32-bitcast count lane (GOSS)
+    member = jnp.asarray(np.where(rng.random(n) < 0.2, 0.0,
+                                  np.where(rng.random(n) < 0.3, 0.25, 1.0)
+                                  ).astype(np.float32))
+    w8 = pack_channels(grad, hess, member)
+    w2, scales, _clips = quantize_pack_channels(grad, hess, member,
+                                                bits=bits)
+    sc = np.asarray(scales)
+
+    def _bound(leg, got, ref):
+        got, ref = np.asarray(got), np.asarray(ref)
+        if not np.array_equal(got[..., 2], ref[..., 2]):
+            return _fail(f"{leg} count")
+        cnt = ref[..., 2]
+        for ch, s in ((0, sc[0]), (1, sc[1])):
+            if np.any(np.abs(got[..., ch] - ref[..., ch])
+                      > s * (cnt + 1.0) + 1e-4):
+                return _fail(f"{leg} ch{ch} bound")
+        return True
+
+    ref = unpack_hist(histogram_all(binsT, w8, B, rb))
+    got = unpack_hist_packed(histogram_all(binsT, w2, B, rb), scales)
+    if not _bound("all", got, ref):
+        return False
+
+    lid_np = np.full(n, 7, np.int32)
+    lid_np[rb:3 * rb] = np.where(rng.random(2 * rb) < 0.5, 3, 5)
+    lid = jnp.asarray(lid_np)
+    refs = unpack_hist(histogram_segment(
+        binsT, w8, lid, jnp.int32(1), jnp.int32(2), jnp.int32(3), B, rb))
+    gots = unpack_hist_packed(histogram_segment(
+        binsT, w2, lid, jnp.int32(1), jnp.int32(2), jnp.int32(3), B, rb),
+        scales)
+    if not _bound("segment", gots, refs):
+        return False
+
+    targets = jnp.asarray([3, 5], jnp.int32)
+    bl = jnp.arange(nblk, dtype=jnp.int32)
+    reff = unpack_hist(histogram_frontier(
+        binsT, w8, lid, bl, jnp.int32(nblk), targets, B, rb))
+    gotf = unpack_hist_packed(histogram_frontier(
+        binsT, w2, lid, bl, jnp.int32(nblk), targets, B, rb), scales)
+    if not _bound("frontier", gotf, reff):
+        return False
+
+    bins4 = rng.integers(0, 15, (F, n))
+    packedT = jnp.asarray(pack_bins_4bit(bins4))
+    ref4 = unpack_hist(histogram_all(packedT, w8, 16, rb, packed4=True))
+    got4 = unpack_hist_packed(histogram_all(packedT, w2, 16, rb,
+                                            packed4=True), scales)
+    if not _bound("packed4", got4, ref4):
+        return False
+    return True
+
+
+_ONEHOT_BUILD_CHECKS: dict = {}
+
+
+def onehot_build_mode() -> str:
+    """Resolved one-hot construction for the histogram kernels
+    (``LIGHTGBM_TPU_ONEHOT_BUILD``).
+
+    ''/'iota' -> the compare-vs-iota baseline.  'gather'/'twolevel' ->
+    the alternative build, gated by a one-shot BIT-identity self-check
+    against iota on the live backend (all builds feed the same matmul,
+    so identity is the contract — any difference means the build is
+    wrong or does not lower, and the mode falls back to iota with a
+    stderr note).  A trailing '!' ('gather!') bypasses the check for
+    on-chip A/Bs.  Resolved in the NON-jit public wrappers, never
+    inside a jitted dispatcher, so an env change is never masked by a
+    stale jit cache entry."""
+    import os
+    env = os.environ.get("LIGHTGBM_TPU_ONEHOT_BUILD", "").lower()
+    if env in ("", "iota"):
+        return "iota"
+    force = env.endswith("!")
+    mode = env.rstrip("!")
+    if mode not in ("gather", "twolevel"):
+        return "iota"
+    if force:
+        return mode
+    if mode not in _ONEHOT_BUILD_CHECKS:
+        try:
+            _ONEHOT_BUILD_CHECKS[mode] = _onehot_build_self_check(mode)
+        except Exception:
+            import sys
+            import traceback
+            sys.stderr.write(f"one-hot build self-check ({mode}) raised:\n"
+                             + traceback.format_exc()[-2000:] + "\n")
+            _ONEHOT_BUILD_CHECKS[mode] = False
+    if not _ONEHOT_BUILD_CHECKS[mode]:
+        return "iota"
+    return mode
+
+
+def _onehot_build_self_check(mode: str) -> bool:
+    """Bit-identity of an alternative one-hot build vs the iota baseline
+    (same [nf*B, chunk] matrix, same dot_general, same accumulation
+    order => bitwise-equal f32 sums) on full/segment/frontier and
+    packed4 legs."""
+    import numpy as np
+    rng = np.random.default_rng(17)
+
+    def _fail(leg):
+        import sys
+        sys.stderr.write(f"one-hot build self-check ({mode}) FAILED "
+                         f"leg: {leg}\n")
+        return False
+
+    F, B, rb, nblk = 4, 16, 512, 4
+    n = rb * nblk
+    binsT = jnp.asarray(rng.integers(0, B, (F, n)), jnp.uint8)
+    grad = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    hess = jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32)
+    member = jnp.ones(n, jnp.float32)
+    w8 = pack_channels(grad, hess, member)
+
+    a = _histogram_all(binsT, w8, B, rb, onehot_build="iota")
+    b = _histogram_all(binsT, w8, B, rb, onehot_build=mode)
+    if not np.array_equal(np.asarray(a), np.asarray(b)):
+        return _fail("all")
+
+    lid_np = np.full(n, 7, np.int32)
+    lid_np[rb:3 * rb] = np.where(rng.random(2 * rb) < 0.5, 3, 5)
+    lid = jnp.asarray(lid_np)
+    sa = _histogram_segment_dyn(binsT, w8, lid, jnp.int32(1), jnp.int32(2),
+                                jnp.int32(3), B, rb, onehot_build="iota")
+    sb = _histogram_segment_dyn(binsT, w8, lid, jnp.int32(1), jnp.int32(2),
+                                jnp.int32(3), B, rb, onehot_build=mode)
+    if not np.array_equal(np.asarray(sa), np.asarray(sb)):
+        return _fail("segment")
+
+    targets = jnp.asarray([3, 5], jnp.int32)
+    bl = jnp.arange(nblk, dtype=jnp.int32)
+    fa = _histogram_frontier_dyn(binsT, w8, lid, bl, jnp.int32(nblk),
+                                 targets, B, rb, 2, onehot_build="iota")
+    fb = _histogram_frontier_dyn(binsT, w8, lid, bl, jnp.int32(nblk),
+                                 targets, B, rb, 2, onehot_build=mode)
+    if not np.array_equal(np.asarray(fa), np.asarray(fb)):
+        return _fail("frontier")
+
+    bins4 = rng.integers(0, 15, (F, n))
+    packedT = jnp.asarray(pack_bins_4bit(bins4))
+    pa = _histogram_all(packedT, w8, 16, rb, packed4=True,
+                        onehot_build="iota")
+    pb = _histogram_all(packedT, w8, 16, rb, packed4=True,
+                        onehot_build=mode)
+    if not np.array_equal(np.asarray(pa), np.asarray(pb)):
+        return _fail("packed4")
+    return True
+
+
+def run_kernel_self_checks(verbose: bool = True) -> int:
+    """Run every kernel variant self-check on the current backend and
+    print a pass/fail line per check — the ``verify_t1.sh
+    --with-kernel-checks`` leg (CPU CI runs the interpret path; on-chip
+    runs catch lowering drift the interpreter cannot).  Returns a
+    process exit code (0 = all green)."""
+    checks = [
+        ("fused_route", _fused_route_self_check),
+        ("route_kernel", _route_kernel_self_check),
+        ("packed_acc", _packed_acc_self_check),
+        ("onehot_gather", lambda: _onehot_build_self_check("gather")),
+        ("onehot_twolevel", lambda: _onehot_build_self_check("twolevel")),
+    ]
+    try:
+        from ..models.grower_frontier import _hist_stage_self_check
+        checks.append(("hist_stage", _hist_stage_self_check))
+    except Exception:
+        pass
+    bad = []
+    for name, fn in checks:
+        try:
+            ok = bool(fn())
+        except Exception:
+            import sys
+            import traceback
+            sys.stderr.write(f"kernel self-check {name} raised:\n"
+                             + traceback.format_exc()[-2000:] + "\n")
+            ok = False
+        if verbose:
+            print(f"kernel self-check: {'ok' if ok else 'FAIL'} {name}")
+        if not ok:
+            bad.append(name)
+    if verbose:
+        print(f"kernel self-checks: {'FAIL' if bad else 'PASS'}")
+    return 1 if bad else 0
+
+
 def leaf_histogram_pallas(binsT: jax.Array, grad: jax.Array,
                           hess: jax.Array, member: jax.Array,
                           num_bins: int, block_rows: int = 0,
-                          packed4: bool = False) -> jax.Array:
+                          packed4: bool = False,
+                          packed_acc: bool = False,
+                          bits: int = 8) -> jax.Array:
     """Drop-in [F, B, 3] leaf histogram matching ops.histogram semantics,
-    computed with the full-data pallas kernel."""
+    computed with the full-data pallas kernel.  ``packed_acc`` runs the
+    quantized int16 stream instead of the 8-channel hi/lo split — the
+    per-call quantize gives this path natural per-leaf scales."""
+    if packed_acc:
+        w2, scales, _clips = quantize_pack_channels(grad, hess, member,
+                                                    bits=bits)
+        return unpack_hist_packed(
+            histogram_all(binsT, w2, num_bins, block_rows,
+                          packed4=packed4), scales)
     w8 = pack_channels(grad, hess, member)
     return unpack_hist(histogram_all(binsT, w8, num_bins, block_rows,
                                      packed4=packed4))

@@ -147,6 +147,25 @@ def evaluate(records, window=5, wall_tol=0.15, hbm_tol=0.20,
                 notes.append(f"{config}: dispatch latency "
                              f"{lat * 1e3:.3f}ms vs median "
                              f"{lat_base * 1e3:.3f}ms — ok")
+        # histogram-pass latency (records with per-label dispatch
+        # timing): the hist kernels are the iteration's dominant cost
+        # post-route-window, so a regression here can hide inside a
+        # steady wall when other phases happen to improve
+        hp = newest.get("hist_pass_mean_s")
+        hp_base = _median([r["hist_pass_mean_s"] for r in history
+                           if isinstance(r.get("hist_pass_mean_s"),
+                                         (int, float))
+                           and r["hist_pass_mean_s"] > 0])
+        if (isinstance(hp, (int, float)) and hp > 0
+                and hp_base is not None):
+            if hp / hp_base > 1.0 + latency_tol:
+                failures.append(
+                    f"{config}: hist pass {hp * 1e3:.3f}ms regressed "
+                    f"{hp / hp_base - 1.0:+.1%} over median "
+                    f"{hp_base * 1e3:.3f}ms (tol +{latency_tol:.0%})")
+            else:
+                notes.append(f"{config}: hist pass {hp * 1e3:.3f}ms vs "
+                             f"median {hp_base * 1e3:.3f}ms — ok")
         # serve tail latency (bench_serve.py records): p99 is the
         # service-level promise, so it gates where mean would forgive a
         # fat tail
@@ -223,6 +242,25 @@ def self_test():
             {"config": "c", "value": 10.2, "unit": "s",
              "quality_ok": True, "peak_hbm_bytes": 1000,
              "dispatch_mean_s": None})),
+    ]
+    hhist = [{"config": "h", "value": 1.0, "unit": "s/iter",
+              "quality_ok": True, "hist_pass_mean_s": 0.0124 + 0.0001 * i}
+             for i in range(4)]
+
+    def hverdict(newest):
+        failures, _ = evaluate(hhist + [newest])
+        return bool(failures)
+
+    checks += [
+        ("steady hist pass passes", not hverdict(
+            {"config": "h", "value": 1.0, "unit": "s/iter",
+             "quality_ok": True, "hist_pass_mean_s": 0.0126})),
+        ("hist pass regression fails", hverdict(
+            {"config": "h", "value": 1.0, "unit": "s/iter",
+             "quality_ok": True, "hist_pass_mean_s": 0.020})),
+        ("hist-field-free record passes hist gate", not hverdict(
+            {"config": "h", "value": 1.0, "unit": "s/iter",
+             "quality_ok": True, "hist_pass_mean_s": None})),
     ]
     shist = [{"config": "serve-s-b16-d0", "qps": 1000.0 - 5 * i,
               "p50_s": 0.001, "p99_s": 0.004 + 0.0001 * i,

@@ -1,0 +1,179 @@
+"""Round-6 unattended on-chip measurement plan.
+
+No backend was reachable while the round-6 variants were built; every
+kernel change (packed accumulator, round-carry leaf-hist staging,
+one-hot build alternatives, VMEM auto-limit) is interpret-validated
+only.  The moment the chip answers, this driver runs the full A/B
+ladder and appends everything to ONCHIP_LOG.md.  Nothing flips to
+default until the numbers from this plan land in PERF_NOTES.md.
+
+Ordered by value-per-chip-minute:
+
+  1. kernel self-checks on REAL hardware — every auto-gate
+     (fused route, packed acc, one-hot gather/twolevel, staging) must
+     lower and match on-device; interpret-green is not lowering-green
+     (ONCHIP_LOG round 4).  This also exercises the auto-sized
+     vmem_limit_bytes on every fused compile.
+  2. bench.py FIRST (the scoreboard; a short window must capture this)
+  3. frontier defaults probe at 10.5M — validates the auto-sized VMEM
+     limit at the calibration shape (K=16/F=28/rb=32768: estimator
+     says 18 MB need -> 36 MB limit vs the old hand-set 64 MB; watch
+     for Mosaic "scoped vmem" aborts, and the hist/vmem_limit_bytes
+     gauge in the seg-stats print)
+  4. packed-accumulator A/B (PACKED_ACC force vs 0, frontier + strict;
+     gate: hist-pass time down AND train_auc within 1e-3 of the off leg)
+  5. round-carry staging A/B (HIST_STAGE force vs 0, frontier only —
+     bit-identical by construction, so wall is the whole verdict)
+  6. one-hot build A/B (ONEHOT_BUILD gather!/twolevel! vs iota; "!"
+     bypasses the self-check so a compile failure is loud here rather
+     than silently falling back; twolevel needs power-of-two num_bins —
+     max_bin=63 gives B=64, so the leg is real)
+  7. in-scan eval chunked A/B re-run ON TPU (PR 7's fetch 32 -> 4; the
+     CPU numbers in PERF_NOTES are the honest dispatch-vs-compute A/B,
+     not the TPU win — this step replaces that caveat)
+  8. bench_suite spill_ab ON TPU (PR 9's resident-vs-spill A/B; current
+     trajectory records are CPU-fallback only).  bench_suite appends
+     the trajectory record itself, including the new dispatch_labels /
+     hist_pass_mean_s fields tools/bench_gate.py latency-gates.
+
+Usage:
+    python tools/onchip_r6.py          # run everything now
+    python tools/onchip_r6.py --wait   # poll until the chip answers
+    python tools/onchip_r6.py --if-up  # exit fast when the chip is down
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from onchip import PY, REPO, chip_up, log, run_step, wait_for_chip  # noqa: E402
+
+PROBE_SHAPE = "10500000,255,1,3"     # HIGGS-scale headline shape
+PROBE_SHAPE_SHORT = "10500000,255,1,2"
+
+# In-scan eval A/B at TPU scale: same metric/leaves as the CPU A/B in
+# PERF_NOTES ("In-scan eval" section) but 2M train / 200k valid rows so
+# the per-iteration fetch actually costs device time.  Prints wall
+# s/iter and transfer/fetch_calls for chunk=1 vs chunk=8 — the two
+# numbers that replace the "CPU wall honest" caveat.
+EVAL_AB = r"""
+import time
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.telemetry import TELEMETRY
+
+rng = np.random.RandomState(7)
+def gen(n):
+    X = rng.normal(size=(n, 20)).astype(np.float32)
+    y = X[:, 0] * 2.0 + X[:, 1] - X[:, 2] * X[:, 3] \
+        + rng.normal(size=n).astype(np.float32) * 0.1
+    return X, y.astype(np.float64)
+X, y = gen(2_000_000)
+Xv, yv = gen(200_000)
+for chunk in (1, 8):
+    params = {"objective": "regression", "metric": "l2",
+              "num_leaves": 31, "learning_rate": 0.1, "verbosity": -1,
+              "tpu_boost_chunk": chunk}
+    # warm-up run excludes compile from the measured wall
+    lgb.train(params, lgb.Dataset(X, y), num_boost_round=4,
+              valid_sets=[lgb.Dataset(Xv, yv)], valid_names=["v"],
+              verbose_eval=False)
+    TELEMETRY.reset()
+    t0 = time.time()
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=32,
+                    valid_sets=[lgb.Dataset(Xv, yv)], valid_names=["v"],
+                    verbose_eval=False)
+    wall = time.time() - t0
+    c = TELEMETRY.stats()["counters"]
+    print(f"EVAL_AB chunk={chunk} wall={wall:.2f}s "
+          f"per_iter={wall / 32:.4f}s "
+          f"fetch_calls={int(c.get('transfer/fetch_calls', 0))} "
+          f"eval_fetch_calls={int(c.get('transfer/eval_fetch_calls', 0))}",
+          flush=True)
+"""
+
+
+def main():
+    if "--wait" in sys.argv:
+        if not wait_for_chip(max_wait_s=10 * 3600):
+            log("r6 probe: backend never came up; giving up")
+            sys.exit(3)
+        log("r6 probe: backend UP — running plan r6")
+    elif not chip_up():
+        if "--if-up" in sys.argv:
+            print("backend down; skipping (--if-up)")
+            sys.exit(3)
+        log("r6 probe: backend DOWN; proceeding anyway")
+    else:
+        log("r6 probe: backend UP — running plan r6")
+
+    probe = os.path.join(REPO, "tools", "perf_probe.py")
+    bench = os.path.join(REPO, "bench.py")
+    suite = os.path.join(REPO, "bench_suite.py")
+
+    # 1. every kernel-variant self-check on real hardware (the same
+    # entry point verify_t1.sh --with-kernel-checks runs on interpret)
+    run_step("r6 kernel self-checks on chip", [PY, "-c", (
+        "import sys;"
+        "from lightgbm_tpu.ops.pallas_histogram import "
+        "run_kernel_self_checks;"
+        "sys.exit(run_kernel_self_checks())")], 1800)
+
+    # 2. the scoreboard
+    run_step("r6 bench (first)", [PY, bench], 9000)
+
+    # 3. VMEM auto-limit validation at the calibration shape (frontier
+    # K=16/F=28/rb=32768: the seg-stats print carries the gauge)
+    run_step("r6 frontier defaults 10.5M (auto-VMEM)",
+             [PY, probe, PROBE_SHAPE], 2400,
+             {"LIGHTGBM_TPU_SEG_STATS": "1",
+              "LIGHTGBM_TPU_IMPL": "frontier"})
+
+    # 4. packed-accumulator A/B — force vs off, both growers.  "force"
+    # bypasses the self-check so a lowering failure aborts loudly
+    # instead of silently measuring the off leg.
+    for impl in ("frontier", "auto"):
+        tag = impl if impl != "auto" else "strict"
+        run_step(f"r6 {tag} PACKED_ACC=force 10.5M",
+                 [PY, probe, PROBE_SHAPE], 2400,
+                 {"LIGHTGBM_TPU_SEG_STATS": "1",
+                  "LIGHTGBM_TPU_IMPL": impl,
+                  "LIGHTGBM_TPU_PACKED_ACC": "force"})
+        run_step(f"r6 {tag} PACKED_ACC=0 10.5M",
+                 [PY, probe, PROBE_SHAPE_SHORT], 2400,
+                 {"LIGHTGBM_TPU_SEG_STATS": "1",
+                  "LIGHTGBM_TPU_IMPL": impl,
+                  "LIGHTGBM_TPU_PACKED_ACC": "0"})
+
+    # 5. round-carry staging A/B (frontier only; serial path)
+    run_step("r6 frontier HIST_STAGE=force 10.5M",
+             [PY, probe, PROBE_SHAPE], 2400,
+             {"LIGHTGBM_TPU_SEG_STATS": "1",
+              "LIGHTGBM_TPU_IMPL": "frontier",
+              "LIGHTGBM_TPU_HIST_STAGE": "force"})
+    run_step("r6 frontier HIST_STAGE=0 10.5M",
+             [PY, probe, PROBE_SHAPE_SHORT], 2400,
+             {"LIGHTGBM_TPU_SEG_STATS": "1",
+              "LIGHTGBM_TPU_IMPL": "frontier",
+              "LIGHTGBM_TPU_HIST_STAGE": "0"})
+
+    # 6. one-hot build A/B (strict grower so the ~18 ms one-hot share
+    # of the ~27 ms pass — PERF_NOTES round 5 — is the denominator)
+    for build in ("gather!", "twolevel!", "iota"):
+        run_step(f"r6 strict ONEHOT_BUILD={build} 10.5M",
+                 [PY, probe, PROBE_SHAPE_SHORT], 2400,
+                 {"LIGHTGBM_TPU_SEG_STATS": "1",
+                  "LIGHTGBM_TPU_ONEHOT_BUILD": build})
+
+    # 7. in-scan eval chunked A/B on TPU (replaces the CPU-wall caveat)
+    run_step("r6 in-scan eval A/B (chunk 1 vs 8, 2M rows)",
+             [PY, "-c", EVAL_AB], 3600)
+
+    # 8. spill A/B on TPU (appends its own trajectory record)
+    run_step("r6 bench_suite spill_ab", [PY, suite, "spill_ab"], 4800)
+
+    log("plan r6 complete")
+
+
+if __name__ == "__main__":
+    main()

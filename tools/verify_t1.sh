@@ -17,6 +17,15 @@
 #                                             micro-batching queue and
 #                                             bit-checks vs Booster.predict;
 #                                             writes no artifacts)
+#        bash tools/verify_t1.sh --with-kernel-checks (also run every
+#                                             kernel variant self-check —
+#                                             fused route, packed
+#                                             accumulator, one-hot builds,
+#                                             round-carry staging — on the
+#                                             CPU interpret backend so CI
+#                                             catches parity regressions;
+#                                             on-chip runs catch lowering
+#                                             drift the interpreter can't)
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "$1" = "--with-gate" ]; then
@@ -24,5 +33,8 @@ if [ "$1" = "--with-gate" ]; then
 fi
 if [ "$1" = "--serve-smoke" ]; then
     timeout -k 10 330 env BENCH_SKIP_TPU=1 python tools/bench_serve.py --smoke || exit 1
+fi
+if [ "$1" = "--with-kernel-checks" ]; then
+    timeout -k 10 330 env JAX_PLATFORMS=cpu python -c 'import sys; from lightgbm_tpu.ops.pallas_histogram import run_kernel_self_checks; sys.exit(run_kernel_self_checks())' || exit 1
 fi
 exit $rc
