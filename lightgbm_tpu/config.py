@@ -292,6 +292,17 @@ _PARAMS: Dict[str, _P] = {
     # give-up budget for one queued serve request; a stuck dispatch
     # surfaces as a named ServeError instead of a hang
     "serve_queue_timeout_s": _P(30.0),
+    # streaming serve-health JSONL (serve/health.py): the session
+    # appends serve_start/serve_window/serve_admit/serve_fault/
+    # serve_summary records through the same never-torn O_APPEND writer
+    # training uses, consumable live via tools/serve_monitor.py.  Env
+    # LIGHTGBM_TPU_SERVE_HEALTH_JSONL wins; "" = no stream
+    "serve_health_out": _P(""),
+    # seconds between serve_window records (QPS, stage p50/p99, pad and
+    # coalesce fill ratios) while a serve session with a health stream
+    # is alive; idle windows are still written so a wedged server is
+    # distinguishable from an idle one
+    "serve_health_window_s": _P(5.0),
 }
 
 # runtime-only knobs excluded from a saved model's ``parameters:``
@@ -306,7 +317,9 @@ RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection",
                                  "collective_timeout_s",
                                  "predict_device", "serve_max_batch",
                                  "serve_max_delay_ms",
-                                 "serve_queue_timeout_s"])
+                                 "serve_queue_timeout_s",
+                                 "serve_health_out",
+                                 "serve_health_window_s"])
 
 # alias -> canonical name
 ALIAS_TABLE: Dict[str, str] = {}
@@ -517,6 +530,8 @@ class Config:
             raise ValueError("serve_max_delay_ms must be >= 0")
         if self.serve_queue_timeout_s <= 0:
             raise ValueError("serve_queue_timeout_s must be > 0")
+        if self.serve_health_window_s <= 0:
+            raise ValueError("serve_health_window_s must be > 0")
 
     # -- accessors --
     def to_dict(self) -> Dict[str, Any]:

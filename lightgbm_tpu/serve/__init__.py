@@ -10,16 +10,22 @@ Layering (each file usable on its own):
                 pow2 shape bucketing, CostJit-compiled, host f64 gather
   queue.py      request micro-batching with per-request futures and the
                 serve_max_delay_ms / serve_max_batch knob
+  health.py     serve health stream: serve_start/serve_window/
+                serve_admit/serve_fault/serve_summary JSONL records
+                (serve_health_out= / LIGHTGBM_TPU_SERVE_HEALTH_JSONL)
 
-``ServeSession`` wires the four together; ``Booster.serve()``
-(basic.py) is the one-liner entry point returning a handle bound to
-that booster's model.  See docs/SERVING.md.
+``ServeSession`` wires them together; ``Booster.serve()`` (basic.py)
+is the one-liner entry point returning a handle bound to that
+booster's model.  See docs/SERVING.md.
 """
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import Future
 
+from ..utils.telemetry import TELEMETRY
+from .health import SERVE_HEALTH_ENV, ServeHealth, resolve_serve_health_path
 from .predictor import MIN_BUCKET, BucketedPredictor
 from .queue import MicroBatchQueue
 from .registry import (ModelRegistry, ServeAdmissionError, ServeError,
@@ -27,37 +33,60 @@ from .registry import (ModelRegistry, ServeAdmissionError, ServeError,
 
 __all__ = [
     "ModelRegistry", "BucketedPredictor", "MicroBatchQueue",
-    "ServeSession", "ServeHandle", "ServeError", "ServeAdmissionError",
-    "SERVE_ADMIT_FRACTION", "MIN_BUCKET",
+    "ServeSession", "ServeHandle", "ServeHealth", "ServeError",
+    "ServeAdmissionError", "SERVE_ADMIT_FRACTION", "MIN_BUCKET",
+    "SERVE_HEALTH_ENV", "resolve_serve_health_path",
 ]
 
 
 class ServeSession:
-    """One registry + predictor + queue; hosts any number of models."""
+    """One registry + predictor + queue; hosts any number of models.
+
+    ``health_out=`` (the ``serve_health_out`` config parameter; env
+    ``LIGHTGBM_TPU_SERVE_HEALTH_JSONL`` wins over both) opens the
+    session's own serve health stream — a private writer, never the
+    training ``HEALTH`` instance, so serving cannot touch a training
+    run's stream or its models."""
 
     def __init__(self, max_batch: int = 256, max_delay_ms: float = 2.0,
                  queue_timeout_s: float = 30.0,
-                 admit_fraction: float = SERVE_ADMIT_FRACTION):
+                 admit_fraction: float = SERVE_ADMIT_FRACTION,
+                 health_out: str = "", health_window_s: float = 5.0):
+        path = resolve_serve_health_path(override=health_out)
+        self.health = None
+        if path:
+            self.health = ServeHealth(
+                path, window_s=health_window_s,
+                meta={"pid": os.getpid(), "max_batch": int(max_batch),
+                      "max_delay_ms": float(max_delay_ms)})
+        TELEMETRY.gauge_set("serve/max_batch", int(max_batch))
         self.registry = ModelRegistry(max_batch=max_batch,
                                       admit_fraction=admit_fraction)
+        self.registry.health = self.health
         self.predictor = BucketedPredictor(self.registry,
                                            max_batch=max_batch)
+        self.predictor.health = self.health
         self.queue = MicroBatchQueue(self.predictor,
                                      max_delay_ms=max_delay_ms,
                                      max_batch=max_batch,
-                                     queue_timeout_s=queue_timeout_s)
+                                     queue_timeout_s=queue_timeout_s,
+                                     health=self.health)
 
     @classmethod
     def from_config(cls, config, **overrides):
         """Knobs from a Config (serve_max_batch, serve_max_delay_ms,
-        serve_queue_timeout_s), keyword overrides winning.  Overrides
+        serve_queue_timeout_s, serve_health_out,
+        serve_health_window_s), keyword overrides winning.  Overrides
         accept both the constructor names (``max_batch``) and the
         config-parameter spellings (``serve_max_batch``)."""
         kw = {}
         if config is not None:
             kw = {"max_batch": config.serve_max_batch,
                   "max_delay_ms": config.serve_max_delay_ms,
-                  "queue_timeout_s": config.serve_queue_timeout_s}
+                  "queue_timeout_s": config.serve_queue_timeout_s,
+                  "health_out": getattr(config, "serve_health_out", ""),
+                  "health_window_s": getattr(config,
+                                             "serve_health_window_s", 5.0)}
         for k, v in overrides.items():
             kw[k[6:] if k.startswith("serve_") else k] = v
         return cls(**kw)

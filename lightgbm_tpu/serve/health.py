@@ -1,0 +1,253 @@
+"""Serve-side health stream: the serving half of the run-health layer.
+
+A long-lived :class:`~lightgbm_tpu.serve.ServeSession` opened with
+``serve_health_out=`` (env ``LIGHTGBM_TPU_SERVE_HEALTH_JSONL`` wins)
+appends ``lightgbm_tpu.health/v1`` records through the SAME never-torn
+``O_APPEND`` writer training uses (utils/telemetry.HealthStream) — but
+into its OWN stream instance, so serving can never interleave with (or
+truncate) a training run's health file.  Record kinds:
+
+  * ``serve_start`` — stream opened: pid, knobs (max_batch,
+    max_delay_ms, window period).
+  * ``serve_window`` — one per ``serve_health_window_s`` seconds while
+    the session lives: request/batch/row counts and QPS for the window,
+    per-stage latency p50/p99 (``t_queue``/``t_coalesce``/
+    ``t_dispatch``/``t_reply``) and end-to-end p50/p99, the coalesce
+    fill ratio (rows per batch / max_batch), pad ratio, current queue
+    depth, per-model row counts, and the HBM gauge when the backend
+    reports one.  Idle windows are still written (qps 0) so a wedged
+    server is distinguishable from an idle one.
+  * ``serve_admit`` — mirror of every registry admission decision
+    (admitted / rejected / evicted, full detail string).
+  * ``serve_fault`` — a dispatch error, injected fault or predictor
+    exception that failed request futures.
+  * ``serve_summary`` — terminal record from ``close()``: lifetime
+    totals, pending futures failed at close.  Its presence is what
+    separates an aborted-but-orderly server from a wedged one.
+
+Consume live with ``tools/serve_monitor.py`` (mirrors run_monitor).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from ..utils.telemetry import TELEMETRY, HealthStream
+
+SERVE_HEALTH_ENV = "LIGHTGBM_TPU_SERVE_HEALTH_JSONL"
+# bound on the per-window stage sample lists: a window at extreme QPS
+# keeps exact counts but quantiles come from the newest samples
+WINDOW_SAMPLE_CAPACITY = 8192
+
+# lifecycle stage keys, in request order (also the record_dispatch
+# label suffixes used by serve/queue.py)
+STAGES = ("t_queue", "t_coalesce", "t_dispatch", "t_reply")
+
+
+def resolve_serve_health_path(config=None, override: str = "") -> str:
+    """Serve stream destination: env wins over the ``serve_health_out``
+    config parameter / keyword override; "" = no stream."""
+    env = os.environ.get(SERVE_HEALTH_ENV, "")
+    if env:
+        return env
+    if override:
+        return str(override)
+    if config is not None:
+        return str(getattr(config, "serve_health_out", "") or "")
+    return ""
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              int(round(q * (len(sorted_vals) - 1))))
+    return float(sorted_vals[idx])
+
+
+class _Window:
+    """Accumulators for one serve_window period (reset each emit)."""
+
+    def __init__(self) -> None:
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.padded = 0
+        self.dispatch_rows = 0      # rows through the compiled path
+        self.e2e: List[float] = []
+        self.stages: Dict[str, List[float]] = {s: [] for s in STAGES}
+        self.model_rows: Dict[str, int] = defaultdict(int)
+
+    def _keep(self, samples: List[float], vals) -> None:
+        samples.extend(vals)
+        if len(samples) > WINDOW_SAMPLE_CAPACITY:
+            del samples[: len(samples) - WINDOW_SAMPLE_CAPACITY]
+
+
+class ServeHealth:
+    """One serve session's health stream + periodic window emitter.
+
+    The window emitter is a daemon thread bounded by ``close()`` — it
+    can never outlive the session, and ``close()`` flushes one final
+    (partial) window before the ``serve_summary`` so short-lived
+    sessions still report their traffic."""
+
+    def __init__(self, path: str, window_s: float = 5.0,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self.window_s = max(float(window_s), 0.05)
+        self._lock = threading.Lock()
+        self._win = _Window()
+        self._win_t0 = time.perf_counter()
+        # lifetime totals for the serve_summary record
+        self._total = defaultdict(int)
+        self._closed = False
+        self._stream = HealthStream()
+        rec: Dict[str, Any] = {"window_s": round(self.window_s, 3)}
+        if meta:
+            rec.update(meta)
+        self._stream.open(path, meta=rec, start_kind="serve_start")
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="serve-health",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def active(self) -> bool:
+        return self._stream.active
+
+    # ------------------------------------------------------------ feeds
+    def note_request(self, model_id: str, rows: int,
+                     stages: Dict[str, float], e2e_s: float) -> None:
+        """One replied request: its per-stage walls and end-to-end
+        latency (serve/queue.py calls this as it resolves futures)."""
+        with self._lock:
+            w = self._win
+            w.requests += 1
+            w.rows += int(rows)
+            w.model_rows[model_id] += int(rows)
+            w._keep(w.e2e, (float(e2e_s),))
+            for k, v in stages.items():
+                if k in w.stages:
+                    w._keep(w.stages[k], (float(v),))
+            self._total["requests"] += 1
+            self._total["rows"] += int(rows)
+
+    def note_dispatch(self, model_id: str, rows: int, padded: int,
+                      bucket: int) -> None:
+        """One compiled dispatch (serve/predictor.py): real rows, pad
+        rows and the bucket it compiled/ran under."""
+        with self._lock:
+            w = self._win
+            w.batches += 1
+            w.dispatch_rows += int(rows)
+            w.padded += int(padded)
+            self._total["batches"] += 1
+
+    def event(self, kind: str, fields: Optional[Dict[str, Any]] = None,
+              ) -> None:
+        """A serve_admit / serve_fault record, written immediately."""
+        self._stream.record(kind, fields)
+        if kind == "serve_fault":
+            with self._lock:
+                self._total["faults"] += 1
+
+    # ---------------------------------------------------------- windows
+    def _snapshot_window(self):
+        """Swap the live window for a fresh one; returns the finished
+        window and its wall span."""
+        with self._lock:
+            w, self._win = self._win, _Window()
+            t0, self._win_t0 = self._win_t0, time.perf_counter()
+        return w, max(self._win_t0 - t0, 1e-9)
+
+    def _window_record(self, w: _Window, span_s: float,
+                       max_batch: Optional[int] = None,
+                       ) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "span_s": round(span_s, 3),
+            "requests": w.requests,
+            "rows": w.rows,
+            "batches": w.batches,
+            "qps": round(w.requests / span_s, 3),
+            "rows_per_s": round(w.rows / span_s, 1),
+        }
+        if w.batches:
+            rec["rows_per_batch"] = round(w.dispatch_rows / w.batches, 3)
+            denom = w.dispatch_rows + w.padded
+            rec["pad_ratio"] = round(w.padded / max(denom, 1), 6)
+            cap = max_batch or TELEMETRY.gauge_get("serve/max_batch")
+            if cap:
+                # the coalescing knob's measured effect: how full the
+                # window's average dispatch ran vs the coalescing cap
+                rec["fill_ratio"] = round(
+                    w.dispatch_rows / w.batches / float(cap), 6)
+        if w.e2e:
+            lat = sorted(w.e2e)
+            rec["p50_s"] = round(_quantile(lat, 0.50), 9)
+            rec["p99_s"] = round(_quantile(lat, 0.99), 9)
+        stages = {}
+        for name, vals in w.stages.items():
+            if vals:
+                sv = sorted(vals)
+                stages[name] = {"p50_s": round(_quantile(sv, 0.50), 9),
+                                "p99_s": round(_quantile(sv, 0.99), 9)}
+        if stages:
+            rec["stages"] = stages
+        if w.model_rows:
+            rec["models"] = dict(w.model_rows)
+        depth = TELEMETRY.gauge_get("serve/queue_depth")
+        if depth is not None:
+            rec["queue_depth"] = int(depth)
+        slack = TELEMETRY.gauge_get("serve/coalesce_slack_ms")
+        if slack is not None:
+            rec["coalesce_slack_ms"] = round(float(slack), 3)
+        hbm = TELEMETRY.memory_gauges()
+        if hbm:
+            rec["hbm"] = hbm
+        return rec
+
+    def emit_window(self, max_batch: Optional[int] = None) -> None:
+        w, span = self._snapshot_window()
+        self._stream.record("serve_window",
+                            self._window_record(w, span, max_batch))
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.window_s):
+            try:
+                self.emit_window()
+            except Exception:       # a reporting bug must not kill serve
+                return
+
+    # ---------------------------------------------------------- closing
+    def close(self, pending_failed: int = 0,
+              extra: Optional[Dict[str, Any]] = None) -> None:
+        """Flush the final partial window, write ``serve_summary`` and
+        release the descriptor.  Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        try:
+            self.emit_window()
+        except Exception:
+            pass
+        with self._lock:
+            rec: Dict[str, Any] = {
+                "requests": self._total["requests"],
+                "rows": self._total["rows"],
+                "batches": self._total["batches"],
+                "faults": self._total["faults"],
+                "pending_failed": int(pending_failed),
+            }
+        if extra:
+            rec.update(extra)
+        self._stream.record("serve_summary", rec)
+        self._stream.close(summary=False)

@@ -54,6 +54,7 @@ class BucketedPredictor:
         self._fns_version = -1
         self._rows = 0
         self._padded = 0
+        self.health = None      # serve/health.ServeHealth, session-wired
 
     # ----------------------------------------------------------- compile
     def _fn_for(self, model_id: str, bucket: int):
@@ -126,6 +127,8 @@ class BucketedPredictor:
             TELEMETRY.gauge_set(
                 "serve/pad_ratio",
                 round(self._padded / max(self._rows + self._padded, 1), 6))
+        if self.health is not None:
+            self.health.note_dispatch(model_id, B, pad, bucket)
         return leaves[:, :B]
 
     def predict(self, model_id: str, X, raw_score: bool = False):

@@ -8,10 +8,29 @@ predictor.  Coalescing requests into one padded dispatch trades a
 bounded amount of added latency for fewer, fuller executables — the
 ``serve_max_delay_ms=0`` setting degenerates to dispatch-per-request.
 
+Every request carries a monotonic lifecycle timestamp tuple
+(enqueue → coalesce-close → dispatch → device-ready → reply) and each
+stage wall is recorded as a ``TELEMETRY.record_dispatch`` sample
+(``serve/t_queue``, ``serve/t_coalesce``, ``serve/t_dispatch``,
+``serve/t_reply``) plus one completed-request sample into the
+registry's sliding window (QPS/p50/p99 in ``stats()["serve"]``); at
+telemetry level >= 2 the stages also land as Chrome-trace spans on the
+``serve`` track.  ``serve/queue_depth`` and ``serve/inflight_batches``
+gauges expose the queue's instantaneous state, and
+``serve/coalesce_slack_ms`` records how much of the ``max_delay_ms``
+budget the last batch left unused — the measured signal for tuning the
+delay knob.  A session-scoped serve health stream (serve/health.py)
+additionally gets per-request stage walls and per-batch fill for its
+periodic ``serve_window`` records.
+
 Failure behavior is explicit: an injected ``serve/enqueue`` fault or a
 predictor error becomes a named exception on the affected futures
-(never a hang), and ``predict`` applies ``queue_timeout_s`` so a stuck
-dispatch surfaces as a give-up that names the site.
+(never a hang, and a ``serve_fault`` health record), and ``predict``
+applies ``queue_timeout_s`` so a stuck dispatch surfaces as a give-up
+that names the site.  ``close()`` fails pending futures, bumps the
+``serve/closed`` counter and writes the ``serve_summary`` terminal
+health record — an aborted server is distinguishable from a wedged one
+in the stream.
 """
 
 from __future__ import annotations
@@ -31,7 +50,8 @@ from .registry import ServeError
 
 
 class _Request:
-    __slots__ = ("model_id", "raw_score", "X", "future", "t_enqueue")
+    __slots__ = ("model_id", "raw_score", "X", "future", "t_enqueue",
+                 "t_coalesce")
 
     def __init__(self, model_id, raw_score, X):
         self.model_id = model_id
@@ -39,6 +59,7 @@ class _Request:
         self.X = X
         self.future = Future()
         self.t_enqueue = time.perf_counter()
+        self.t_coalesce = None          # stamped when its batch closes
 
 
 class MicroBatchQueue:
@@ -46,14 +67,16 @@ class MicroBatchQueue:
 
     def __init__(self, predictor: BucketedPredictor,
                  max_delay_ms: float = 2.0, max_batch: int = 256,
-                 queue_timeout_s: float = 30.0):
+                 queue_timeout_s: float = 30.0, health=None):
         self.predictor = predictor
         self.max_delay_s = max(float(max_delay_ms), 0.0) / 1000.0
         self.max_batch = int(max_batch)
         self.queue_timeout_s = float(queue_timeout_s)
+        self.health = health            # serve/health.ServeHealth or None
         self._pending = deque()
         self._cond = threading.Condition()
         self._closed = False
+        self._inflight = 0
         self._worker = threading.Thread(target=self._run,
                                         name="serve-batcher", daemon=True)
         self._worker.start()
@@ -75,8 +98,10 @@ class MicroBatchQueue:
             if self._closed:
                 raise ServeError("serve queue is closed")
             self._pending.append(req)
+            depth = len(self._pending)
             self._cond.notify()
         TELEMETRY.counter_add("serve/requests")
+        TELEMETRY.gauge_set("serve/queue_depth", depth)
         return req.future
 
     def predict(self, model_id: str, X, raw_score: bool = False,
@@ -91,8 +116,11 @@ class MicroBatchQueue:
                 f"waiting on the batch queue (serve_queue_timeout_s)")
 
     def close(self):
-        """Stop the worker; pending futures fail with a named error."""
+        """Stop the worker; pending futures fail with a named error.
+        Terminal telemetry makes the abort legible: the ``serve/closed``
+        counter and the stream's ``serve_summary`` record."""
         with self._cond:
+            already = self._closed
             self._closed = True
             leftovers = list(self._pending)
             self._pending.clear()
@@ -101,6 +129,12 @@ class MicroBatchQueue:
             req.future.set_exception(ServeError("serve queue closed "
                                                 "before dispatch"))
         self._worker.join(timeout=5.0)
+        if already:
+            return
+        TELEMETRY.counter_add("serve/closed")
+        TELEMETRY.gauge_set("serve/queue_depth", 0)
+        if self.health is not None:
+            self.health.close(pending_failed=len(leftovers))
 
     def __enter__(self):
         return self
@@ -139,7 +173,19 @@ class MicroBatchQueue:
                 else:
                     keep.append(r)
             self._pending = keep
-            return batch
+            depth = len(keep)
+        # coalesce-close: the window just ended for every batched
+        # request; the slack is how much of the delay budget the batch
+        # left on the table (negative = the queue ran past its window,
+        # i.e. the worker was busy dispatching when the deadline hit)
+        t_close = time.perf_counter()
+        for r in batch:
+            r.t_coalesce = t_close
+        waited_ms = (t_close - batch[0].t_enqueue) * 1e3
+        TELEMETRY.gauge_set("serve/coalesce_slack_ms",
+                            self.max_delay_s * 1e3 - waited_ms)
+        TELEMETRY.gauge_set("serve/queue_depth", depth)
+        return batch
 
     def _run(self):
         while True:
@@ -149,12 +195,17 @@ class MicroBatchQueue:
                 continue
             if batch is None:
                 return
+            t_close = batch[0].t_coalesce
             t_dispatch = time.perf_counter()
             for r in batch:
                 TELEMETRY.record_dispatch("serve/queue_wait",
                                           r.t_enqueue, t_dispatch)
             X = batch[0].X if len(batch) == 1 else \
                 np.concatenate([r.X for r in batch])
+            with self._cond:
+                self._inflight += 1
+                TELEMETRY.gauge_set("serve/inflight_batches",
+                                    self._inflight)
             try:
                 res = self.predictor.predict(batch[0].model_id, X,
                                              raw_score=batch[0].raw_score)
@@ -167,6 +218,62 @@ class MicroBatchQueue:
             except Exception as exc:
                 for r in batch:
                     r.future.set_exception(exc)
+                TELEMETRY.counter_add("serve/errors")
+                if self.health is not None:
+                    self.health.event("serve_fault", {
+                        "model": batch[0].model_id,
+                        "requests": len(batch),
+                        "error": f"{type(exc).__name__}: {exc}"})
                 continue
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    TELEMETRY.gauge_set("serve/inflight_batches",
+                                        self._inflight)
+            # device-ready: predictor.predict materialized the leaves
+            # (np.asarray blocks on the device buffers) and finished the
+            # host f64 gather; what remains is slicing + future wakeups
+            t_device = time.perf_counter()
             for r, out in zip(batch, slices):
                 r.future.set_result(out)
+            t_reply = time.perf_counter()
+            self._record_lifecycle(batch, t_close, t_dispatch, t_device,
+                                   t_reply, X.shape[0])
+
+    # ------------------------------------------------------ observability
+    def _record_lifecycle(self, batch, t_close, t_dispatch, t_device,
+                          t_reply, rows):
+        """Stage walls for every request in a replied batch: dispatch
+        samples (always), Chrome-trace spans (level >= 2, one per stage
+        per batch on the ``serve`` track), the sliding-window sample,
+        and the serve health stream's per-request feed."""
+        for r in batch:
+            TELEMETRY.record_dispatch("serve/t_queue",
+                                      r.t_enqueue, t_close)
+            TELEMETRY.record_dispatch("serve/t_coalesce",
+                                      t_close, t_dispatch)
+            TELEMETRY.record_dispatch("serve/t_dispatch",
+                                      t_dispatch, t_device)
+            TELEMETRY.record_dispatch("serve/t_reply",
+                                      t_device, t_reply)
+            TELEMETRY.serve_request_done(t_reply - r.t_enqueue,
+                                         end=t_reply)
+        args = {"requests": len(batch), "rows": int(rows)}
+        head = batch[0]
+        TELEMETRY.record_span("serve/t_queue", head.t_enqueue,
+                              t_close - head.t_enqueue, args, tid="serve")
+        TELEMETRY.record_span("serve/t_coalesce", t_close,
+                              t_dispatch - t_close, args, tid="serve")
+        TELEMETRY.record_span("serve/t_dispatch", t_dispatch,
+                              t_device - t_dispatch, args, tid="serve")
+        TELEMETRY.record_span("serve/t_reply", t_device,
+                              t_reply - t_device, args, tid="serve")
+        if self.health is not None:
+            for r in batch:
+                self.health.note_request(
+                    r.model_id, r.X.shape[0],
+                    {"t_queue": t_close - r.t_enqueue,
+                     "t_coalesce": t_dispatch - t_close,
+                     "t_dispatch": t_device - t_dispatch,
+                     "t_reply": t_reply - t_device},
+                    t_reply - r.t_enqueue)

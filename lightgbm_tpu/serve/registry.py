@@ -137,6 +137,15 @@ class ModelRegistry:
         self.pack_version = 0
         self.max_batch = int(max_batch)
         self.admit_fraction = float(admit_fraction)
+        self.health = None      # serve/health.ServeHealth, session-wired
+
+    def _admit_record(self, detail: str) -> None:
+        """Every admission decision lands in the telemetry faults section
+        AND (when the session streams health) as a serve_admit record."""
+        TELEMETRY.fault_event("serve_admit", site="serve/admit",
+                              detail=detail)
+        if self.health is not None:
+            self.health.event("serve_admit", {"detail": detail})
 
     # ------------------------------------------------------------ loading
     def load(self, booster, model_id: Optional[str] = None,
@@ -175,10 +184,9 @@ class ModelRegistry:
             self._order.remove(model_id)
             self._pack = None
             self.pack_version += 1
-            TELEMETRY.fault_event(
-                "serve_admit", site="serve/admit",
-                detail=f"evicted {model_id}; residents="
-                       f"{','.join(self._order) or '<none>'}")
+            self._admit_record(
+                f"evicted {model_id}; residents="
+                f"{','.join(self._order) or '<none>'}")
 
     # ---------------------------------------------------------- admission
     def _packed_nbytes(self, entries) -> int:
@@ -205,11 +213,10 @@ class ModelRegistry:
         pack_bytes = self._packed_nbytes(hypothetical)
         budget = TELEMETRY.device_memory_budget()
         if budget is None:
-            TELEMETRY.fault_event(
-                "serve_admit", site="serve/admit",
-                detail=f"admitted {entry.model_id} (~{entry.nbytes} B, "
-                       f"pack ~{pack_bytes} B); no allocator stats on "
-                       f"this backend — budget check skipped")
+            self._admit_record(
+                f"admitted {entry.model_id} (~{entry.nbytes} B, "
+                f"pack ~{pack_bytes} B); no allocator stats on "
+                f"this backend — budget check skipped")
             return
         # request activation for one max-size batch of the widest model:
         # raw floats in, per-tree leaves out, bins in between
@@ -220,11 +227,10 @@ class ModelRegistry:
         need = pack_bytes + act + TELEMETRY.cost_working_set()
         limit = int(self.admit_fraction * budget)
         if need <= limit:
-            TELEMETRY.fault_event(
-                "serve_admit", site="serve/admit",
-                detail=f"admitted {entry.model_id}: working set "
-                       f"~{need} B within {limit} B "
-                       f"({self.admit_fraction:.0%} of {budget} B HBM)")
+            self._admit_record(
+                f"admitted {entry.model_id}: working set "
+                f"~{need} B within {limit} B "
+                f"({self.admit_fraction:.0%} of {budget} B HBM)")
             return
         residents = ", ".join(
             f"{m.model_id}(~{m.nbytes}B)" for m in self._models.values()) \
@@ -233,8 +239,7 @@ class ModelRegistry:
                   f"~{need} B exceeds {limit} B "
                   f"({self.admit_fraction:.0%} of the {budget} B reported "
                   f"HBM budget); residents: {residents}")
-        TELEMETRY.fault_event("serve_admit", site="serve/admit",
-                              detail=detail)
+        self._admit_record(detail)
         raise ServeAdmissionError(
             f"serve admission: {detail}; evict a resident model "
             f"(ModelRegistry.evict) or raise the budget")

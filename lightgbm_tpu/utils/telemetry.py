@@ -74,6 +74,20 @@ records never tear even when a signal kills the process mid-run;
 ``resume=true`` compacts records past the snapshot iteration and keeps
 appending, yielding ONE contiguous stream across kill+resume.  Consume
 it live with ``tools/run_monitor.py``.
+
+The v5 schema adds the SERVE observability plane: every request through
+the micro-batching queue (serve/queue.py) records its lifecycle stage
+walls (``serve/t_queue`` → ``serve/t_coalesce`` → ``serve/t_dispatch``
+→ ``serve/t_reply``) through :meth:`record_dispatch`, feeds one
+completed-request sample into a bounded sliding window here
+(:meth:`serve_request_done`), and ``stats()`` gains a ``serve`` section
+with the last-10s QPS and end-to-end p50/p99
+(:meth:`serve_window_stats`).  The serve plane additionally streams its
+own health JSONL (``serve/health.py``, the same O_APPEND never-torn
+writer as training, ``serve_start``/``serve_window``/``serve_admit``/
+``serve_fault``/``serve_summary`` record kinds) — deliberately a
+SEPARATE ``HealthStream`` instance, so serving a model can never touch
+a training run's stream or its models.
 """
 
 from __future__ import annotations
@@ -87,7 +101,8 @@ from collections import defaultdict, deque
 from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
-METRICS_SCHEMA = "lightgbm_tpu.metrics/v4"
+METRICS_SCHEMA = "lightgbm_tpu.metrics/v5"
+METRICS_VERSION = 5
 HEALTH_SCHEMA = "lightgbm_tpu.health/v1"
 HEALTH_ENV = "LIGHTGBM_TPU_HEALTH_JSONL"
 TIMING_ENV = "LIGHTGBM_TPU_DEVICE_TIMING"
@@ -97,6 +112,10 @@ MEM_TRACK_CAPACITY = 16384
 FAULT_CAPACITY = 512
 # bounded per-label reservoir backing the p50/p99 dispatch quantiles
 TIMING_SAMPLE_CAPACITY = 4096
+# serve sliding window: width of the stats() serve section and the
+# capacity of the (t_done, latency) completed-request ring behind it
+SERVE_WINDOW_S = 10.0
+SERVE_SAMPLE_CAPACITY = 65536
 
 # jax.monitoring event name -> (count counter, seconds counter)
 _JAX_DURATION_EVENTS = {
@@ -170,10 +189,13 @@ class HealthStream:
 
     # ---------------------------------------------------------- lifecycle
     def open(self, path: str, resume_iter: Optional[int] = None,
-             meta: Optional[Dict[str, Any]] = None) -> None:
+             meta: Optional[Dict[str, Any]] = None,
+             start_kind: Optional[str] = None) -> None:
         """Open (or, with ``resume_iter``, compact-and-continue) the
         stream and write the ``start``/``resume`` record.  An IO failure
-        is survivable: logged, and the stream stays inactive."""
+        is survivable: logged, and the stream stays inactive.
+        ``start_kind`` renames the opening record (the serve plane's
+        private stream opens with ``serve_start``)."""
         from .log import log_warning
         with self._lock:
             if self._fd is not None:
@@ -199,7 +221,8 @@ class HealthStream:
                 return
             self._path = path
             rec: Dict[str, Any] = {
-                "kind": "resume" if resuming else "start",
+                "kind": ("resume" if resuming
+                         else (start_kind or "start")),
                 "schema": HEALTH_SCHEMA,
                 "ts": round(time.time(), 3),
                 "pid": os.getpid(),
@@ -396,6 +419,13 @@ class TelemetryRegistry:
         # the jax-profiler capture artifact (utils/phase.py): path and,
         # for windowed captures, the iteration span
         self._profile_capture: Optional[Dict[str, Any]] = None
+        # ------ serve sliding window (v5) ------
+        # (t_done rel epoch, end-to-end latency) of completed serve
+        # requests; serve/queue.py appends one sample per reply and
+        # serve_window_stats() folds the trailing SERVE_WINDOW_S into
+        # live QPS/p50/p99 — the bound makes a long-lived server's
+        # memory flat no matter how much traffic it absorbs
+        self._serve_done: deque = deque(maxlen=SERVE_SAMPLE_CAPACITY)
         # ------ fault / recovery narration ------
         # every injected fault, rollback, retry and salvage lands here so
         # the metrics blob can explain a degraded run; recorded at EVERY
@@ -486,6 +516,10 @@ class TelemetryRegistry:
         with self._lock:
             self._note_writer()
             self._gauges[name] = value
+
+    def gauge_get(self, name: str, default=None):
+        with self._lock:
+            return self._gauges.get(name, default)
 
     # -------------------------------------------------------------- spans
     def record_span(self, name: str, t0: float, dur: float,
@@ -886,6 +920,40 @@ class TelemetryRegistry:
                   int(round(q * (len(sorted_vals) - 1))))
         return float(sorted_vals[idx])
 
+    # --------------------------------------------- serve sliding window
+    def serve_request_done(self, latency_s: float,
+                           end: Optional[float] = None) -> None:
+        """Fold one completed serve request (end-to-end enqueue→reply
+        latency) into the sliding window.  ``end`` is the reply's
+        ``time.perf_counter()`` stamp (defaults to now)."""
+        if self._level < 1:
+            return
+        t = (end if end is not None else time.perf_counter()) \
+            - self._epoch
+        with self._lock:
+            self._serve_done.append((t, max(0.0, float(latency_s))))
+
+    def serve_window_stats(self, window_s: float = SERVE_WINDOW_S,
+                           now: Optional[float] = None,
+                           ) -> Optional[Dict[str, Any]]:
+        """Live serve rates over the trailing ``window_s`` seconds:
+        request count, QPS and end-to-end p50/p99.  ``None`` when no
+        request completed inside the window (distinguishes an idle
+        server from one that never served)."""
+        t_now = (now if now is not None else time.perf_counter()) \
+            - self._epoch
+        cutoff = t_now - window_s
+        with self._lock:
+            lat = sorted(lt for (t, lt) in self._serve_done
+                         if t >= cutoff)
+        if not lat:
+            return None
+        return {"window_s": float(window_s),
+                "requests": len(lat),
+                "qps": round(len(lat) / window_s, 3),
+                "p50_s": round(self._quantile(lat, 0.50), 9),
+                "p99_s": round(self._quantile(lat, 0.99), 9)}
+
     def _timing_section(self) -> Optional[Dict[str, Any]]:
         """The v4 ``timing`` section: per-label measured dispatch wall
         (count/total/mean/p50/p99/max + gap stats) and, for labels with
@@ -957,7 +1025,9 @@ class TelemetryRegistry:
         health stream, its ``health`` digest section.  v4 adds the
         ``timing`` section (measured per-dispatch wall + profiler
         capture info), present only when device timing ran or a
-        profiler capture was taken."""
+        profiler capture was taken.  v5 adds the ``serve`` section:
+        the sliding-window QPS/p50/p99 of the serve plane, present
+        only when a request completed inside the window."""
         import sys
         from .phase import GLOBAL_TIMER, _sync_enabled
         with self._lock:
@@ -975,7 +1045,7 @@ class TelemetryRegistry:
             network = net.collective_stats()
         out: Dict[str, Any] = {
             "schema": METRICS_SCHEMA,
-            "version": 4,
+            "version": METRICS_VERSION,
             "level": self._level,
             "telemetry_level": self._level,
             "mode": "sync" if _sync_enabled() else "dispatch",
@@ -996,6 +1066,9 @@ class TelemetryRegistry:
         timing = self._timing_section()
         if timing is not None:
             out["timing"] = timing
+        serve = self.serve_window_stats()
+        if serve is not None:
+            out["serve"] = serve
         faults = self._faults_section()
         if faults is not None:
             out["faults"] = faults
@@ -1107,6 +1180,7 @@ class TelemetryRegistry:
             self._data_tier = None
             self._costs = {}
             self._timing = {}
+            self._serve_done.clear()
             self._profile_capture = None
             self._faults.clear()
             self._fault_counts.clear()

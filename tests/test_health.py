@@ -111,7 +111,7 @@ def test_stats_v3_surface(tmp_path, rng):
     bst, _, path = _train_stream(tmp_path, rng, chunk=2)
     stats = bst.get_stats()
     assert stats["schema"] == METRICS_SCHEMA
-    assert stats["version"] == 4
+    assert stats["version"] == 5
     assert stats["telemetry_level"] == stats["level"]
     health = stats["health"]
     assert health["schema"] == HEALTH_SCHEMA
@@ -342,7 +342,7 @@ def test_sigterm_flushes_health_and_metrics(tmp_path, rng):
     assert recs[-1]["kind"] == "summary"      # stream flushed on the way
     assert recs[-1]["aborted"] is True        # out, not torn mid-record
     blob = json.loads((tmp_path / "metrics.json").read_text())
-    assert blob["version"] == 4
+    assert blob["version"] == 5
     assert (tmp_path / "model.txt.partial").exists()
 
 
@@ -433,3 +433,98 @@ def test_bench_gate_self_test_smoke():
          "--self-test"], capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
+
+
+# --------------------------------------------------------- fleet merge
+def _write_rank_stream(dirpath, rank, world, iters, summary=False,
+                       t_step=0.5, t_skew=0.0):
+    """One synthetic per-rank health stream with rank/world start meta,
+    the shape cli.py writes under distributed training."""
+    path = os.path.join(str(dirpath), f"rank{rank}.health.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"kind": "start", "t": 0.0,
+                             "schema": HEALTH_SCHEMA, "rank": rank,
+                             "world": world,
+                             "num_iterations": 20}) + "\n")
+        for i in range(iters):
+            fh.write(json.dumps({"kind": "iter", "iter": i,
+                                 "t": t_step * i + t_skew,
+                                 "chunk": 1}) + "\n")
+        if summary:
+            fh.write(json.dumps({"kind": "summary", "records": iters,
+                                 "iterations": iters, "aborted": False,
+                                 "t": t_step * iters}) + "\n")
+    return path
+
+
+def test_fleet_merge_attribution_and_ordering(tmp_path, capsys):
+    """--fleet over two synthetic rank streams: both ranks attributed
+    by their start meta, and the interleaved tail ordered by stream
+    time across ranks."""
+    _write_rank_stream(tmp_path, 0, 2, iters=6, t_skew=0.0)
+    _write_rank_stream(tmp_path, 1, 2, iters=6, t_skew=0.1)
+    assert run_monitor.main(["--fleet", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "2 stream(s)" in out
+    assert "rank0/2" in out and "rank1/2" in out
+    assert "STALL" not in out             # even pace: nobody flagged
+    # the tail interleaves both ranks, sorted by stream time
+    tail = [ln for ln in out.splitlines() if ln.strip().startswith("[")]
+    assert any("rank0/2" in ln for ln in tail)
+    assert any("rank1/2" in ln for ln in tail)
+    times = [float(ln.split("[")[1].split("s]")[0]) for ln in tail]
+    assert times == sorted(times)
+
+
+def test_fleet_stall_flag_when_one_stream_stops(tmp_path, capsys):
+    """The loud flag: one rank's stream stops appending while the rest
+    of the fleet advances past it."""
+    _write_rank_stream(tmp_path, 0, 3, iters=12)
+    _write_rank_stream(tmp_path, 1, 3, iters=12)
+    _write_rank_stream(tmp_path, 2, 3, iters=4)       # wedged rank
+    states = run_monitor.load_fleet(str(tmp_path))
+    stalled = run_monitor.fleet_stalled(states)
+    assert [s[0] for s in stalled] == ["rank2/3"]
+    assert run_monitor.main(["--fleet", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "!! STALL rank2/3" in out
+    assert "lags the fleet median" in out
+    # a FINISHED rank behind the median is not a stall: its summary
+    # record already explains why it stopped appending
+    _write_rank_stream(tmp_path, 2, 3, iters=4, summary=True)
+    states = run_monitor.load_fleet(str(tmp_path))
+    assert run_monitor.fleet_stalled(states) == []
+
+
+def test_fleet_follow_until_all_summaries(tmp_path):
+    """--fleet --follow exits 0 once every rank's summary lands, and
+    labels fall back to filenames for streams without rank meta."""
+    _write_rank_stream(tmp_path, 0, 2, iters=3, summary=True)
+
+    def late_writer():
+        time.sleep(0.3)
+        _write_rank_stream(tmp_path, 1, 2, iters=3, summary=True)
+
+    t = threading.Thread(target=late_writer)
+    t.start()
+    try:
+        rc = run_monitor.follow_fleet(str(tmp_path), interval=0.05,
+                                      timeout=30,
+                                      out=open(os.devnull, "w"))
+    finally:
+        t.join()
+    assert rc == 0
+    # no streams at all: exit 2 after the timeout
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert run_monitor.follow_fleet(str(empty), interval=0.05,
+                                    timeout=0.2,
+                                    out=open(os.devnull, "w")) == 2
+    # meta-less stream falls back to its filename as the label
+    other = tmp_path / "other"
+    other.mkdir()
+    with open(other / "plain.health.jsonl", "w") as fh:
+        fh.write(json.dumps({"kind": "iter", "iter": 0, "t": 0.1}) + "\n")
+    states = run_monitor.load_fleet(str(other))
+    (path, state), = states.items()
+    assert run_monitor._rank_label(path, state) == "plain.health.jsonl"

@@ -1,0 +1,327 @@
+"""Serve observability plane tests (metrics schema v5).
+
+What must hold: every request through the micro-batching queue leaves
+a complete lifecycle trail — the four stage distributions
+(``serve/t_queue``/``t_coalesce``/``t_dispatch``/``t_reply``) in the
+telemetry timing section with ordered quantiles, the sliding-window
+QPS/p50/p99 in ``stats()["serve"]``, queue-depth/inflight gauges, and
+the coalesce-slack signal.  A session opened with ``serve_health_out=``
+(env wins) writes a parseable never-torn JSONL stream whose windows
+account for every request and whose terminal ``serve_summary`` (plus
+the ``serve/closed`` counter) separates an orderly close from a wedged
+server.  The open-loop load generator must show the coalescing window
+engaging at high arrival rate and NOT at a trickle — the numbers
+ROADMAP item 1 demanded.  And none of it may touch training: models
+stay byte-identical with the serve stream enabled.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve import ServeSession, resolve_serve_health_path
+from lightgbm_tpu.serve.health import SERVE_HEALTH_ENV
+from lightgbm_tpu.utils.faults import FAULTS
+from lightgbm_tpu.utils.telemetry import TELEMETRY
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import loadgen  # noqa: E402
+import serve_monitor  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    TELEMETRY.reset()
+    TELEMETRY.set_config_level(1)
+    TELEMETRY.install_jax_listeners()
+    yield
+    FAULTS.configure()
+
+
+def _train(rng, rounds=8):
+    X = rng.normal(size=(400, 8))
+    X[:, 3] = rng.randint(0, 6, size=400)
+    y = (np.nan_to_num(X[:, 0] + X[:, 1]) > 0.3).astype(np.float64)
+    ds = lgb.Dataset(X, y, categorical_feature=[3])
+    return lgb.train({"objective": "binary", "verbose": -1,
+                      "num_leaves": 15}, ds,
+                     num_boost_round=rounds), X
+
+
+def _records(path):
+    out = []
+    with open(path, "rb") as fh:
+        for raw in fh.read().split(b"\n"):
+            if raw.strip():
+                out.append(json.loads(raw))    # torn line would raise
+    return out
+
+
+# ------------------------------------------------- lifecycle tracing
+def test_lifecycle_stage_distributions(rng):
+    bst, X = _train(rng)
+    with ServeSession(max_batch=32, max_delay_ms=2.0) as sess:
+        mid = sess.load(bst)
+        futs = [sess.submit(mid, X[i:i + 1]) for i in range(40)]
+        for f in futs:
+            f.result(timeout=30)
+        # the worker records the last batch's stage walls just after
+        # resolving its futures — poll for the full count
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            stats = TELEMETRY.stats()
+            labels = stats.get("timing", {}).get("labels", {})
+            if labels.get("serve/t_reply", {}).get("count", 0) >= 40:
+                break
+            time.sleep(0.01)
+    for stage in ("serve/t_queue", "serve/t_coalesce",
+                  "serve/t_dispatch", "serve/t_reply",
+                  "serve/queue_wait"):
+        assert stage in labels, f"missing stage distribution {stage}"
+        d = labels[stage]
+        assert d["count"] >= 40
+        assert 0 <= d["p50_s"] <= d["p99_s"], stage
+        assert math.isfinite(d["p99_s"])
+    gauges = stats["gauges"]
+    assert gauges["serve/queue_depth"] == 0          # all drained
+    assert gauges["serve/inflight_batches"] == 0
+    assert isinstance(gauges["serve/coalesce_slack_ms"], float)
+    assert gauges["serve/max_batch"] == 32
+
+
+def test_sliding_window_serve_stats(rng):
+    assert TELEMETRY.serve_window_stats() is None    # idle: no section
+    bst, X = _train(rng)
+    with ServeSession(max_batch=16, max_delay_ms=0.0) as sess:
+        mid = sess.load(bst)
+        for i in range(12):
+            sess.predict(mid, X[i:i + 1])
+        # the last request's window sample lands just after its future
+        # resolves — poll for the full count
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            stats = TELEMETRY.stats()
+            if stats.get("serve", {}).get("requests", 0) >= 12:
+                break
+            time.sleep(0.01)
+    assert stats["version"] == 5
+    assert stats["schema"] == "lightgbm_tpu.metrics/v5"
+    win = stats["serve"]
+    assert win["requests"] == 12
+    assert win["qps"] > 0
+    assert 0 <= win["p50_s"] <= win["p99_s"]
+    # outside the 10s window nothing remains
+    assert TELEMETRY.serve_window_stats(
+        now=TELEMETRY._epoch + 3600.0) is None
+
+
+def test_spans_on_serve_track(rng):
+    bst, X = _train(rng)
+    # after training: lgb.train binds the config's telemetry_level (1)
+    TELEMETRY.set_config_level(2)
+    with ServeSession(max_batch=16, max_delay_ms=0.0) as sess:
+        mid = sess.load(bst)
+        sess.predict(mid, X[:4])
+        # the worker records the batch's spans just after resolving the
+        # future — poll briefly instead of racing it
+        deadline = time.monotonic() + 5.0
+        events, trace = [], {"traceEvents": []}
+        while time.monotonic() < deadline and len(events) < 4:
+            trace = TELEMETRY.chrome_trace()
+            events = [e for e in trace["traceEvents"]
+                      if e.get("ph") == "X"
+                      and str(e.get("name", "")).startswith("serve/t_")]
+            time.sleep(0.01)
+    names = {e["name"] for e in events}
+    assert names == {"serve/t_queue", "serve/t_coalesce",
+                     "serve/t_dispatch", "serve/t_reply"}
+    # all four stages live on the dedicated "serve" track: one numeric
+    # tid whose thread_name metadata event names it
+    serve_tids = {m["tid"] for m in trace["traceEvents"]
+                  if m.get("ph") == "M" and m.get("name") == "thread_name"
+                  and m["args"]["name"] == "serve"}
+    assert len(serve_tids) == 1
+    assert {e["tid"] for e in events} == serve_tids
+
+
+# ---------------------------------------------------- health stream
+def test_serve_health_stream_full_lifecycle(rng, tmp_path):
+    path = str(tmp_path / "svc.serve.health.jsonl")
+    bst, X = _train(rng)
+    with ServeSession(max_batch=32, max_delay_ms=1.0, health_out=path,
+                      health_window_s=0.2) as sess:
+        mid = sess.load(bst)
+        futs = [sess.submit(mid, X[i:i + 2]) for i in range(0, 60, 2)]
+        for f in futs:
+            f.result(timeout=30)
+    recs = _records(path)
+    kinds = [r["kind"] for r in recs]
+    assert kinds[0] == "serve_start"
+    assert kinds[-1] == "serve_summary"
+    assert "serve_admit" in kinds
+    wins = [r for r in recs if r["kind"] == "serve_window"]
+    assert sum(w.get("requests", 0) for w in wins) == 30
+    assert sum(w.get("rows", 0) for w in wins) == 60
+    summary = recs[-1]
+    assert summary["requests"] == 30
+    assert summary["rows"] == 60
+    assert summary["pending_failed"] == 0
+    live = [w for w in wins if w.get("requests")]
+    assert live, "no window captured the traffic"
+    saw_stages = set()
+    for w in live:
+        assert 0 <= w["p50_s"] <= w["p99_s"]
+        assert math.isfinite(w["p99_s"])
+        for name, d in w.get("stages", {}).items():
+            saw_stages.add(name)
+            assert 0 <= d["p50_s"] <= d["p99_s"], name
+    assert saw_stages == {"t_queue", "t_coalesce", "t_dispatch",
+                          "t_reply"}
+    for w in live:
+        if w.get("fill_ratio") is not None:
+            assert 0 < w["fill_ratio"] <= 1.0
+
+
+def test_close_emits_summary_and_counter(rng, tmp_path):
+    path = str(tmp_path / "close.serve.health.jsonl")
+    bst, X = _train(rng)
+    sess = ServeSession(max_batch=16, health_out=path,
+                        health_window_s=60.0)
+    mid = sess.load(bst)
+    sess.predict(mid, X[:2])
+    sess.close()
+    sess.close()                                     # idempotent
+    assert TELEMETRY.stats()["counters"]["serve/closed"] == 1
+    recs = _records(path)
+    assert [r["kind"] for r in recs].count("serve_summary") == 1
+    assert recs[-1]["kind"] == "serve_summary"
+    assert recs[-1]["requests"] == 1
+
+
+def test_serve_fault_recorded(rng, tmp_path):
+    path = str(tmp_path / "fault.serve.health.jsonl")
+    bst, X = _train(rng)
+    with ServeSession(max_batch=16, health_out=path,
+                      health_window_s=60.0) as sess:
+        mid = sess.load(bst)
+        # wrong feature count passes submit but fails in the worker
+        bad = sess.submit(mid, np.zeros((1, 3), dtype=np.float32))
+        with pytest.raises(Exception):
+            bad.result(timeout=30)
+    assert TELEMETRY.stats()["counters"]["serve/errors"] == 1
+    faults = [r for r in _records(path) if r["kind"] == "serve_fault"]
+    assert len(faults) == 1
+    assert "features" in faults[0]["error"]
+    assert _records(path)[-1]["faults"] == 1         # summary total
+
+
+def test_env_override_wins(rng, tmp_path, monkeypatch):
+    env_path = str(tmp_path / "env.serve.health.jsonl")
+    kw_path = str(tmp_path / "kw.serve.health.jsonl")
+    monkeypatch.setenv(SERVE_HEALTH_ENV, env_path)
+    assert resolve_serve_health_path(override=kw_path) == env_path
+    bst, X = _train(rng, rounds=4)
+    with ServeSession(max_batch=16, health_out=kw_path) as sess:
+        mid = sess.load(bst)
+        sess.predict(mid, X[:1])
+    assert os.path.exists(env_path)
+    assert not os.path.exists(kw_path)
+    monkeypatch.delenv(SERVE_HEALTH_ENV)
+    assert resolve_serve_health_path(override=kw_path) == kw_path
+    assert resolve_serve_health_path() == ""
+
+
+def test_training_byte_identical_with_serve_obs(rng, tmp_path,
+                                                monkeypatch):
+    """The serve plane must not touch the training path: same seed,
+    same data -> byte-identical model with the serve stream enabled."""
+    X = rng.normal(size=(300, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 7,
+              "deterministic": True}
+
+    def fit():
+        ds = lgb.Dataset(X.copy(), y.copy())
+        return lgb.train(params, ds,
+                         num_boost_round=6).model_to_string()
+
+    base = fit()
+    monkeypatch.setenv(SERVE_HEALTH_ENV,
+                       str(tmp_path / "t.serve.health.jsonl"))
+    with_env = fit()
+    assert with_env == base
+    # and with a live serve session next to the training run
+    bst, Xs = _train(rng, rounds=4)
+    with ServeSession(max_batch=16) as sess:
+        mid = sess.load(bst)
+        sess.predict(mid, Xs[:1])
+        during = fit()
+    assert during == base
+
+
+# --------------------------------------------------- open-loop loadgen
+def test_loadgen_coalesces_at_high_rate_not_at_trickle(rng, tmp_path):
+    bst, X = _train(rng)
+    hot = loadgen.run_cell(
+        bst, X, "t", rate=250.0, delay_ms=25.0, duration_s=0.9,
+        max_batch=64, window_s=0.3,
+        health_path=str(tmp_path / "hot.serve.health.jsonl"))
+    trickle = loadgen.run_cell(
+        bst, X, "t", rate=12.0, delay_ms=0.0, duration_s=0.8,
+        max_batch=64, window_s=0.3,
+        health_path=str(tmp_path / "trk.serve.health.jsonl"))
+    for rec in (hot, trickle):
+        assert rec["errors"] == 0
+        assert rec["completed"] == rec["requests"] > 0
+        assert rec["quality_ok"], "reply diverged under coalescing"
+        assert 0 <= rec["p50_s"] <= rec["p99_s"]
+    assert hot["rows_per_batch"] > 1.5, \
+        f"coalescing never engaged: {hot['rows_per_batch']}"
+    assert trickle["rows_per_batch"] < 1.5
+    # health streams: counts match, kinds present, quantiles ordered
+    assert loadgen._check_health_stream(
+        str(tmp_path / "hot.serve.health.jsonl"), hot["completed"]) == []
+    assert loadgen._check_health_stream(
+        str(tmp_path / "trk.serve.health.jsonl"),
+        trickle["completed"]) == []
+
+
+def test_loadgen_merge_bench_serve(tmp_path):
+    path = str(tmp_path / "BENCH_SERVE.json")
+    with open(path, "w") as fh:
+        json.dump([{"config": "serve-small-b16-d0", "p99_s": 0.01},
+                   {"config": "loadgen-small-r50-d0", "p99_s": 0.9}], fh)
+    loadgen.merge_bench_serve(
+        [{"config": "loadgen-small-r50-d0", "p99_s": 0.1}], path=path)
+    merged = json.load(open(path))
+    assert {r["config"] for r in merged} == {
+        "serve-small-b16-d0", "loadgen-small-r50-d0"}
+    assert [r for r in merged
+            if r["config"] == "loadgen-small-r50-d0"][0]["p99_s"] == 0.1
+
+
+# ------------------------------------------------------ serve_monitor
+def test_serve_monitor_render_and_follow(rng, tmp_path, capsys):
+    path = str(tmp_path / "mon.serve.health.jsonl")
+    bst, X = _train(rng)
+    with ServeSession(max_batch=16, max_delay_ms=0.0, health_out=path,
+                      health_window_s=0.2) as sess:
+        mid = sess.load(bst)
+        for i in range(8):
+            sess.predict(mid, X[i:i + 1])
+    assert serve_monitor.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "[closed]" in out
+    assert "summary: 8 requests" in out
+    assert "qps" in out
+    # follow on a finished stream returns immediately with 0
+    assert serve_monitor.follow(path, interval=0.05, timeout=10,
+                                out=sys.stderr) == 0
+    assert serve_monitor.main([str(tmp_path / "nope.jsonl")]) == 2

@@ -280,6 +280,34 @@ def self_test():
         ("serve first record passes", not evaluate(
             [{"config": "serve-new", "qps": 5.0, "p99_s": 0.1}])[0]),
     ]
+    # open-loop loadgen records (tools/loadgen.py): same p99 gate, but
+    # the record shape carries rows_per_batch instead of bucket fields
+    lhist = [{"config": "loadgen-small-r300-d5", "qps": 295.0 + i,
+              "rows_per_batch": 6.0 + 0.1 * i, "p50_s": 0.004,
+              "p99_s": 0.012 + 0.0002 * i, "quality_ok": True}
+             for i in range(4)]
+
+    def lverdict(newest):
+        failures, _ = evaluate(lhist + [newest])
+        return bool(failures)
+
+    checks += [
+        ("open-loop steady p99 passes", not lverdict(
+            {"config": "loadgen-small-r300-d5", "qps": 297.0,
+             "rows_per_batch": 6.2, "p50_s": 0.004, "p99_s": 0.0125,
+             "quality_ok": True})),
+        ("open-loop p99 regression fails", lverdict(
+            {"config": "loadgen-small-r300-d5", "qps": 297.0,
+             "rows_per_batch": 6.2, "p50_s": 0.004, "p99_s": 0.020,
+             "quality_ok": True})),
+        ("open-loop quality flip fails", lverdict(
+            {"config": "loadgen-small-r300-d5", "qps": 297.0,
+             "rows_per_batch": 6.2, "p50_s": 0.004, "p99_s": 0.0125,
+             "quality_ok": False})),
+        ("open-loop first record passes", not evaluate(
+            [{"config": "loadgen-new-r50-d0", "qps": 49.0,
+              "p99_s": 0.01}])[0]),
+    ]
     bad = [name for name, ok in checks if not ok]
     for name, ok in checks:
         print(f"bench_gate self-test: {'ok' if ok else 'FAIL'} {name}")

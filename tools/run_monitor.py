@@ -13,9 +13,18 @@ multi-hour stream is not re-parsed every tick), re-rendering every
 ``--interval`` seconds until the ``summary`` record lands (exit 0) or
 ``--timeout`` seconds pass without one (exit 3).
 
+``--fleet dir/`` merges every ``*.jsonl`` stream in a directory — the
+per-rank files of a multi-host run (cli.py stamps ``rank``/``world``
+into each stream's start meta) — into one view: per-rank progress and
+pace, an interleaved tail of the newest records across ranks, and a
+LOUD stall flag when one rank's last iteration lags the fleet median
+(the signature of a wedged collective: the stuck rank stops appending
+while the others time out at the barrier behind it).
+
 Usage:
   python tools/run_monitor.py run.health.jsonl
   python tools/run_monitor.py run.health.jsonl --follow --interval 2
+  python tools/run_monitor.py --fleet rundir/ [--follow]
 """
 
 import argparse
@@ -23,6 +32,11 @@ import json
 import os
 import sys
 import time
+from collections import deque
+
+# a rank whose newest iteration trails the fleet median by at least
+# this many iterations (with no summary record) is flagged as stalled
+STALL_LAG_ITERS = 2
 
 
 class StreamState:
@@ -39,6 +53,7 @@ class StreamState:
         self.faults = []
         self.summary = None
         self.records = 0
+        self.recent = deque(maxlen=64)  # (t, kind, iter) tail for --fleet
         self._tail = b""
 
     def feed(self, data: bytes) -> None:
@@ -55,6 +70,7 @@ class StreamState:
                 continue
             self.records += 1
             kind = rec.get("kind")
+            self.recent.append((rec.get("t"), kind, rec.get("iter")))
             if kind == "start":
                 self.start = rec
             elif kind == "resume":
@@ -207,6 +223,127 @@ def render(state: StreamState, path: str) -> str:
     return "\n".join(lines)
 
 
+def _rank_label(name: str, state: StreamState) -> str:
+    """rankR/W from the stream's start meta (multi-host runs stamp
+    both); the filename is the fallback for streams without it."""
+    meta = state.start or {}
+    r, w = meta.get("rank"), meta.get("world")
+    if r is not None:
+        return f"rank{r}/{w}" if w else f"rank{r}"
+    return os.path.basename(name)
+
+
+def load_fleet(dirpath):
+    """{path: StreamState} over every *.jsonl stream in a directory."""
+    states = {}
+    for name in sorted(os.listdir(dirpath)):
+        if not name.endswith(".jsonl"):
+            continue
+        path = os.path.join(dirpath, name)
+        state = StreamState()
+        try:
+            with open(path, "rb") as fh:
+                state.feed(fh.read())
+        except OSError:
+            continue
+        states[path] = state
+    return states
+
+
+def _fleet_median_iter(states):
+    last = sorted(max(s.iters) for s in states.values() if s.iters)
+    if not last:
+        return None
+    mid = len(last) // 2
+    return (last[mid] if len(last) % 2
+            else (last[mid - 1] + last[mid]) // 2)
+
+
+def fleet_stalled(states):
+    """[(label, last_iter, median)] for every unfinished rank whose
+    newest iteration lags the fleet median by >= STALL_LAG_ITERS."""
+    median = _fleet_median_iter(states)
+    if median is None:
+        return []
+    out = []
+    for path, state in states.items():
+        if state.summary is not None:
+            continue
+        last = max(state.iters) if state.iters else -1
+        if median - last >= STALL_LAG_ITERS:
+            out.append((_rank_label(path, state), last, median))
+    return out
+
+
+def render_fleet(states, dirpath, tail=12):
+    """The merged view: one pace line per rank, the interleaved tail of
+    the newest records across every stream, and the stall flags."""
+    lines = [f"fleet {dirpath}: {len(states)} stream(s)"]
+    if not states:
+        lines.append("  no *.jsonl streams found")
+        return "\n".join(lines)
+    merged = []
+    for path, state in states.items():
+        label = _rank_label(path, state)
+        if state.summary is not None:
+            status = ("aborted" if state.summary.get("aborted")
+                      else "finished")
+        elif state.iters or state.start:
+            status = "running"
+        else:
+            status = "empty"
+        line = f"  {label}: [{status}] {state.records} records"
+        if state.iters:
+            first, last = min(state.iters), max(state.iters)
+            line += f", iter {last}"
+            t0 = state.iters[first].get("t")
+            t1 = state.iters[last].get("t")
+            if (t0 is not None and t1 is not None and last > first
+                    and t1 > t0):
+                line += f", {(last - first) / (t1 - t0):.2f} it/s"
+        if state.faults:
+            line += f", {len(state.faults)} fault(s)"
+        lines.append(line)
+        for t, kind, it in state.recent:
+            merged.append((t if t is not None else 0.0, label, kind, it))
+    stalls = fleet_stalled(states)
+    for label, last, median in stalls:
+        lines.append(
+            f"  !! STALL {label}: last iteration {last} lags the fleet "
+            f"median {median} by {median - last} — rank wedged or its "
+            f"stream stopped (others will hit the collective timeout)")
+    merged.sort(key=lambda r: r[0])
+    if merged:
+        lines.append(f"  tail ({min(tail, len(merged))} newest across "
+                     f"ranks):")
+        for t, label, kind, it in merged[-tail:]:
+            at = f"@{it}" if it is not None else ""
+            lines.append(f"    [{t:9.3f}s] {label} {kind}{at}")
+    return "\n".join(lines)
+
+
+def follow_fleet(dirpath, interval, timeout, out=sys.stdout):
+    """Re-render the merged view until every stream has its summary
+    (exit 0), stall-flagging laggards along the way; exit 2 when the
+    directory never yields a stream, 3 on timeout."""
+    deadline = time.monotonic() + timeout if timeout > 0 else None
+    while True:
+        states = load_fleet(dirpath) if os.path.isdir(dirpath) else {}
+        if states:
+            out.write(render_fleet(states, dirpath) + "\n")
+            out.flush()
+            if all(s.summary is not None for s in states.values()):
+                return 0
+        if deadline is not None and time.monotonic() >= deadline:
+            if not states:
+                out.write(f"run_monitor: no streams under {dirpath}\n")
+                return 2
+            out.write("run_monitor: timeout waiting for every rank's "
+                      "summary record\n")
+            return 3
+        time.sleep(interval)
+
+
 def follow(path, interval, timeout, out=sys.stdout):
     """Tail the stream until its summary record lands.  Returns 0 on a
     completed stream, 2 when the file never appears, 3 on timeout."""
@@ -245,7 +382,13 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         description="summarize a lightgbm_tpu run-health JSONL stream, "
                     "live or post-hoc")
-    ap.add_argument("path")
+    ap.add_argument("path",
+                    help="health JSONL stream, or a directory of "
+                         "per-rank streams with --fleet")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat PATH as a directory of per-rank "
+                         "streams; merge them into one view with "
+                         "per-rank pace and stall flags")
     ap.add_argument("--follow", action="store_true",
                     help="keep tailing until the summary record lands")
     ap.add_argument("--interval", type=float, default=2.0,
@@ -254,6 +397,15 @@ def main(argv=None):
                     help="--follow gives up after this many seconds "
                          "(0 = wait forever)")
     args = ap.parse_args(argv)
+    if args.fleet:
+        if args.follow:
+            return follow_fleet(args.path, max(0.05, args.interval),
+                                args.timeout)
+        if not os.path.isdir(args.path):
+            print(f"run_monitor: --fleet needs a directory: {args.path}")
+            return 2
+        print(render_fleet(load_fleet(args.path), args.path))
+        return 0
     if args.follow:
         return follow(args.path, max(0.05, args.interval), args.timeout)
     if not os.path.exists(args.path):

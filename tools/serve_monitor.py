@@ -1,0 +1,210 @@
+"""Live/post-hoc terminal summary of a serve-health JSONL stream.
+
+The stream is the append-only file a ServeSession writes for
+``serve_health_out=`` / ``LIGHTGBM_TPU_SERVE_HEALTH_JSONL`` (see
+lightgbm_tpu/serve/health.py, schema ``lightgbm_tpu.health/v1``):
+``serve_start``, periodic ``serve_window`` records (QPS, stage and
+end-to-end p50/p99, coalesce fill ratio, pad ratio, queue depth),
+``serve_admit`` decisions, ``serve_fault`` events, and a terminal
+``serve_summary``.
+
+One-shot mode renders the stream as it stands — serving OR closed.
+``--follow`` tails the file exactly like run_monitor.py (byte-offset
+incremental reads), re-rendering every ``--interval`` seconds until the
+``serve_summary`` record lands (exit 0) or ``--timeout`` seconds pass
+without one (exit 3).
+
+Usage:
+  python tools/serve_monitor.py svc.serve.health.jsonl
+  python tools/serve_monitor.py svc.serve.health.jsonl --follow
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+class ServeStreamState:
+    """Folded view of a serve health stream; feed() accepts raw JSONL
+    bytes incrementally and tolerates a torn trailing line."""
+
+    WINDOW_KEEP = 12
+
+    def __init__(self):
+        self.start = None
+        self.windows = []               # newest WINDOW_KEEP kept
+        self.admits = []
+        self.faults = []
+        self.summary = None
+        self.records = 0
+        self.total_requests = 0
+        self.total_rows = 0
+        self._tail = b""
+
+    def feed(self, data: bytes) -> None:
+        buf = self._tail + data
+        lines = buf.split(b"\n")
+        self._tail = lines.pop()
+        for raw in lines:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            self.records += 1
+            kind = rec.get("kind")
+            if kind == "serve_start":
+                self.start = rec
+            elif kind == "serve_window":
+                self.total_requests += rec.get("requests", 0)
+                self.total_rows += rec.get("rows", 0)
+                self.windows.append(rec)
+                del self.windows[: -self.WINDOW_KEEP]
+            elif kind == "serve_admit":
+                self.admits.append(rec)
+            elif kind == "serve_fault":
+                self.faults.append(rec)
+            elif kind == "serve_summary":
+                self.summary = rec
+
+
+def _ms(v):
+    return f"{v * 1e3:.2f}ms" if isinstance(v, (int, float)) else "?"
+
+
+def render(state: ServeStreamState, path: str) -> str:
+    lines = []
+    if state.summary is not None:
+        status = "closed"
+    elif state.start is not None:
+        status = "serving"
+    else:
+        status = "empty"
+    schema = (state.start or {}).get("schema", "?")
+    lines.append(f"serve-health {os.path.basename(path)} [{status}] "
+                 f"schema={schema} records={state.records}")
+    if state.start:
+        lines.append(f"  session: pid={state.start.get('pid', '?')} "
+                     f"max_batch={state.start.get('max_batch', '?')} "
+                     f"max_delay_ms={state.start.get('max_delay_ms', '?')}"
+                     f" window_s={state.start.get('window_s', '?')}")
+    live = [w for w in state.windows if w.get("requests")]
+    if live:
+        w = live[-1]
+        line = (f"  window@{w.get('t', 0):.1f}s: {w.get('qps', 0):g} qps"
+                f" ({w.get('requests', 0)} req, {w.get('rows', 0)} rows)"
+                f" e2e p50={_ms(w.get('p50_s'))} p99={_ms(w.get('p99_s'))}")
+        lines.append(line)
+        parts = []
+        if w.get("rows_per_batch") is not None:
+            parts.append(f"rows/batch={w['rows_per_batch']:g}")
+        if w.get("fill_ratio") is not None:
+            parts.append(f"fill={w['fill_ratio']:.0%}")
+        if w.get("pad_ratio") is not None:
+            parts.append(f"pad={w['pad_ratio']:.0%}")
+        if w.get("queue_depth") is not None:
+            parts.append(f"depth={w['queue_depth']}")
+        if w.get("coalesce_slack_ms") is not None:
+            parts.append(f"slack={w['coalesce_slack_ms']:g}ms")
+        if parts:
+            lines.append("  coalescing: " + " ".join(parts))
+        stages = w.get("stages") or {}
+        if stages:
+            lines.append("  stages: " + " ".join(
+                f"{name}[{_ms(d.get('p50_s'))}/{_ms(d.get('p99_s'))}]"
+                for name, d in stages.items()))
+        if w.get("models"):
+            lines.append("  models: " + " ".join(
+                f"{m}={r}" for m, r in sorted(w["models"].items())))
+    elif state.windows:
+        lines.append(f"  idle: last {len(state.windows)} window(s) "
+                     f"served no requests")
+    else:
+        lines.append("  no windows yet")
+    if state.total_requests:
+        lines.append(f"  lifetime: {state.total_requests} requests / "
+                     f"{state.total_rows} rows across the stream")
+    if state.admits:
+        last = state.admits[-1].get("detail", "")
+        lines.append(f"  admissions: {len(state.admits)}, last: "
+                     f"{last[:90]}")
+    if state.faults:
+        lines.append(f"  FAULTS: {len(state.faults)}, last: "
+                     f"{state.faults[-1].get('error', '?')}")
+    if state.summary is not None:
+        s = state.summary
+        lines.append(f"  summary: {s.get('requests', '?')} requests, "
+                     f"{s.get('batches', '?')} batches, "
+                     f"{s.get('faults', 0)} fault(s), "
+                     f"{s.get('pending_failed', 0)} failed at close")
+    return "\n".join(lines)
+
+
+def follow(path, interval, timeout, out=sys.stdout):
+    """Tail the stream until serve_summary lands.  Returns 0 on a
+    closed stream, 2 when the file never appears, 3 on timeout."""
+    state = ServeStreamState()
+    offset = 0
+    deadline = time.monotonic() + timeout if timeout > 0 else None
+    waited_for_file = False
+    while True:
+        if os.path.exists(path):
+            size = os.path.getsize(path)
+            if size < offset:            # truncated (fresh session)
+                state, offset = ServeStreamState(), 0
+            if size > offset:
+                with open(path, "rb") as fh:
+                    fh.seek(offset)
+                    data = fh.read()
+                offset += len(data)
+                state.feed(data)
+                out.write(render(state, path) + "\n")
+                out.flush()
+        else:
+            waited_for_file = True
+        if state.summary is not None:
+            return 0
+        if deadline is not None and time.monotonic() >= deadline:
+            if waited_for_file and state.records == 0:
+                out.write(f"serve_monitor: {path} never appeared\n")
+                return 2
+            out.write("serve_monitor: timeout waiting for the "
+                      "serve_summary record (session still alive?)\n")
+            return 3
+        time.sleep(interval)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="summarize a lightgbm_tpu serve-health JSONL "
+                    "stream, live or post-hoc")
+    ap.add_argument("path")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep tailing until serve_summary lands")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll period in seconds (default 2)")
+    ap.add_argument("--timeout", type=float, default=0.0,
+                    help="--follow gives up after this many seconds "
+                         "(0 = wait forever)")
+    args = ap.parse_args(argv)
+    if args.follow:
+        return follow(args.path, max(0.05, args.interval), args.timeout)
+    if not os.path.exists(args.path):
+        print(f"serve_monitor: no such stream: {args.path}")
+        return 2
+    state = ServeStreamState()
+    with open(args.path, "rb") as fh:
+        state.feed(fh.read())
+    print(render(state, args.path))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)

@@ -4,7 +4,7 @@ Input: a metrics dict as produced by ``TELEMETRY.metrics_blob()`` /
 ``Booster.get_stats()`` — the blob the CLI writes for ``metrics_out=``,
 ``bench.py`` / ``bench_suite.py`` embed under ``"metrics"``, and
 ``engine.train`` attaches as ``booster.train_stats``.  The current
-``lightgbm_tpu.metrics/v4`` schema and the older v3/v2/v1 blobs are all
+``lightgbm_tpu.metrics/v5`` schema and the older v4/v3/v2/v1 blobs are all
 accepted: every section is optional and renders as ``n/a`` when absent.
 
 Usage:

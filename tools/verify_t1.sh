@@ -16,6 +16,12 @@
 #                                             through the compiled
 #                                             micro-batching queue and
 #                                             bit-checks vs Booster.predict;
+#                                             then a ~2s open-loop loadgen
+#                                             burst asserting the serve
+#                                             health stream parses, the
+#                                             coalescing window engages
+#                                             under load, and every reply
+#                                             stays bit-identical;
 #                                             writes no artifacts)
 #        bash tools/verify_t1.sh --with-kernel-checks (also run every
 #                                             kernel variant self-check —
@@ -33,6 +39,7 @@ if [ "$1" = "--with-gate" ]; then
 fi
 if [ "$1" = "--serve-smoke" ]; then
     timeout -k 10 330 env BENCH_SKIP_TPU=1 python tools/bench_serve.py --smoke || exit 1
+    timeout -k 10 330 env JAX_PLATFORMS=cpu python tools/loadgen.py --smoke || exit 1
 fi
 if [ "$1" = "--with-kernel-checks" ]; then
     timeout -k 10 330 env JAX_PLATFORMS=cpu python -c 'import sys; from lightgbm_tpu.ops.pallas_histogram import run_kernel_self_checks; sys.exit(run_kernel_self_checks())' || exit 1
