@@ -12,13 +12,14 @@ from . import callback
 from .basic import Booster, Dataset
 from .config import Config
 from .core.dataset import TpuDataset
-from .engine import CVBooster, cv, train
+from .engine import CVBooster, cv, estimate_working_set, train
 from .utils.log import LightGBMError, register_log_callback, set_verbosity
 
 __version__ = "0.1.0"
 
 __all__ = ["Booster", "Dataset", "Config", "TpuDataset", "CVBooster", "cv",
-           "train", "callback", "LightGBMError", "register_log_callback",
+           "train", "estimate_working_set", "callback", "LightGBMError",
+           "register_log_callback",
            "set_verbosity", "__version__"]
 
 

@@ -474,6 +474,20 @@ class Booster:
     def num_model_per_iteration(self) -> int:
         return self.gbdt.num_tree_per_iteration
 
+    def estimate_working_set(self) -> int:
+        """Estimated device working set of training this booster, in
+        bytes — the exact resolved-layout number the internal admission
+        checks (``data_in_hbm=auto``, the sched plane's HBM gate) use
+        for this run.  For a pre-construction estimate from a config and
+        a ``(num_data, num_columns)`` shape alone, use module-level
+        :func:`lightgbm_tpu.estimate_working_set`."""
+        if self.train_set is None:
+            raise LightGBMError(
+                "estimate_working_set needs a training booster; for a "
+                "model-only handle call lightgbm_tpu."
+                "estimate_working_set(config, data_shape) instead")
+        return self.gbdt._estimate_working_set()
+
     # ---------------------------------------------------------------- eval
     def _feval_preds(self, score) -> np.ndarray:
         """What feval receives: objective-TRANSFORMED predictions (the

@@ -38,7 +38,7 @@ def load_parameters(argv: List[str]) -> Dict[str, str]:
             elif arg.strip().lower() in ("train", "training", "predict",
                                          "prediction", "test",
                                          "convert_model", "refit",
-                                         "refit_tree"):
+                                         "refit_tree", "sched"):
                 # subcommand convenience: `... predict data=...` must
                 # not silently fall through to the default task=train
                 arg = f"task={arg.strip()}"
@@ -67,7 +67,10 @@ class Application:
 
     def run(self) -> None:
         task = str(self.config.task).strip().lower()
-        if task in ("train", "training"):
+        if task == "sched" or (task in ("train", "training")
+                               and str(self.config.sched).strip()):
+            self.sched()
+        elif task in ("train", "training"):
             self.train()
         elif task in ("predict", "prediction", "test"):
             self.predict()
@@ -362,6 +365,27 @@ class Application:
             raise SystemExit(distributed.PREEMPT_EXIT_CODE)
         self._save_model(booster, cfg.output_model)
         log_info(f"Finished training, saved model to {cfg.output_model}")
+
+    # ------------------------------------------------------------ scheduling
+    def sched(self) -> None:
+        """task=sched / sched=SPEC: run the spec file's jobs through
+        the multi-tenant scheduler (docs/SCHEDULING.md).  CLI key=value
+        arguments override the spec's scheduler knobs."""
+        spec_path = str(self.config.sched).strip()
+        if not spec_path:
+            log_fatal("No job spec, set sched=jobs.spec for task=sched")
+        from .sched import run_spec_file
+        overrides = {k: v for k, v in self.params.items()
+                     if k not in ("config", "config_file", "task",
+                                  "sched")}
+        summary = run_spec_file(spec_path, overrides=overrides)
+        log_info(
+            f"Scheduler finished: {summary['done']} job(s) done, "
+            f"{summary['failed']} failed, {summary['slices']} slice(s), "
+            f"policy={summary['policy']}, "
+            f"cross_job_cache_hits={summary['cross_job_cache_hits']}")
+        if summary["failed"] or summary.get("rejected"):
+            raise SystemExit(1)
 
     def _resume(self, booster, snapshot_file: str) -> int:
         """Load the newest snapshot's trees + exact sidecar state; the

@@ -303,6 +303,30 @@ _PARAMS: Dict[str, _P] = {
     # is alive; idle windows are still written so a wedged server is
     # distinguishable from an idle one
     "serve_health_window_s": _P(5.0),
+    # multi-tenant training scheduler (lightgbm_tpu/sched,
+    # docs/SCHEDULING.md): path of a job spec file; a non-empty value
+    # (or task=sched) runs the spec's jobs cooperatively time-sliced
+    # on this process's device set instead of one training run
+    "sched": _P(""),
+    # chunk dispatches one job runs per scheduler time slice before the
+    # next tenant is considered; the chunk boundary is the preemption
+    # point, so a larger quantum trades fairness granularity for fewer
+    # scheduler round-trips
+    "sched_quantum_chunks": _P(4),
+    # slice-picking policy: "round_robin" rotates tenants per quantum;
+    # "fair" (alias fair_share) is the deficit policy — always run the
+    # runnable job with the least accumulated device-seconds, weighted
+    # by its share weight (measured via device_timing when on, slice
+    # wall otherwise)
+    "sched_policy": _P("round_robin"),
+    # concurrently RESIDENT jobs; submissions beyond it queue (FIFO)
+    # until a running job finishes
+    "sched_max_jobs": _P(8),
+    # scheduler health JSONL (sched_start/sched_admit/sched_slice/
+    # sched_preempt_job/job_done/sched_summary records) through the
+    # same never-torn O_APPEND writer training uses; tail it with
+    # tools/sched_monitor.py.  "" = no stream
+    "sched_health_out": _P(""),
 }
 
 # runtime-only knobs excluded from a saved model's ``parameters:``
@@ -319,7 +343,10 @@ RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection",
                                  "serve_max_delay_ms",
                                  "serve_queue_timeout_s",
                                  "serve_health_out",
-                                 "serve_health_window_s"])
+                                 "serve_health_window_s",
+                                 "sched", "sched_quantum_chunks",
+                                 "sched_policy", "sched_max_jobs",
+                                 "sched_health_out"])
 
 # alias -> canonical name
 ALIAS_TABLE: Dict[str, str] = {}
@@ -532,6 +559,18 @@ class Config:
             raise ValueError("serve_queue_timeout_s must be > 0")
         if self.serve_health_window_s <= 0:
             raise ValueError("serve_health_window_s must be > 0")
+        sp = str(self.sched_policy).strip().lower() or "round_robin"
+        sp = {"rr": "round_robin", "fair_share": "fair",
+              "deficit": "fair"}.get(sp, sp)
+        if sp not in ("round_robin", "fair"):
+            raise ValueError(
+                "sched_policy must be one of round_robin, fair "
+                f"(got {self.sched_policy!r})")
+        self.sched_policy = sp
+        if self.sched_quantum_chunks < 1:
+            raise ValueError("sched_quantum_chunks must be >= 1")
+        if self.sched_max_jobs < 1:
+            raise ValueError("sched_max_jobs must be >= 1")
 
     # -- accessors --
     def to_dict(self) -> Dict[str, Any]:

@@ -19,6 +19,16 @@ from .config import resolve_alias
 from .utils.log import LightGBMError, log_info, log_warning
 
 
+def estimate_working_set(params, data_shape, *, num_bins=None) -> int:
+    """Estimated training working set in bytes for ``params`` (a dict
+    or Config) over a ``(num_data, num_columns)`` dataset, without
+    constructing a dataset or booster — the number the admission checks
+    (``data_in_hbm=auto``, the sched plane's HBM gate, the serve
+    registry) budget against.  See docs/TUNING.md."""
+    from .models.gbdt import estimate_working_set as _estimate
+    return _estimate(params, data_shape, num_bins=num_bins)
+
+
 def _resolve_num_boost_round(params: Dict, num_boost_round: int) -> int:
     for k in list(params):
         if resolve_alias(k) == "num_iterations":

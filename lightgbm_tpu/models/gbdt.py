@@ -315,6 +315,71 @@ def _is_oom_error(e: BaseException) -> bool:
 _ADMIT_FRACTION = 0.9
 
 
+def working_set_bytes(num_data: int, num_columns: int, *,
+                      num_tree_per_iteration: int = 1,
+                      layout: Tuple[str, int, bool] = ("rows", 0, False),
+                      itemsize: int = 1) -> int:
+    """The working-set arithmetic shared by the internal pre-dispatch
+    admission check (``GBDT._estimate_working_set``) and the public
+    :func:`estimate_working_set`: the bin matrix in its device layout
+    (``("T", row_multiple, packed4)`` pads rows to whole blocks and
+    packs two sub-16-bin columns per byte; ``("rows", 0, False)`` is the
+    plain row-major matrix), the f32 boosting state (scores, grads,
+    hessians per class, bag weights, leaf ids), plus the largest CostJit
+    ``memory_analysis`` working set already on record."""
+    num_data, f = int(num_data), int(num_columns)
+    kind, rm, packed4 = layout
+    if kind == "T":
+        npad_rows = num_data + ((-num_data) % max(1, int(rm)))
+        mat_bytes = (-(-f // 2) * npad_rows if packed4
+                     else f * npad_rows * int(itemsize))
+    else:
+        mat_bytes = num_data * f * int(itemsize)
+    state_bytes = 4 * num_data * (3 * int(num_tree_per_iteration) + 2)
+    return mat_bytes + state_bytes + TELEMETRY.cost_working_set()
+
+
+def estimate_working_set(config, data_shape, *,
+                         num_bins: Optional[int] = None) -> int:
+    """Estimated training working set in bytes for ``config`` over a
+    ``(num_data, num_columns)`` dataset — BEFORE constructing a dataset
+    or booster, so admission control (serve registry, the sched plane's
+    HBM gate, ``data_in_hbm=auto``) and users share one number.
+
+    ``config`` is a :class:`~lightgbm_tpu.config.Config` or a params
+    dict.  ``num_bins`` defaults to ``max_bin`` (the post-binning upper
+    bound; a constructed dataset may resolve fewer bins and a slightly
+    smaller matrix).  The single-device bin layout is resolved the same
+    way training resolves it: the pallas kernel's feature-major padded/
+    packed layout when the shape supports it, the row-major matrix
+    otherwise.  A warm process adds its compiled programs' recorded
+    temp+argument+output bytes; a cold one contributes 0.  See
+    docs/TUNING.md (working-set budgeting)."""
+    if not isinstance(config, Config):
+        config = Config.from_params(dict(config))
+    num_data, num_columns = (int(x) for x in tuple(data_shape))
+    if num_data < 1 or num_columns < 1:
+        raise LightGBMError(
+            f"estimate_working_set needs a (num_data, num_columns) "
+            f"shape with both >= 1, got {data_shape!r}")
+    from ..objective import create_objective
+    objective = create_objective(config)
+    C = int(getattr(objective, "num_tree_per_iteration", 1) or 1)
+    bins = int(num_bins) if num_bins else max(2, int(config.max_bin))
+    layout: Tuple[str, int, bool] = ("rows", 0, False)
+    choice = str(config.tpu_histogram_backend).strip().lower()
+    if (choice != "onehot" and not config.gpu_use_dp
+            and not config.tpu_double_precision):
+        from ..ops.pallas_histogram import pick_block_rows, supported
+        nb2 = _round_up_pow2(max(bins, 2))
+        if supported(num_columns, nb2, np.dtype(np.uint8)):
+            rb = (int(config.tpu_row_chunk) if config.tpu_row_chunk > 0
+                  else pick_block_rows(num_columns, bins, num_data))
+            layout = ("T", rb, bins <= 16)
+    return working_set_bytes(num_data, num_columns,
+                             num_tree_per_iteration=C, layout=layout)
+
+
 class GBDT:
     """Gradient Boosted Decision Trees (boosting='gbdt')."""
 
@@ -707,18 +772,12 @@ class GBDT:
         plus the largest CostJit ``memory_analysis`` working set already
         on record (a resumed/warm process knows its compiled programs'
         temp+argument+output bytes; a cold one contributes 0)."""
-        kind, rm, packed4 = self._bins_layout
         ts = self.train_set
-        if kind == "T":
-            npad_rows = self.num_data + ((-self.num_data) % max(1, rm))
-            f = ts.num_columns
-            mat_bytes = (-(-f // 2) * npad_rows if packed4
-                         else f * npad_rows * ts.binned.dtype.itemsize)
-        else:
-            mat_bytes = int(ts.binned.nbytes)
-        state_bytes = 4 * self.num_data * (3 * self.num_tree_per_iteration
-                                           + 2)
-        return mat_bytes + state_bytes + TELEMETRY.cost_working_set()
+        return working_set_bytes(
+            self.num_data, ts.num_columns,
+            num_tree_per_iteration=self.num_tree_per_iteration,
+            layout=self._bins_layout,
+            itemsize=ts.binned.dtype.itemsize)
 
     def _resolve_data_tier(self, parallel: bool) -> str:
         """data_in_hbm=auto|resident|spill -> this run's starting tier.

@@ -1,0 +1,18 @@
+"""Multi-tenant training-as-a-service scheduler (docs/SCHEDULING.md).
+
+N independent training jobs cooperatively time-sliced on one device
+set: chunk-boundary preemption, byte-exact snapshot/restore of
+descheduled tenants, working-set admission control against the HBM
+budget, a shared persistent compile cache across tenants, and a
+per-scheduler JSONL health stream with fairness and queue-latency
+accounting (``tools/sched_monitor.py`` renders it,
+``tools/submit_jobs.py`` drives it from a spec file).
+"""
+
+from .job import Job, JobSpec, peek_data_shape
+from .scheduler import POLICIES, SchedAdmissionError, Scheduler
+from .spec import parse_spec_file, run_spec_file
+
+__all__ = ["Job", "JobSpec", "Scheduler", "SchedAdmissionError",
+           "POLICIES", "parse_spec_file", "run_spec_file",
+           "peek_data_shape"]

@@ -1,0 +1,301 @@
+"""One tenant of the multi-tenant training scheduler.
+
+A :class:`Job` wraps the CLI/engine training loop as a resumable
+generator that yields at chunk boundaries — the natural preemption
+point the chunked boosting path (``tpu_boost_chunk``) already drains
+at.  The scheduler advances a job a quantum of chunk dispatches at a
+time (:meth:`Job.run_chunks`); between quanta the job can be
+descheduled to a snapshot (:meth:`Job.preempt`) through
+``utils/snapshots.py`` and later rebuilt from it, byte-identically:
+the chunk step sequence is bit-exact at any split (PR 1 invariant) and
+the snapshot sidecar restores the exact PRNG/score/bagging state
+(PR 4 invariant), so a job trained under arbitrary slice interleaving
+produces the same model file as an uninterrupted standalone run.
+
+A job's ``health_out``/``snapshot_freq`` knobs are ignored under the
+scheduler: observability is the scheduler's JSONL stream (one stream
+per scheduler, not per tenant) and snapshots are preemption-driven.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..config import Config
+from ..metric import default_metric_for_objective
+from ..utils.log import LightGBMError, log_info, log_warning
+
+# job lifecycle states
+PENDING = "pending"        # admitted, no device state yet
+RESIDENT = "resident"      # booster + dataset live on the device set
+PREEMPTED = "preempted"    # descheduled to a snapshot, device state freed
+DONE = "done"              # final model written
+FAILED = "failed"          # slice/snapshot failure exhausted its retry
+
+
+def peek_data_shape(path: str) -> Tuple[int, int]:
+    """Cheap ``(rows, columns)`` of a text data file for pre-load
+    admission estimates: the first line's delimiter-separated field
+    count and the file's line count.  No parsing, no binning."""
+    if not os.path.exists(path):
+        raise LightGBMError(f"Data file {path} doesn't exist")
+    with open(path, "rb") as fh:
+        first = fh.readline()
+        sep = b"\t" if b"\t" in first else b","
+        cols = len(first.rstrip(b"\r\n").split(sep))
+        rows = 1 if first.strip() else 0
+        while True:
+            block = fh.read(1 << 20)
+            if not block:
+                break
+            rows += block.count(b"\n")
+    return max(rows, 1), max(cols, 1)
+
+
+class JobSpec:
+    """A named training job: CLI-style params (``data=``,
+    ``objective=``, ``output_model=``, ...) plus a fair-share weight."""
+
+    def __init__(self, name: str, params: Dict[str, Any],
+                 weight: float = 1.0):
+        self.name = str(name)
+        self.params = dict(params)
+        self.weight = float(weight)
+        if not self.name:
+            raise LightGBMError("every scheduled job needs a name")
+        if self.weight <= 0:
+            raise LightGBMError(
+                f"job {self.name}: weight must be > 0, got {weight}")
+
+
+class Job:
+    """One admitted tenant: its resolved config, device state when
+    resident, and the scheduler's per-job accounting."""
+
+    def __init__(self, spec: JobSpec):
+        self.name = spec.name
+        self.weight = spec.weight
+        self.config = Config.from_params(spec.params)
+        if not str(self.config.data):
+            raise LightGBMError(f"job {self.name}: set data=...")
+        if not str(self.config.output_model):
+            raise LightGBMError(f"job {self.name}: set output_model=...")
+        self.state = PENDING
+        self.error = ""
+        # accounting the scheduler folds per slice
+        self.estimate = 0              # admission working-set bytes
+        self.iters_done = 0
+        self.slices = 0
+        self.wall_s = 0.0
+        self.device_s = 0.0
+        self.counters: Dict[str, int] = {}
+        self.last_eval: Dict[str, float] = {}
+        self.slice_retries = 0
+        self.preemptions = 0
+        self.submit_t: Optional[float] = None
+        self.first_slice_t: Optional[float] = None
+        # device/host training state (None unless RESIDENT)
+        self._booster = None
+        self._train = None
+        self._valids: List = []
+        self._names: List[str] = []
+        self._gen = None
+        self._metric_names: List[str] = []
+        self._resume_snap: Optional[str] = None
+        self._snapshots: List[str] = []
+
+    # ------------------------------------------------------------ admission
+    def data_shape(self) -> Tuple[int, int]:
+        """(num_data, num_features) estimate for admission: file peek
+        minus the label column."""
+        rows, cols = peek_data_shape(str(self.config.data))
+        if bool(self.config.header):
+            rows = max(rows - 1, 1)
+        return rows, max(cols - 1, 1)
+
+    @property
+    def queue_wait_s(self) -> float:
+        if self.submit_t is None:
+            return 0.0
+        end = self.first_slice_t if self.first_slice_t is not None \
+            else time.perf_counter()
+        return max(0.0, end - self.submit_t)
+
+    @property
+    def total_iterations(self) -> int:
+        return int(self.config.num_iterations)
+
+    # ------------------------------------------------------------- lifecycle
+    def _build(self) -> None:
+        """Construct (or reconstruct from the preemption snapshot) the
+        dataset + booster, mirroring the CLI train setup order so a
+        scheduled run is byte-identical to a standalone one."""
+        from ..core.parser import load_file_to_dataset
+        from ..models.boosting_factory import create_boosting
+        from ..objective import create_objective
+
+        cfg = self.config
+        train = load_file_to_dataset(str(cfg.data), cfg)
+        valids, names = [], []
+        for vf in cfg.valid or []:
+            valids.append(load_file_to_dataset(str(vf), cfg,
+                                               reference=train))
+            names.append(os.path.basename(str(vf)))
+        objective = create_objective(cfg)
+        if objective is not None:
+            objective.init(train.metadata, train.num_data)
+        booster = create_boosting(cfg, train, objective)
+        if cfg.input_model and self._resume_snap is None:
+            from ..basic import Booster as PyBooster
+            from ..models.serialization import load_trees_into
+            load_trees_into(booster,
+                            PyBooster(model_file=str(cfg.input_model)))
+        for name, vset in zip(names, valids):
+            booster.add_valid_data(name, vset)
+        metric_names = list(cfg.metric)
+        if not metric_names:
+            d = default_metric_for_objective(cfg.objective)
+            metric_names = [d] if d else []
+        booster.setup_metrics(metric_names)
+        if self._resume_snap is not None:
+            from ..basic import Booster as PyBooster
+            from ..models.serialization import load_trees_into
+            from ..utils.snapshots import restore_snapshot_state
+            load_trees_into(booster,
+                            PyBooster(model_file=self._resume_snap))
+            it = restore_snapshot_state(booster, self._resume_snap)
+            if it != self.iters_done:
+                raise LightGBMError(
+                    f"job {self.name}: preemption snapshot at iteration "
+                    f"{it} does not match the accounted {self.iters_done}")
+        self._booster, self._train = booster, train
+        self._valids, self._names = valids, names
+        self._metric_names = metric_names
+        self._gen = self._steps()
+        self.state = RESIDENT
+
+    def _steps(self):
+        """The train loop as a generator: one chunk dispatch per
+        ``next()``, StopIteration on the call that completes (or
+        early-stops) the run.  Step clamping mirrors cli.py so the
+        dispatch sequence is identical to a standalone run."""
+        cfg, booster = self.config, self._booster
+        chunk = booster.boost_chunk_size()
+        freqs = [f for f in (
+            (cfg.metric_freq if self._metric_names else 0),) if f > 0]
+        total = self.total_iterations
+        while True:
+            if self.iters_done >= total:
+                return
+            step = min(chunk, total - self.iters_done)
+            for f in freqs:
+                step = min(step, f - self.iters_done % f)
+            stop = (booster.train_chunk(step) if step > 1
+                    else booster.train_one_iter())
+            it = self.iters_done + step - 1
+            self.iters_done += step
+            if (cfg.metric_freq > 0 and (it + 1) % cfg.metric_freq == 0
+                    and self._metric_names):
+                self._eval(it)
+            if stop or self.iters_done >= total:
+                return
+            yield step
+
+    def _eval(self, it: int) -> None:
+        cfg, booster = self.config, self._booster
+        rec: Dict[str, float] = {}
+        if cfg.is_provide_training_metric:
+            for mname, val, _ in booster.eval_train():
+                rec[f"training/{mname}"] = float(val)
+        for vi, _vname in enumerate(self._names):
+            for mname, val, _ in booster.eval_valid(vi):
+                rec[f"valid_{vi + 1}/{mname}"] = float(val)
+        if rec:
+            self.last_eval = rec
+
+    # ---------------------------------------------------------- scheduling
+    def run_chunks(self, n: int) -> str:
+        """Advance up to ``n`` chunk boundaries; returns ``"done"``
+        when the run completed (final model written) else
+        ``"running"``.  Builds/rebuilds device state on demand."""
+        if self.state in (DONE, FAILED):
+            return self.state
+        if self._gen is None:
+            self._build()
+        for _ in range(max(1, int(n))):
+            try:
+                next(self._gen)
+            except StopIteration:
+                self._finish()
+                return DONE
+        return "running"
+
+    def preempt(self) -> Optional[str]:
+        """Deschedule: flush pending trees, write a resumable snapshot
+        (model + exact-state sidecar) and free the device state.
+        Returns the snapshot path, or None when the job held no device
+        state worth persisting.  The caller (scheduler) owns the
+        ``sched/snapshot`` fault probe and its retry."""
+        if self.state != RESIDENT or self._booster is None:
+            self._drop()
+            if self.state not in (DONE, FAILED):
+                self.state = PREEMPTED if self._resume_snap else PENDING
+            return None
+        if int(self._booster.current_iteration()) == 0:
+            # nothing trained yet: dropping device state loses nothing
+            self._drop()
+            self.state = PENDING
+            return None
+        from ..models.serialization import save_model_to_string
+        from ..utils.snapshots import save_snapshot
+        it = int(self._booster.current_iteration())
+        snap = f"{self.config.output_model}.snapshot_iter_{it}"
+        save_snapshot(self._booster, snap,
+                      save_model_to_string(self._booster, self.config))
+        if snap not in self._snapshots:
+            self._snapshots.append(snap)
+        self._resume_snap = snap
+        self._drop()
+        self.state = PREEMPTED
+        self.preemptions += 1
+        return snap
+
+    def fail(self, exc: BaseException) -> None:
+        """Per-tenant failure: record the cause and free device state;
+        sibling jobs and the scheduler keep running."""
+        self.error = f"{type(exc).__name__}: {exc}"
+        self._drop()
+        self.state = FAILED
+        log_warning(f"scheduled job {self.name} failed: {self.error}")
+
+    def _finish(self) -> None:
+        from ..models.serialization import save_model_to_string
+        from ..utils.file_io import atomic_write_text
+        from ..utils.snapshots import state_path
+        atomic_write_text(str(self.config.output_model),
+                          save_model_to_string(self._booster, self.config))
+        log_info(f"scheduled job {self.name}: finished "
+                 f"{self.iters_done} iterations, saved model to "
+                 f"{self.config.output_model}")
+        self._drop()
+        # the final model supersedes this job's preemption snapshots
+        for snap in self._snapshots:
+            for victim in (snap, state_path(snap)):
+                try:
+                    if os.path.exists(victim):
+                        os.remove(victim)
+                except OSError:
+                    pass
+        self._snapshots = []
+        self._resume_snap = None
+        self.state = DONE
+
+    def _drop(self) -> None:
+        if self._gen is not None:
+            self._gen.close()
+        self._gen = None
+        self._booster = None
+        self._train = None
+        self._valids, self._names = [], []

@@ -1,0 +1,501 @@
+"""Multi-tenant training scheduler: N jobs time-sliced on one device set.
+
+The scheduler composes the substrates that landed one PR at a time —
+chunk-boundary draining, byte-exact snapshots, the jitcost working-set
+estimate, the shared persistent compile cache, the health-stream writer
+— into a training-as-a-service loop:
+
+* **Admission** (:meth:`Scheduler.submit`): a job whose estimated
+  working set (public ``estimate_working_set``) alone exceeds
+  ``admit_fraction`` x the HBM budget is REJECTED with a named
+  :class:`SchedAdmissionError` and a ``sched_admit`` record; an
+  admitted job runs immediately when it fits next to the resident set,
+  otherwise it queues.  Backends without allocator stats (CPU) skip
+  the budget check unless an explicit ``hbm_budget_bytes`` is given.
+* **Slicing** (:meth:`Scheduler.step`): the policy picks a runnable
+  job and advances it one quantum of chunk dispatches; per-slice wall,
+  measured device-seconds (``device_timing`` deltas, slice wall as the
+  fallback weight) and telemetry-counter deltas are attributed to that
+  job.  Making a job resident may preempt the least-recently-sliced
+  resident tenant to a snapshot (``sched_preempt_job``).
+* **Policies**: ``round_robin`` rotates tenants per quantum;
+  ``fair`` is the deficit policy — always slice the runnable job with
+  the least ``device_seconds / weight``.
+* **Per-tenant fault isolation**: the ``sched/slice`` fault site is
+  probed at every slice start (occurrence index = global slice count)
+  and ``sched/snapshot`` before every preemption snapshot; one retry
+  per incident, then the JOB fails — never the scheduler or siblings.
+* **Health stream**: ``sched_start`` / ``sched_admit`` /
+  ``sched_slice`` / ``sched_preempt_job`` / ``job_done`` /
+  ``sched_summary`` JSONL records through the same never-torn
+  O_APPEND writer training uses, tailed by ``tools/sched_monitor.py``.
+* **Cross-tenant compile cache**: ``compile_cache=`` arms the
+  persistent XLA cache before the first tenant compiles; cache-hit
+  counter deltas observed in a slice of a job that started after
+  another tenant already ran are counted as ``cross_job_cache_hits``
+  (the proof that same-shape tenants share compilations).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.faults import FAULTS, InjectedFault
+from ..utils.log import LightGBMError, log_info, log_warning
+from ..utils.telemetry import TELEMETRY, HealthStream
+from .job import (DONE, FAILED, PENDING, PREEMPTED, RESIDENT, Job,
+                  JobSpec)
+
+POLICIES = ("round_robin", "fair")
+
+
+class SchedAdmissionError(LightGBMError):
+    """A submitted job's estimated working set can never fit the HBM
+    budget; raised at submit, mirrored as a rejected ``sched_admit``."""
+
+
+class Scheduler:
+    """Cooperative time-slicing of independent training jobs on this
+    process's device set.  Drive it with :meth:`submit` + :meth:`run`,
+    or :meth:`step` for slice-at-a-time control (tests interleave
+    :meth:`preempt_job` between steps)."""
+
+    def __init__(self, quantum_chunks: int = 4,
+                 policy: str = "round_robin",
+                 max_jobs: int = 8,
+                 health_out: str = "",
+                 compile_cache: str = "",
+                 admit_fraction: float = 0.9,
+                 hbm_budget_bytes: Optional[int] = None,
+                 fault_spec: str = ""):
+        if policy not in POLICIES:
+            raise LightGBMError(
+                f"sched_policy must be one of {', '.join(POLICIES)}, "
+                f"got {policy!r}")
+        if quantum_chunks < 1:
+            raise LightGBMError("sched_quantum_chunks must be >= 1")
+        if max_jobs < 1:
+            raise LightGBMError("sched_max_jobs must be >= 1")
+        self.quantum_chunks = int(quantum_chunks)
+        self.policy = policy
+        self.max_jobs = int(max_jobs)
+        self.admit_fraction = float(admit_fraction)
+        self._explicit_budget = (int(hbm_budget_bytes)
+                                 if hbm_budget_bytes else None)
+        self.jobs: List[Job] = []
+        self._by_name: Dict[str, Job] = {}
+        self._rr_next = 0               # round-robin rotation pointer
+        self._slice_idx = 0             # global slice counter (fault n=)
+        self._last_sliced: Dict[str, int] = {}   # name -> slice index
+        self._ran_before: List[str] = []         # first-slice order
+        self.cross_job_cache_hits = 0
+        self._fault_spec = str(fault_spec or "")
+        from ..utils.faults import parse_spec
+        self._fault_sites = frozenset(parse_spec(self._fault_spec))
+        # sched/* incidents consumed at this layer: booster
+        # construction re-arms the process-global registry (resetting
+        # its fired counts), so count-limited sched specs are capped
+        # here to keep per-slice injection deterministic across tenants
+        self._faults_consumed: Dict[str, int] = {}
+        self._stream = HealthStream()
+        self._health_out = str(health_out or "")
+        self._t0: Optional[float] = None
+        self._closed = False
+        if compile_cache:
+            from ..utils import enable_jax_compilation_cache
+            cc = str(compile_cache).strip()
+            if cc.lower() in ("1", "true", "on", "yes", "default"):
+                enable_jax_compilation_cache()
+            else:
+                enable_jax_compilation_cache(cache_dir=cc)
+        if self._fault_spec:
+            FAULTS.configure(self._fault_spec)
+
+    @classmethod
+    def from_config(cls, config, **overrides) -> "Scheduler":
+        """Build from the ``sched_*`` knobs of a resolved Config (the
+        CLI entry point and tools/submit_jobs.py route through here)."""
+        kw: Dict[str, Any] = dict(
+            quantum_chunks=int(config.sched_quantum_chunks),
+            policy=str(config.sched_policy),
+            max_jobs=int(config.sched_max_jobs),
+            health_out=str(config.sched_health_out),
+            compile_cache=str(getattr(config, "compile_cache", "") or ""),
+            fault_spec=str(getattr(config, "fault_injection", "") or ""))
+        kw.update(overrides)
+        return cls(**kw)
+
+    # -------------------------------------------------------------- budget
+    def hbm_budget(self) -> Optional[int]:
+        if self._explicit_budget is not None:
+            return self._explicit_budget
+        return TELEMETRY.device_memory_budget()
+
+    def _limit(self) -> Optional[int]:
+        budget = self.hbm_budget()
+        return int(self.admit_fraction * budget) if budget else None
+
+    def _resident(self) -> List[Job]:
+        return [j for j in self.jobs if j.state == RESIDENT]
+
+    def _resident_bytes(self) -> int:
+        return sum(j.estimate for j in self._resident())
+
+    # ------------------------------------------------------------ admission
+    def submit(self, spec: JobSpec) -> Job:
+        """Admission-check and enqueue one job.  Raises
+        :class:`SchedAdmissionError` when the job can never fit the
+        budget; otherwise the job is admitted (runs at its first slice)
+        or queued behind the resident set."""
+        job = Job(spec)
+        if job.name in self._by_name:
+            raise LightGBMError(
+                f"duplicate scheduled job name {job.name!r}")
+        for other in self.jobs:
+            if str(other.config.output_model) == \
+                    str(job.config.output_model):
+                raise LightGBMError(
+                    f"job {job.name}: output_model "
+                    f"{job.config.output_model!r} collides with job "
+                    f"{other.name}")
+        from ..engine import estimate_working_set
+        job.estimate = int(estimate_working_set(job.config,
+                                                job.data_shape()))
+        job.submit_t = time.perf_counter()
+        limit = self._limit()
+        if limit is not None and job.estimate > limit:
+            budget = self.hbm_budget()
+            detail = (f"rejected {job.name}: estimated working set "
+                      f"~{job.estimate} B exceeds {limit} B "
+                      f"({self.admit_fraction:.0%} of the {budget} B "
+                      "HBM budget)")
+            self._admit_record(job, "rejected", detail)
+            raise SchedAdmissionError(
+                f"sched admission: {detail}; shrink the job (max_bin, "
+                "data) or raise the budget")
+        # admitted = a slice can run it without preempting anyone;
+        # queued = it contends with the live tenants (the scheduler
+        # will preempt to make room when its turn comes)
+        live = [j for j in self.jobs
+                if j.state in (PENDING, RESIDENT, PREEMPTED)]
+        live_bytes = sum(j.estimate for j in live)
+        can_run = (len(live) < self.max_jobs
+                   and (limit is None
+                        or live_bytes + job.estimate <= limit))
+        decision = "admitted" if can_run else "queued"
+        if limit is None:
+            detail = (f"{decision} {job.name} (~{job.estimate} B); no "
+                      "allocator stats on this backend — budget check "
+                      "skipped")
+        else:
+            detail = (f"{decision} {job.name}: working set "
+                      f"~{job.estimate} B, live "
+                      f"~{live_bytes} B of {limit} B")
+        self._admit_record(job, decision, detail)
+        self.jobs.append(job)
+        self._by_name[job.name] = job
+        return job
+
+    def _admit_record(self, job: Job, decision: str, detail: str) -> None:
+        TELEMETRY.fault_event("sched_admit", site="sched/admit",
+                              iteration=self._slice_idx, detail=detail)
+        TELEMETRY.counter_add(f"sched/admit_{decision}")
+        self._record("sched_admit", {
+            "job": job.name, "decision": decision,
+            "estimate_bytes": int(job.estimate), "detail": detail})
+        (log_warning if decision == "rejected" else log_info)(
+            f"sched admission: {detail}")
+
+    # --------------------------------------------------------------- stream
+    def _open_stream(self) -> None:
+        if self._t0 is not None:
+            return
+        self._t0 = time.perf_counter()
+        if self._health_out:
+            budget = self.hbm_budget()
+            self._stream.open(self._health_out, meta={
+                "policy": self.policy,
+                "quantum_chunks": self.quantum_chunks,
+                "max_jobs": self.max_jobs,
+                "admit_fraction": self.admit_fraction,
+                "hbm_budget_bytes": (int(budget) if budget else None),
+            }, start_kind="sched_start")
+
+    def _record(self, kind: str,
+                fields: Optional[Dict[str, Any]] = None) -> None:
+        self._open_stream()
+        if self._stream.active:
+            self._stream.record(kind, fields)
+
+    # --------------------------------------------------------------- faults
+    def _probe(self, site: str) -> None:
+        """Probe a sched fault site at the global slice index.  Every
+        tenant booster construction re-arms the process-global registry
+        from the TENANT's (empty) fault spec, wiping the scheduler's —
+        so the scheduler restores its own spec before probing, and caps
+        count-limited specs at this layer (``_faults_consumed``; the
+        registry's own fired counters reset on every re-arm).  Pinned
+        ``n`` keeps the re-arm deterministic: a site fires iff
+        n >= start, up to its count, regardless of re-arm churn."""
+        if site in self._fault_sites and site not in FAULTS.armed():
+            FAULTS.configure(self._fault_spec)
+        if not FAULTS.enabled:
+            return
+        armed = FAULTS.armed().get(site)
+        if armed is None:
+            return
+        count = armed.get("count")
+        if count is not None and \
+                self._faults_consumed.get(site, 0) >= count:
+            return
+        try:
+            FAULTS.maybe_raise(site, n=self._slice_idx)
+        except InjectedFault:
+            self._faults_consumed[site] = \
+                self._faults_consumed.get(site, 0) + 1
+            raise
+
+    # ------------------------------------------------------------ residency
+    def _make_room_for(self, job: Job) -> bool:
+        """Preempt least-recently-sliced residents until ``job`` fits
+        the resident set (count and byte caps).  True when it fits."""
+        limit = self._limit()
+
+        def fits() -> bool:
+            return (len(self._resident()) < self.max_jobs
+                    and (limit is None
+                         or self._resident_bytes() + job.estimate
+                         <= limit))
+
+        while not fits():
+            victims = [j for j in self._resident() if j is not job]
+            if not victims:
+                return False
+            victim = min(victims,
+                         key=lambda j: self._last_sliced.get(j.name, -1))
+            self.preempt_job(victim.name, reason="make room for "
+                             f"{job.name}")
+            if victim.state == FAILED:
+                continue        # snapshot failed; its estimate is freed
+        return True
+
+    def preempt_job(self, name: str, reason: str = "explicit") -> None:
+        """Deschedule one tenant to a byte-exact snapshot (its next
+        slice resumes from it).  A ``sched/snapshot`` injection gets
+        one retry; a second failure fails the JOB only."""
+        job = self._by_name[name]
+        if job.state not in (RESIDENT,):
+            return
+        snap = None
+        for attempt in (0, 1):
+            try:
+                self._probe("sched/snapshot")
+                snap = job.preempt()
+                break
+            except Exception as e:
+                TELEMETRY.fault_event(
+                    "sched_snapshot_fault", site="sched/snapshot",
+                    iteration=self._slice_idx,
+                    detail=f"job {job.name} attempt {attempt}: {e}")
+                if attempt == 0:
+                    job.slice_retries += 1
+                    continue
+                job.fail(e)
+                self._record("sched_preempt_job", {
+                    "job": job.name, "reason": reason,
+                    "iter": int(job.iters_done), "failed": True,
+                    "error": job.error})
+                return
+        self._record("sched_preempt_job", {
+            "job": job.name, "reason": reason,
+            "iter": int(job.iters_done),
+            "snapshot": (os.path.basename(snap) if snap else None)})
+        TELEMETRY.counter_add("sched/preemptions")
+
+    # -------------------------------------------------------------- picking
+    def _runnable(self) -> List[Job]:
+        return [j for j in self.jobs
+                if j.state in (PENDING, RESIDENT, PREEMPTED)]
+
+    def _pick(self) -> Optional[Job]:
+        runnable = self._runnable()
+        if not runnable:
+            return None
+        if self.policy == "fair":
+            return min(runnable,
+                       key=lambda j: (j.device_s / j.weight,
+                                      self._last_sliced.get(j.name, -1)))
+        # round_robin: next unfinished job at or after the pointer, in
+        # submit order
+        order = [j for j in self.jobs if j in runnable]
+        for off in range(len(self.jobs)):
+            cand = self.jobs[(self._rr_next + off) % len(self.jobs)]
+            if cand in order:
+                self._rr_next = (self.jobs.index(cand) + 1) \
+                    % len(self.jobs)
+                return cand
+        return None
+
+    # --------------------------------------------------------------- slicing
+    def step(self) -> Optional[Job]:
+        """Run one time slice: pick a tenant, give it a quantum of
+        chunk dispatches, attribute the slice's wall/device-seconds/
+        counter deltas to it.  Returns the sliced job, or None when no
+        job is runnable (all done/failed)."""
+        self._open_stream()
+        job = self._pick()
+        if job is None:
+            return None
+        if not self._make_room_for(job):
+            # can't fit even after preempting everyone else: the job
+            # was admissible alone, so this is transient only when
+            # another tenant cannot be preempted; fail it loudly
+            job.fail(LightGBMError(
+                f"job {job.name} (~{job.estimate} B) cannot fit the "
+                "resident budget even alone"))
+            return job
+        n_slice = self._slice_idx
+        self._slice_idx += 1
+        if job.first_slice_t is None:
+            job.first_slice_t = time.perf_counter()
+        counters0 = dict(TELEMETRY.stats()["counters"])
+        dev0 = TELEMETRY.dispatch_seconds_total()
+        wall0 = time.perf_counter()
+        status = "running"
+        try:
+            try:
+                self._probe("sched/slice")
+            except InjectedFault as e:
+                # retry-once at the slice boundary: nothing was
+                # dispatched yet, so the job state is untouched
+                job.slice_retries += 1
+                TELEMETRY.counter_add("sched/slice_retries")
+                TELEMETRY.fault_event(
+                    "sched_slice_fault", site="sched/slice",
+                    iteration=n_slice,
+                    detail=f"job {job.name} retry after: {e}")
+                self._probe("sched/slice")
+            status = job.run_chunks(self.quantum_chunks)
+        except Exception as e:
+            job.fail(e)
+            status = FAILED
+            TELEMETRY.fault_event(
+                "sched_slice_fault", site="sched/slice",
+                iteration=n_slice,
+                detail=f"job {job.name} failed: {e}")
+        wall = time.perf_counter() - wall0
+        dev = TELEMETRY.dispatch_seconds_total() - dev0
+        counters1 = TELEMETRY.stats()["counters"]
+        deltas = {k: int(v - counters0.get(k, 0))
+                  for k, v in counters1.items()
+                  if v != counters0.get(k, 0)}
+        job.slices += 1
+        job.wall_s += wall
+        # fairness weight: measured device-seconds when device_timing
+        # is on, slice wall otherwise (documented fallback)
+        job.device_s += dev if dev > 0 else wall
+        for k, v in deltas.items():
+            job.counters[k] = job.counters.get(k, 0) + v
+        hits = deltas.get("compile/cache_hits", 0)
+        if hits > 0 and any(n != job.name for n in self._ran_before):
+            self.cross_job_cache_hits += hits
+            TELEMETRY.counter_add("sched/cross_job_cache_hits", hits)
+        if job.name not in self._ran_before:
+            self._ran_before.append(job.name)
+        self._last_sliced[job.name] = n_slice
+        rec: Dict[str, Any] = {
+            "job": job.name, "slice": n_slice, "status": status,
+            "iter": int(job.iters_done),
+            "total": job.total_iterations,
+            "wall_s": round(wall, 6),
+            "device_s": round(dev if dev > 0 else wall, 6),
+        }
+        if job.last_eval:
+            rec["metrics"] = dict(job.last_eval)
+        self._record("sched_slice", rec)
+        TELEMETRY.counter_add("sched/slices")
+        if status == DONE:
+            self._record("job_done", {
+                "job": job.name, "iter": int(job.iters_done),
+                "slices": job.slices,
+                "wall_s": round(job.wall_s, 6),
+                "device_s": round(job.device_s, 6),
+                "queue_wait_s": round(job.queue_wait_s, 6),
+                "preemptions": job.preemptions,
+                "model": os.path.basename(
+                    str(job.config.output_model))})
+            TELEMETRY.counter_add("sched/jobs_done")
+        elif status == FAILED:
+            self._record("job_done", {
+                "job": job.name, "iter": int(job.iters_done),
+                "failed": True, "error": job.error})
+            TELEMETRY.counter_add("sched/jobs_failed")
+        return job
+
+    # ------------------------------------------------------------------ run
+    def run(self, max_slices: Optional[int] = None) -> Dict[str, Any]:
+        """Slice until every job is done or failed (or ``max_slices``
+        elapsed — the scheduler stays resumable), then write the
+        ``sched_summary`` record and return it."""
+        self._open_stream()
+        n = 0
+        while self.step() is not None:
+            n += 1
+            if max_slices is not None and n >= max_slices:
+                break
+        return self.close()
+
+    def summary(self) -> Dict[str, Any]:
+        total_dev = sum(j.device_s for j in self.jobs) or 1.0
+        per_job = {}
+        for j in self.jobs:
+            per_job[j.name] = {
+                "state": j.state,
+                "iterations": int(j.iters_done),
+                "slices": j.slices,
+                "wall_s": round(j.wall_s, 6),
+                "device_s": round(j.device_s, 6),
+                "share": round(j.device_s / total_dev, 6),
+                "weight": j.weight,
+                "queue_wait_s": round(j.queue_wait_s, 6),
+                "preemptions": j.preemptions,
+                "retries": j.slice_retries,
+                "estimate_bytes": int(j.estimate),
+            }
+            if j.error:
+                per_job[j.name]["error"] = j.error
+        # Jain's fairness index over weighted device-seconds: 1.0 =
+        # perfectly proportional shares, 1/N = one tenant got it all
+        xs = [j.device_s / j.weight for j in self.jobs
+              if j.slices > 0]
+        fairness = (round((sum(xs) ** 2)
+                          / (len(xs) * sum(x * x for x in xs)), 6)
+                    if xs and sum(x * x for x in xs) > 0 else None)
+        return {
+            "policy": self.policy,
+            "quantum_chunks": self.quantum_chunks,
+            "slices": self._slice_idx,
+            "jobs": per_job,
+            "done": sum(1 for j in self.jobs if j.state == DONE),
+            "failed": sum(1 for j in self.jobs if j.state == FAILED),
+            "fairness_index": fairness,
+            "cross_job_cache_hits": int(self.cross_job_cache_hits),
+            "wall_s": round((time.perf_counter() - self._t0)
+                            if self._t0 else 0.0, 6),
+        }
+
+    def close(self) -> Dict[str, Any]:
+        """Write ``sched_summary`` and release the stream; idempotent.
+        Unfinished resident jobs are preempted to snapshots first so no
+        work is lost."""
+        for j in self._resident():
+            self.preempt_job(j.name, reason="scheduler close")
+        out = self.summary()
+        if not self._closed:
+            self._record("sched_summary", out)
+            if self._stream.active:
+                self._stream.close(summary=False)
+            self._closed = True
+        return out

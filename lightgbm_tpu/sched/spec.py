@@ -1,0 +1,138 @@
+"""Job-spec files for the scheduler (conf-flavored, like train.conf).
+
+Top-level ``key = value`` lines before the first ``job =`` line are
+scheduler knobs (``sched_quantum_chunks=``, ``sched_policy=``,
+``compile_cache=``, ...) AND defaults inherited by every job.  Each
+``job = NAME`` line opens a job section whose lines override the
+defaults for that job only.  ``weight =`` inside a section sets the
+job's fair-share weight (scheduler-level key, never a training param).
+
+    sched_policy = fair
+    sched_quantum_chunks = 2
+    compile_cache = /tmp/shared_cache
+    num_iterations = 30          # inherited default
+
+    job = churn
+    data = churn.csv
+    objective = binary
+    output_model = churn.txt
+    weight = 2
+
+    job = intent
+    data = intent.csv
+    objective = multiclass
+    num_class = 3
+    output_model = intent.txt
+
+Driven by ``tools/submit_jobs.py`` and the CLI ``sched=`` entry point
+(``python -m lightgbm_tpu sched=jobs.spec``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..config import Config, kv2map
+from ..utils.log import LightGBMError
+from .job import JobSpec
+
+# keys the spec grammar consumes at the scheduler layer (everything
+# else flows into job params / scheduler-knob params untouched)
+_JOB_KEY = "job"
+_WEIGHT_KEY = "weight"
+# scheduler/global-only keys that must not leak into per-job configs
+_SCHED_ONLY = frozenset([
+    "sched", "sched_quantum_chunks", "sched_policy", "sched_max_jobs",
+    "sched_health_out", "compile_cache", "fault_injection", "task",
+    "config", "config_file",
+])
+
+
+def parse_spec_file(path: str) -> Tuple[Dict[str, str], List[JobSpec]]:
+    """Parse one spec file into (scheduler params, job specs)."""
+    if not os.path.exists(path):
+        raise LightGBMError(f"sched spec file {path} doesn't exist")
+    sched_params: Dict[str, str] = {}
+    defaults: Dict[str, str] = {}
+    jobs: List[JobSpec] = []
+    current: Optional[Dict[str, str]] = None
+    current_name = ""
+    rel_dir = os.path.dirname(os.path.abspath(path))
+
+    def _close_section() -> None:
+        if current is None:
+            return
+        weight = float(current.pop(_WEIGHT_KEY, 1.0))
+        params = {k: v for k, v in {**defaults, **current}.items()
+                  if k not in _SCHED_ONLY}
+        for key in ("data", "valid", "output_model", "input_model"):
+            # paths resolve relative to the spec file, not the cwd
+            val = params.get(key)
+            if val and not os.path.isabs(str(val).split(",")[0]):
+                params[key] = ",".join(
+                    os.path.join(rel_dir, p) if p else p
+                    for p in str(val).split(","))
+        jobs.append(JobSpec(current_name, params, weight=weight))
+
+    with open(path) as fh:
+        for line in fh:
+            kv: Dict[str, str] = {}
+            kv2map(kv, line)
+            if not kv:
+                continue
+            (key, value), = kv.items()
+            if key == _JOB_KEY:
+                _close_section()
+                current, current_name = {}, value
+                if not value:
+                    raise LightGBMError(
+                        f"{path}: 'job =' needs a name")
+            elif current is not None:
+                current[key] = value
+            else:
+                (sched_params if key in _SCHED_ONLY
+                 else defaults)[key] = value
+    _close_section()
+    if not jobs:
+        raise LightGBMError(f"{path}: no 'job =' sections found")
+    seen = set()
+    for spec in jobs:
+        if spec.name in seen:
+            raise LightGBMError(
+                f"{path}: duplicate job name {spec.name!r}")
+        seen.add(spec.name)
+    return sched_params, jobs
+
+
+def run_spec_file(path: str, overrides: Optional[Dict[str, Any]] = None,
+                  **scheduler_kwargs) -> Dict[str, Any]:
+    """Parse a spec file, build the scheduler, submit every job and
+    run to completion; returns the ``sched_summary`` dict.  A job the
+    admission check rejects outright is recorded (and its entry kept,
+    state ``failed``) without aborting the siblings.  ``overrides``
+    are CLI-level params that win over the spec's scheduler knobs."""
+    from .scheduler import SchedAdmissionError, Scheduler
+
+    sched_params, specs = parse_spec_file(path)
+    merged = dict(sched_params)
+    for k, v in (overrides or {}).items():
+        if v not in (None, ""):
+            merged[k] = v
+    merged.pop("task", None)
+    merged.pop("sched", None)
+    config = Config.from_params(merged)
+    sched = Scheduler.from_config(config, **scheduler_kwargs)
+    rejected = []
+    for spec in specs:
+        try:
+            sched.submit(spec)
+        except SchedAdmissionError as e:
+            rejected.append((spec.name, str(e)))
+    out = sched.run()
+    if rejected:
+        out["rejected"] = {name: err for name, err in rejected}
+    return out
+
+
+__all__ = ["parse_spec_file", "run_spec_file"]

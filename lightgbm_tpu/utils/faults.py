@@ -63,6 +63,8 @@ KNOWN_SITES = frozenset([
     "oocore/admit",      # admission check decides the matrix won't fit
     "serve/compile",     # serve executable build fails (named give-up)
     "serve/enqueue",     # serve request rejected at enqueue
+    "sched/slice",       # one scheduler time slice fails before dispatch
+    "sched/snapshot",    # preemption snapshot write fails
 ])
 
 

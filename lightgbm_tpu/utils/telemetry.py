@@ -904,6 +904,15 @@ class TelemetryRegistry:
                 e["gap_total_s"] += start - last_end
             e["last_end"] = end
 
+    def dispatch_seconds_total(self) -> float:
+        """Sum of every label's measured wall-to-ready dispatch seconds.
+        Zero until ``device_timing`` ran; deltas of this around a work
+        window (the sched plane brackets each time slice with it) give
+        that window's measured device-seconds without walking the
+        per-label ``timing`` section."""
+        with self._lock:
+            return float(sum(e["total_s"] for e in self._timing.values()))
+
     def record_profile_capture(self, info: Dict[str, Any]) -> None:
         """Attach a jax-profiler capture's artifact location (and, for
         windowed captures, the iteration span) to the ``timing`` section.

@@ -308,6 +308,38 @@ def self_test():
             [{"config": "loadgen-new-r50-d0", "qps": 49.0,
               "p99_s": 0.01}])[0]),
     ]
+    # multi-tenant scheduler records (tools/submit_jobs.py workloads):
+    # sched-only fields (fairness_index, queue_wait, cache hits) ride
+    # along without tripping the field-specific gates; the wall gate
+    # still judges the workload's end-to-end time, and quality_ok
+    # carries the fairness-threshold verdict
+    schist = [{"config": "sched-fair-3job", "value": 6.0 + 0.05 * i,
+               "unit": "s", "quality_ok": True,
+               "fairness_index": 0.95 - 0.001 * i,
+               "queue_wait_s": 0.4, "cross_job_cache_hits": 2}
+              for i in range(4)]
+
+    def scverdict(newest):
+        failures, _ = evaluate(schist + [newest])
+        return bool(failures)
+
+    checks += [
+        ("sched steady wall passes", not scverdict(
+            {"config": "sched-fair-3job", "value": 6.1, "unit": "s",
+             "quality_ok": True, "fairness_index": 0.95,
+             "queue_wait_s": 0.41, "cross_job_cache_hits": 2})),
+        ("sched wall regression fails", scverdict(
+            {"config": "sched-fair-3job", "value": 12.0, "unit": "s",
+             "quality_ok": True, "fairness_index": 0.95,
+             "queue_wait_s": 0.4, "cross_job_cache_hits": 2})),
+        ("sched fairness flip fails", scverdict(
+            {"config": "sched-fair-3job", "value": 6.1, "unit": "s",
+             "quality_ok": False, "fairness_index": 0.45,
+             "queue_wait_s": 0.4, "cross_job_cache_hits": 0})),
+        ("sched first record passes", not evaluate(
+            [{"config": "sched-rr-2job", "value": 3.0, "unit": "s",
+              "fairness_index": 0.99}])[0]),
+    ]
     bad = [name for name, ok in checks if not ok]
     for name, ok in checks:
         print(f"bench_gate self-test: {'ok' if ok else 'FAIL'} {name}")

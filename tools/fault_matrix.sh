@@ -22,6 +22,11 @@
 # NAMED give-up on the affected request futures — never a hang — and the
 # queue must keep serving afterwards.
 #
+# A fifth pass runs the scheduler suite (tests/test_sched.py) over the
+# sched/slice and sched/snapshot sites: a fault in one tenant's slice or
+# preemption snapshot must retry once then fail THAT JOB ONLY — the
+# scheduler and every sibling tenant run to completion.
+#
 #   tools/fault_matrix.sh [extra pytest args...]
 #
 # FAULT_MATRIX_CHUNK is deliberately NOT LIGHTGBM_TPU_-prefixed: the test
@@ -57,6 +62,13 @@ fi
 echo "=== fault matrix: serve sites=serve/compile,serve/enqueue ==="
 if ! JAX_PLATFORMS=cpu \
     python -m pytest tests/test_serve.py -q -p no:cacheprovider \
+    -k "fault" "$@"; then
+  status=1
+fi
+
+echo "=== fault matrix: sched sites=sched/slice,sched/snapshot ==="
+if ! JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_sched.py -q -p no:cacheprovider \
     -k "fault" "$@"; then
   status=1
 fi

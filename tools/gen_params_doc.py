@@ -195,6 +195,33 @@ def generate() -> str:
         "- `serve_queue_timeout_s` — end-to-end budget (default `30`)",
         "  for a blocking `ServeSession.predict` call; expiry raises a",
         "  named give-up instead of hanging the caller.",
+        "- `sched` — CLI entry into the multi-tenant training scheduler:",
+        "  path to a job-spec file (`job = NAME` sections over shared",
+        "  defaults, see docs/SCHEDULING.md).  `python -m lightgbm_tpu",
+        "  sched=jobs.spec` time-slices every job on one device set;",
+        "  each finished job is byte-identical to a standalone run.",
+        "  Runtime-only: never serialized into the model.",
+        "- `sched_quantum_chunks` — chunk dispatches one scheduled job",
+        "  runs before the next tenant is considered (default `4`,",
+        "  must be >= 1).  Smaller quanta interleave more fairly at the",
+        "  cost of more snapshot/rebuild churn when tenants exceed the",
+        "  residency cap.  Runtime-only.",
+        "- `sched_policy` — `round_robin` (default; aliases `rr`) or",
+        "  `fair` (aliases `fair_share`, `deficit`): `fair` picks the",
+        "  tenant with the least measured device-seconds per unit",
+        "  weight, giving weighted proportional shares (Jain index in",
+        "  the `sched_summary` record).  Runtime-only.",
+        "- `sched_max_jobs` — resident-tenant cap (default `8`, must be",
+        "  >= 1): beyond it the scheduler preempts the least-recently",
+        "  sliced tenant to a byte-exact snapshot before admitting the",
+        "  next slice's owner.  Admission also enforces the working-set",
+        "  budget (`estimate_working_set` vs 90% of the device HBM,",
+        "  the out-of-core `admit_fraction` convention).  Runtime-only.",
+        "- `sched_health_out` — stream the scheduler-health JSONL there",
+        "  (schema `lightgbm_tpu.health/v1`, kinds `sched_start`/",
+        "  `sched_admit`/`sched_slice`/`sched_preempt_job`/`job_done`/",
+        "  `sched_summary`).  Tail it with `tools/sched_monitor.py`.",
+        "  Runtime-only.  See docs/SCHEDULING.md.",
         "",
     ]
     return "\n".join(lines)

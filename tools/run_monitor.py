@@ -19,7 +19,11 @@ into each stream's start meta) — into one view: per-rank progress and
 pace, an interleaved tail of the newest records across ranks, and a
 LOUD stall flag when one rank's last iteration lags the fleet median
 (the signature of a wedged collective: the stuck rank stops appending
-while the others time out at the barrier behind it).
+while the others time out at the barrier behind it).  A second,
+pace-relative detector flags any unfinished stream whose file has no
+new line within 2x its own median inter-record gap — this catches a
+wedge the lag check can't (every rank stuck at the same iteration)
+and is reused by ``tools/sched_monitor.py`` for per-job streams.
 
 Usage:
   python tools/run_monitor.py run.health.jsonl
@@ -37,6 +41,14 @@ from collections import deque
 # a rank whose newest iteration trails the fleet median by at least
 # this many iterations (with no summary record) is flagged as stalled
 STALL_LAG_ITERS = 2
+# an unfinished stream with no new line for longer than this factor
+# times its own median inter-record gap is flagged as stale — catches
+# a wedged single rank (or a whole wedged fleet) that the iteration-lag
+# check can't see because every stream stopped at the same iteration
+STALL_GAP_FACTOR = 2.0
+# a stream too young/sparse to have a meaningful gap history is never
+# flagged; require this many timestamped records first
+STALE_MIN_RECORDS = 4
 
 
 class StreamState:
@@ -259,6 +271,61 @@ def _fleet_median_iter(states):
             else (last[mid - 1] + last[mid]) // 2)
 
 
+def median_record_gap(state: StreamState):
+    """Median inter-record gap in seconds over the stream's recent
+    timestamped records; None when fewer than STALE_MIN_RECORDS carry
+    a timestamp (too young to judge a pace from)."""
+    ts = [t for t, _kind, _it in state.recent
+          if isinstance(t, (int, float))]
+    if len(ts) < STALE_MIN_RECORDS:
+        return None
+    gaps = sorted(max(0.0, b - a) for a, b in zip(ts, ts[1:]))
+    mid = len(gaps) // 2
+    return (gaps[mid] if len(gaps) % 2
+            else 0.5 * (gaps[mid - 1] + gaps[mid]))
+
+
+def stream_stale(state: StreamState, age_s):
+    """``(age_s, gap)`` when an unfinished stream has appended nothing
+    for longer than STALL_GAP_FACTOR x its own median inter-record gap
+    (``age_s`` = seconds since the file last grew), else None.  Pure —
+    the caller supplies the age so this works on mtimes, synthetic
+    clocks in tests, and sched streams alike."""
+    if state.summary is not None or age_s is None:
+        return None
+    gap = median_record_gap(state)
+    if gap is None or gap <= 0:
+        return None
+    if age_s > STALL_GAP_FACTOR * gap:
+        return (float(age_s), float(gap))
+    return None
+
+
+def _stream_age_s(path, now=None):
+    """Seconds since the stream file last grew (mtime age); None when
+    the file can't be statted."""
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    return max(0.0, (time.time() if now is None else now) - mtime)
+
+
+def fleet_stale(states, ages=None):
+    """[(label, age_s, median_gap)] for every unfinished stream whose
+    file has gone quiet for > STALL_GAP_FACTOR x its median
+    inter-record gap.  ``ages`` optionally maps path -> age seconds
+    (tests); the default reads file mtimes."""
+    out = []
+    for path, state in states.items():
+        age = (ages.get(path) if ages is not None
+               else _stream_age_s(path))
+        hit = stream_stale(state, age)
+        if hit is not None:
+            out.append((_rank_label(path, state), hit[0], hit[1]))
+    return out
+
+
 def fleet_stalled(states):
     """[(label, last_iter, median)] for every unfinished rank whose
     newest iteration lags the fleet median by >= STALL_LAG_ITERS."""
@@ -312,6 +379,11 @@ def render_fleet(states, dirpath, tail=12):
             f"  !! STALL {label}: last iteration {last} lags the fleet "
             f"median {median} by {median - last} — rank wedged or its "
             f"stream stopped (others will hit the collective timeout)")
+    for label, age, gap in fleet_stale(states):
+        lines.append(
+            f"  !! STALE {label}: no new record for {age:.1f}s, over "
+            f"{STALL_GAP_FACTOR:g}x its median inter-record gap "
+            f"{gap:.2f}s — stream has gone quiet mid-run")
     merged.sort(key=lambda r: r[0])
     if merged:
         lines.append(f"  tail ({min(tail, len(merged))} newest across "

@@ -1,0 +1,244 @@
+"""Live/post-hoc terminal summary of a scheduler-health JSONL stream.
+
+The stream is the append-only file a multi-tenant Scheduler writes for
+``sched_health_out=`` (see lightgbm_tpu/sched/scheduler.py, schema
+``lightgbm_tpu.health/v1``): ``sched_start``, ``sched_admit``
+decisions (admitted/queued/rejected with working-set estimates),
+per-quantum ``sched_slice`` records (job, slice index, iteration
+progress, wall/device seconds, latest metrics), ``sched_preempt_job``
+events, per-tenant ``job_done`` terminals, and a closing
+``sched_summary`` with fairness / queue-latency accounting.
+
+One-shot mode renders the stream as it stands — running OR closed.
+``--follow`` tails the file exactly like run_monitor.py (byte-offset
+incremental reads), re-rendering every ``--interval`` seconds until
+the ``sched_summary`` record lands (exit 0) or ``--timeout`` seconds
+pass without one (exit 3).  Staleness detection reuses
+run_monitor.stream_stale: an unfinished stream whose file has no new
+line within 2x its own median inter-record gap gets a LOUD flag — the
+signature of a wedged tenant holding the whole scheduler loop.
+
+Usage:
+  python tools/sched_monitor.py jobs.sched.health.jsonl
+  python tools/sched_monitor.py jobs.sched.health.jsonl --follow
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from run_monitor import (  # noqa: E402  (shared staleness detector)
+    STALL_GAP_FACTOR, _stream_age_s, stream_stale)
+
+
+class SchedStreamState:
+    """Folded view of a sched health stream; feed() accepts raw JSONL
+    bytes incrementally and tolerates a torn trailing line."""
+
+    TAIL_KEEP = 64
+
+    def __init__(self):
+        self.start = None
+        self.admits = []
+        self.slices = 0                 # sched_slice records seen
+        self.jobs = {}                  # name -> last slice/done view
+        self.preempts = []
+        self.done = []                  # job_done records in order
+        self.summary = None
+        self.records = 0
+        self.recent = []                # (t, kind, job) tail
+        self._tail = b""
+
+    def feed(self, data: bytes) -> None:
+        buf = self._tail + data
+        lines = buf.split(b"\n")
+        self._tail = lines.pop()
+        for raw in lines:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            self.records += 1
+            kind = rec.get("kind")
+            self.recent.append((rec.get("t"), kind, rec.get("job")))
+            del self.recent[: -self.TAIL_KEEP]
+            if kind == "sched_start":
+                self.start = rec
+            elif kind == "sched_admit":
+                self.admits.append(rec)
+            elif kind == "sched_slice":
+                self.slices += 1
+                view = self.jobs.setdefault(rec.get("job", "?"), {})
+                view.update(rec)
+            elif kind == "sched_preempt_job":
+                self.preempts.append(rec)
+            elif kind == "job_done":
+                self.done.append(rec)
+                view = self.jobs.setdefault(rec.get("job", "?"), {})
+                view.update(rec)
+                view["terminal"] = ("failed" if rec.get("failed")
+                                    else "done")
+            elif kind == "sched_summary":
+                self.summary = rec
+
+
+# run_monitor's fleet staleness helpers expect a StreamState with
+# .recent tuples carrying a leading timestamp and a .summary attribute
+# — SchedStreamState satisfies both, so stream_stale works unchanged.
+
+
+def render(state: SchedStreamState, path: str,
+           age_s=None) -> str:
+    lines = []
+    if state.summary is not None:
+        status = "closed"
+    elif state.start is not None or state.records:
+        status = "running"
+    else:
+        status = "empty"
+    schema = (state.start or {}).get("schema", "?")
+    lines.append(f"sched-health {os.path.basename(path)} [{status}] "
+                 f"schema={schema} records={state.records}")
+    if state.start:
+        budget = state.start.get("hbm_budget_bytes")
+        lines.append(
+            f"  scheduler: policy={state.start.get('policy', '?')} "
+            f"quantum={state.start.get('quantum_chunks', '?')} chunks "
+            f"max_jobs={state.start.get('max_jobs', '?')} "
+            f"budget={budget if budget is not None else 'n/a'}")
+    if state.admits:
+        by = {}
+        for a in state.admits:
+            by[a.get("decision", "?")] = by.get(a.get("decision", "?"),
+                                                0) + 1
+        parts = [f"{k}={v}" for k, v in sorted(by.items())]
+        lines.append("  admissions: " + " ".join(parts))
+        for a in state.admits:
+            if a.get("decision") == "rejected":
+                lines.append(f"    REJECTED {a.get('job', '?')}: "
+                             f"{a.get('detail', '')[:80]}")
+    if state.jobs:
+        lines.append(f"  jobs ({len(state.jobs)}), "
+                     f"{state.slices} slice(s) streamed:")
+        for name in sorted(state.jobs):
+            v = state.jobs[name]
+            term = v.get("terminal")
+            if term == "failed":
+                lines.append(f"    {name}: FAILED at iteration "
+                             f"{v.get('iter', '?')} — "
+                             f"{v.get('error', '?')[:70]}")
+                continue
+            it, total = v.get("iter", 0), v.get("total")
+            line = f"    {name}: iter {it}"
+            if total:
+                line += f"/{int(total)} ({100.0 * it / total:.0f}%)"
+            if term == "done":
+                line += (f" [done] {v.get('slices', '?')} slices, "
+                         f"queue wait {v.get('queue_wait_s', 0):.2f}s")
+            else:
+                line += (f" [running] slice {v.get('slice', '?')}, "
+                         f"device {v.get('device_s', 0):.3f}s")
+            metrics = v.get("metrics")
+            if metrics:
+                top = sorted(metrics.items())[:2]
+                line += " " + " ".join(f"{k}={val:g}"
+                                       for k, val in top)
+            lines.append(line)
+    else:
+        lines.append("  no slice records yet")
+    if state.preempts:
+        last = state.preempts[-1]
+        lines.append(f"  preemptions: {len(state.preempts)}, last "
+                     f"{last.get('job', '?')}@{last.get('iter', '?')} "
+                     f"({last.get('reason', '?')})")
+    hit = stream_stale(state, age_s)
+    if hit is not None:
+        lines.append(
+            f"  !! STALE: no new record for {hit[0]:.1f}s, over "
+            f"{STALL_GAP_FACTOR:g}x the stream's median inter-record "
+            f"gap {hit[1]:.2f}s — a tenant slice is likely wedged")
+    if state.summary is not None:
+        s = state.summary
+        fairness = s.get("fairness_index")
+        lines.append(
+            f"  summary: {s.get('done', '?')} done / "
+            f"{s.get('failed', 0)} failed over {s.get('slices', '?')} "
+            f"slices, fairness "
+            f"{fairness if fairness is not None else 'n/a'}, "
+            f"cross-job cache hits "
+            f"{s.get('cross_job_cache_hits', 0)}, "
+            f"wall {s.get('wall_s', 0):.2f}s")
+    return "\n".join(lines)
+
+
+def follow(path, interval, timeout, out=sys.stdout):
+    """Tail the stream until sched_summary lands.  Returns 0 on a
+    closed stream, 2 when the file never appears, 3 on timeout."""
+    state = SchedStreamState()
+    offset = 0
+    deadline = time.monotonic() + timeout if timeout > 0 else None
+    waited_for_file = False
+    while True:
+        if os.path.exists(path):
+            size = os.path.getsize(path)
+            if size < offset:            # truncated (fresh scheduler)
+                state, offset = SchedStreamState(), 0
+            if size > offset:
+                with open(path, "rb") as fh:
+                    fh.seek(offset)
+                    data = fh.read()
+                offset += len(data)
+                state.feed(data)
+                out.write(render(state, path,
+                                 age_s=_stream_age_s(path)) + "\n")
+                out.flush()
+        else:
+            waited_for_file = True
+        if state.summary is not None:
+            return 0
+        if deadline is not None and time.monotonic() >= deadline:
+            if waited_for_file and state.records == 0:
+                out.write(f"sched_monitor: {path} never appeared\n")
+                return 2
+            out.write("sched_monitor: timeout waiting for the "
+                      "sched_summary record (scheduler still alive?)\n")
+            return 3
+        time.sleep(interval)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="summarize a lightgbm_tpu scheduler-health JSONL "
+                    "stream, live or post-hoc")
+    ap.add_argument("path")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep tailing until sched_summary lands")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll period in seconds (default 2)")
+    ap.add_argument("--timeout", type=float, default=0.0,
+                    help="--follow gives up after this many seconds "
+                         "(0 = wait forever)")
+    args = ap.parse_args(argv)
+    if args.follow:
+        return follow(args.path, max(0.05, args.interval), args.timeout)
+    if not os.path.exists(args.path):
+        print(f"sched_monitor: no such stream: {args.path}")
+        return 2
+    state = SchedStreamState()
+    with open(args.path, "rb") as fh:
+        state.feed(fh.read())
+    print(render(state, args.path, age_s=_stream_age_s(args.path)))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)

@@ -1,0 +1,212 @@
+"""Drive the multi-tenant training scheduler from a job-spec file.
+
+The spec grammar is documented in lightgbm_tpu/sched/spec.py and
+docs/SCHEDULING.md: top-level ``key = value`` lines set scheduler
+knobs (``sched_policy=``, ``sched_quantum_chunks=``,
+``sched_health_out=``, ``compile_cache=``, ...) and per-job defaults;
+each ``job = NAME`` section overrides them for one tenant.  This tool
+parses the spec, submits every job, runs the scheduler to completion
+and prints the ``sched_summary``; exit 1 when any job failed or was
+rejected by admission control, 0 otherwise.
+
+``--smoke`` ignores the spec argument and runs a self-contained
+3-tenant workload (binary + multiclass + lambdarank) in a temp
+directory with a health stream, then asserts the stream is
+well-formed: exactly one ``sched_start`` and one ``sched_summary``,
+every record JSON with a ``kind``, one ``job_done`` per tenant, and
+``sched_slice`` iteration counts consistent with each job's terminal
+record.  This is the ``verify_t1.sh --sched-smoke`` leg.
+
+Usage:
+  python tools/submit_jobs.py jobs.spec
+  python tools/submit_jobs.py jobs.spec --policy fair --quantum 2
+  python tools/submit_jobs.py --smoke
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SMOKE_KINDS = ("sched_start", "sched_admit", "sched_slice",
+               "sched_preempt_job", "job_done", "sched_summary")
+
+
+def run_spec(path, overrides):
+    from lightgbm_tpu.sched import run_spec_file
+    out = run_spec_file(path, overrides=overrides)
+    print(json.dumps(out, indent=2, sort_keys=True))
+    bad = out.get("failed", 0) or out.get("rejected")
+    return 1 if bad else 0
+
+
+def _write_smoke_data(d):
+    """Three small datasets: binary, 3-class, and a 2-group ranking
+    set with a query file — one per tenant of the smoke workload."""
+    import numpy as np
+    r = np.random.RandomState(7)
+
+    def feats(n):
+        return r.rand(n, 5)
+
+    xb = feats(240)
+    yb = (xb[:, 0] + 0.25 * r.rand(240) > 0.55).astype(int)
+    np.savetxt(os.path.join(d, "binary.csv"),
+               np.column_stack([yb, xb]), delimiter=",", fmt="%.6f")
+    xm = feats(240)
+    ym = (np.digitize(xm[:, 1], [0.33, 0.66])).astype(int)
+    np.savetxt(os.path.join(d, "multiclass.csv"),
+               np.column_stack([ym, xm]), delimiter=",", fmt="%.6f")
+    xr = feats(200)
+    yr = (np.digitize(xr[:, 2] + 0.1 * r.rand(200),
+                      [0.4, 0.7])).astype(int)
+    np.savetxt(os.path.join(d, "rank.csv"),
+               np.column_stack([yr, xr]), delimiter=",", fmt="%.6f")
+    with open(os.path.join(d, "rank.csv.query"), "w") as fh:
+        fh.write("100\n100\n")
+
+
+def _smoke_spec(d):
+    spec = os.path.join(d, "jobs.spec")
+    stream = os.path.join(d, "sched.health.jsonl")
+    with open(spec, "w") as fh:
+        fh.write(f"""\
+sched_policy = fair
+sched_quantum_chunks = 2
+sched_health_out = {stream}
+num_iterations = 8
+num_leaves = 7
+min_data_in_leaf = 5
+verbosity = -1
+
+job = churn
+data = binary.csv
+objective = binary
+output_model = churn.txt
+weight = 2
+
+job = intent
+data = multiclass.csv
+objective = multiclass
+num_class = 3
+output_model = intent.txt
+
+job = ranker
+data = rank.csv
+objective = lambdarank
+output_model = ranker.txt
+""")
+    return spec, stream
+
+
+def _check_stream(stream, expect_jobs):
+    """Well-formedness assertions over the smoke health stream."""
+    assert os.path.exists(stream), f"no health stream at {stream}"
+    records = []
+    with open(stream) as fh:
+        for ln, raw in enumerate(fh, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            rec = json.loads(raw)      # every line must parse
+            assert "kind" in rec, f"line {ln}: record without kind"
+            assert rec["kind"] in SMOKE_KINDS, \
+                f"line {ln}: unknown kind {rec['kind']!r}"
+            records.append(rec)
+    kinds = [r["kind"] for r in records]
+    assert kinds[0] == "sched_start", "stream must open with sched_start"
+    assert kinds[-1] == "sched_summary", \
+        "stream must close with sched_summary"
+    assert kinds.count("sched_start") == 1
+    assert kinds.count("sched_summary") == 1
+    admits = [r for r in records if r["kind"] == "sched_admit"]
+    assert {a["job"] for a in admits} == set(expect_jobs), \
+        f"admission records missing a job: {admits}"
+    dones = {r["job"]: r for r in records if r["kind"] == "job_done"}
+    assert set(dones) == set(expect_jobs), \
+        f"job_done missing for {set(expect_jobs) - set(dones)}"
+    for name, rec in dones.items():
+        assert not rec.get("failed"), f"{name} failed: {rec}"
+    slices = [r for r in records if r["kind"] == "sched_slice"]
+    assert len(slices) >= len(expect_jobs), "too few slice records"
+    last_iter = {}
+    for r in slices:
+        # per-job iteration counters must be monotone across slices
+        prev = last_iter.get(r["job"], 0)
+        assert r["iter"] >= prev, \
+            f"{r['job']}: iter went backwards {prev} -> {r['iter']}"
+        last_iter[r["job"]] = r["iter"]
+    for name, rec in dones.items():
+        assert last_iter.get(name) == rec["iter"], \
+            f"{name}: slice iter {last_iter.get(name)} != " \
+            f"job_done iter {rec['iter']}"
+    summary = records[-1]
+    assert summary.get("done") == len(expect_jobs)
+    assert summary.get("failed", 0) == 0
+    assert summary.get("fairness_index") is not None
+    return len(records)
+
+
+def run_smoke():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from lightgbm_tpu.sched import run_spec_file
+    from lightgbm_tpu.utils.telemetry import TELEMETRY
+    TELEMETRY.reset()
+    with tempfile.TemporaryDirectory(prefix="sched_smoke_") as d:
+        _write_smoke_data(d)
+        spec, stream = _smoke_spec(d)
+        out = run_spec_file(spec)
+        names = ("churn", "intent", "ranker")
+        assert out.get("done") == 3, f"expected 3 done jobs: {out}"
+        assert out.get("failed", 0) == 0, f"smoke job failed: {out}"
+        assert not out.get("rejected"), f"smoke job rejected: {out}"
+        for name in names:
+            job = out["jobs"][name]
+            assert job["state"] == "done", (name, job)
+            assert job["iterations"] == 8, (name, job)
+        for model in ("churn.txt", "intent.txt", "ranker.txt"):
+            assert os.path.exists(os.path.join(d, model)), \
+                f"missing model {model}"
+        n = _check_stream(stream, names)
+        print(f"sched smoke OK: 3 jobs done over {out['slices']} "
+              f"slices, fairness {out['fairness_index']}, "
+              f"{n} well-formed stream records")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="submit a spec file of training jobs to the "
+                    "multi-tenant scheduler")
+    ap.add_argument("spec", nargs="?",
+                    help="job spec file (see docs/SCHEDULING.md)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the self-contained 3-tenant smoke "
+                         "workload and assert stream well-formedness")
+    ap.add_argument("--policy", default="",
+                    help="override sched_policy= from the spec")
+    ap.add_argument("--quantum", type=int, default=0,
+                    help="override sched_quantum_chunks= from the spec")
+    ap.add_argument("--health-out", default="",
+                    help="override sched_health_out= from the spec")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return run_smoke()
+    if not args.spec:
+        ap.error("a spec file is required unless --smoke")
+    overrides = {}
+    if args.policy:
+        overrides["sched_policy"] = args.policy
+    if args.quantum > 0:
+        overrides["sched_quantum_chunks"] = args.quantum
+    if args.health_out:
+        overrides["sched_health_out"] = args.health_out
+    return run_spec(args.spec, overrides)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,15 @@
 #                                             under load, and every reply
 #                                             stays bit-identical;
 #                                             writes no artifacts)
+#        bash tools/verify_t1.sh --sched-smoke (also run the
+#                                             multi-tenant scheduler
+#                                             smoke: 3 jobs — binary,
+#                                             multiclass, lambdarank —
+#                                             time-sliced under the fair
+#                                             policy in a temp dir, with
+#                                             health-stream
+#                                             well-formedness assertions;
+#                                             writes no artifacts)
 #        bash tools/verify_t1.sh --with-kernel-checks (also run every
 #                                             kernel variant self-check —
 #                                             fused route, packed
@@ -40,6 +49,9 @@ fi
 if [ "$1" = "--serve-smoke" ]; then
     timeout -k 10 330 env BENCH_SKIP_TPU=1 python tools/bench_serve.py --smoke || exit 1
     timeout -k 10 330 env JAX_PLATFORMS=cpu python tools/loadgen.py --smoke || exit 1
+fi
+if [ "$1" = "--sched-smoke" ]; then
+    timeout -k 10 330 env JAX_PLATFORMS=cpu python tools/submit_jobs.py --smoke || exit 1
 fi
 if [ "$1" = "--with-kernel-checks" ]; then
     timeout -k 10 330 env JAX_PLATFORMS=cpu python -c 'import sys; from lightgbm_tpu.ops.pallas_histogram import run_kernel_self_checks; sys.exit(run_kernel_self_checks())' || exit 1
