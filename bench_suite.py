@@ -201,6 +201,12 @@ def run_child(config: str, platform: str, n_rows: int, warmup: int,
               "tpu_boost_chunk": int(os.environ.get(
                   "LIGHTGBM_TPU_BOOST_CHUNK", "0"))}
     params.update(extra.get("params", {}))
+    # fused-K ladder hook (tools/onchip_r7.py): pins the frontier batch
+    # width, same knob perf_probe.py exposes, so the K∈{4,8,16} A/B
+    # cells measure the width they name
+    fk = int(os.environ.get("LIGHTGBM_TPU_FRONTIER_K", "0") or 0)
+    if fk > 0:
+        params["tpu_frontier_width"] = fk
     # spill A/B hook: the parent pins the memory tier per child
     # (runtime-only knob — it never reaches the serialized model)
     dib = os.environ.get("SUITE_DATA_IN_HBM")
@@ -544,11 +550,19 @@ def main():
     import bench
     probe_ok = (not os.environ.get("BENCH_SKIP_TPU")) and bench.probe_tpu()
     results = []
+    # A/B ladder runs (tools/onchip_r7.py) suffix their records so each
+    # env cell forms its OWN config series in the trajectory —
+    # bench_gate's per-config latency baselines never mix a forced
+    # variant with the defaults
+    tag = os.environ.get("SUITE_CONFIG_TAG", "")
     for config in configs:
         r = run_config(config, probe_ok)
         if r is None:
             r = {"config": config, "metric": f"{config}_failed",
                  "value": -1.0, "unit": "s", "quality_ok": False}
+        if tag:
+            r["config"] = f"{r['config']}+{tag}"
+            r["metric"] = f"{r.get('metric', config)}+{tag}"
         results.append(r)
         print(json.dumps(r), flush=True)
     _append_trajectory(results)

@@ -91,6 +91,9 @@ def _maybe_print_seg_stats(stats) -> None:
         # quantization / staging counters stay 0 on paths that never
         # quantize or stage — record only live events so trace_report's
         # hist section renders n/a instead of misleading zero rates
+        if rows[:, 5].sum():
+            TELEMETRY.counter_add("hist/fused_k_rounds",
+                                  int(rows[:, 5].sum()))
         if rows[:, 6].sum():
             TELEMETRY.counter_add("hist/quant_rescales", len(rows))
             TELEMETRY.counter_add("hist/quant_clips",

@@ -38,8 +38,10 @@ from jax import lax
 import os as _os
 
 from ..ops.pallas_histogram import (_segment_buckets, frontier_width,
+                                    fused_packed_optin,
                                     fused_route_decisions,
                                     fused_route_policy, histogram_frontier,
+                                    histogram_frontier_fusedk,
                                     histogram_frontier_routed, null_route,
                                     pack_channels, pack_route,
                                     packed_acc_bits, packed_acc_decisions,
@@ -139,7 +141,8 @@ def _hist_stage_self_check() -> bool:
 def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                             block_rows: int, batch_k: int = 0,
                             gain_ratio: float = 0.0,
-                            comm=None, wrap=None, hist_stage=None):
+                            comm=None, wrap=None, hist_stage=None,
+                            fused_k=None):
     """Build the jitted frontier-batched grower.
 
     Same call contract as make_grow_tree_segment:
@@ -170,25 +173,40 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
     packed_acc = packed_acc_enabled()
     qbits = packed_acc_bits()
     packed_acc_decisions["frontier"] = packed_acc
-    # fused route+histogram: OFF in auto for K > 1 (see
-    # fused_route_policy — the K=16 fusion measured slower on-chip);
-    # feature-parallel stripes always keep the unfused pair — the
-    # histogram scans a column slice, the route needs the full matrix.
-    # The packed stream keeps the unfused pair too (docs/KERNELS.md):
-    # the on-chip A/B isolates one variant at a time.
-    fused_route = (fused_route_policy(K, p.num_columns or 64, B, rb,
-                                      p.packed4)
-                   and comm.column_block is None
-                   and not packed_acc)
-    fused_route_decisions["frontier"] = fused_route
-    # round-carry leaf-hist staging: serial-only (the distributed
-    # wrappers' reduce/stripe hooks read the full carry); an explicit
-    # ``hist_stage=`` (the self-check) bypasses the env gate
+    # serial := no distributed hooks.  Both the round-carry stage and
+    # the fused-K pass require it: the wrappers' reduce/stripe hooks
+    # read the full carry / per-child batches.
     serial = (comm.reduce_hist_batch is None and comm.column_block is None
               and not comm.no_subtract)
+    # fused route+histogram tiers (fused_route_policy): "fusedk" folds
+    # the round's K route updates AND all 2K children's histograms into
+    # ONE pass (LIGHTGBM_TPU_FUSED_K) — no parent gather, no
+    # subtraction trick, so the arithmetic bit-matches the no_subtract
+    # path; "k1" is the legacy K==1 fused route.  Feature-parallel
+    # stripes keep the unfused pair — the histogram scans a column
+    # slice, the route needs the full matrix.  The packed stream keeps
+    # the unfused pair unless LIGHTGBM_TPU_FUSED_PACKED opts the
+    # combined variant in for A/B (docs/KERNELS.md).  An explicit
+    # ``fused_k=`` (tests, self-checks) bypasses the env gate.
+    packed_ok = not packed_acc or fused_packed_optin()
+    fused_tier = fused_route_policy(K, p.num_columns or 64, B, rb,
+                                    p.packed4)
+    if fused_k is None:
+        fused_k = fused_tier == "fusedk"
+    fused_k = bool(fused_k) and serial and packed_ok
+    fused_route = (fused_tier == "k1" and not fused_k
+                   and comm.column_block is None and packed_ok)
+    fused_route_decisions["frontier"] = ("fusedk" if fused_k
+                                         else fused_route)
+    # round-carry leaf-hist staging: serial-only (the distributed
+    # wrappers' reduce/stripe hooks read the full carry); an explicit
+    # ``hist_stage=`` (the self-check) bypasses the env gate.  Under
+    # fused-K there is nothing to stage — no round ever reads leaf_hist
+    # (both children come from data), so the staging cond would only
+    # add latency.
     if hist_stage is None:
         hist_stage = hist_stage_enabled()
-    hist_stage = bool(hist_stage) and serial
+    hist_stage = bool(hist_stage) and serial and not fused_k
     hist_stage_decisions["frontier"] = hist_stage
     from ..ops.pallas_histogram import route_kernel_available
     route_kernel = route_kernel_available()
@@ -291,6 +309,20 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                 h = comm.reduce_hist_batch(h, fmeta)
             return st, h
 
+        def hist_batch_fusedk(st: _SegState, targets2, block_list,
+                              n_blocks, routes):
+            """[2K] child targets (-1 = skip) -> (st, [2K, G, B, 3]):
+            ONE pass applies the round's K routes and accumulates every
+            child's histogram from the updated ids (serial-only; the
+            decision block guarantees no distributed hooks here)."""
+            lid, out = histogram_frontier_fusedk(
+                st.binsT, st.w8, st.leaf_id, block_list, n_blocks,
+                targets2, routes, B, rb, K, packed4=p.packed4)
+            st = st._replace(leaf_id=lid)
+            h = (unpack_hist_packed(out[:, :G_cols], qscales)
+                 if packed_acc else unpack_hist(out[:, :G_cols]))
+            return st, h
+
         def apply_split(st: _SegState, leaf, new_leaf, node):
             """Routing + tree-array bookkeeping for ONE split (the cheap
             per-split work; histograms and scans happen batched)."""
@@ -303,7 +335,7 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             bitset = st.best_cat_bitset[leaf]
 
             lo, hi = st.leaf_lo[leaf], st.leaf_hi[leaf]
-            if not fused_route:
+            if not (fused_route or fused_k):
                 # routing confined to the parent's inherited block
                 # interval (grower_seg.route_split_windowed); the fused
                 # path routes inside the batched histogram kernel instead
@@ -386,7 +418,7 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             return st
 
         def round_body(carry):
-            st, stage_ids, stage_hist, s_hits, s_looks = carry
+            st, stage_ids, stage_hist, s_hits, s_looks, fk_rounds = carry
             base = st.num_leaves
             budget = L - base
             gains_top, leaves_top = lax.top_k(st.best_f32[:, 0], K)
@@ -424,7 +456,12 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             def apply_one(j, s):
                 return apply_split(s, leaves_top[j], new_leaves[j],
                                    nodes[j])
-            if hist_stage:
+            if fused_k:
+                # both children come from data in the fused pass; no
+                # round ever reads leaf_hist, so the [L, G, B, 3]
+                # parent gather vanishes along with the child scatter
+                parent_hist = None
+            elif hist_stage:
                 # round-carry staging: flush LAST round's staged children
                 # into the full carry first (a later round may split a
                 # leaf that left the stage), then look the round's K
@@ -470,10 +507,8 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                 jnp.where(mask, pos, max_blocks)].set(all_blocks,
                                                       mode="drop")
 
-            # 3) ONE batched kernel pass for the K smaller children
-            smaller = jnp.where(smaller_is_left, leaves_top, new_leaves)
-            targets = jnp.where(valid, smaller, -1)
-            if fused_route:
+            # 3) ONE batched kernel pass for the round's histograms
+            if fused_route or fused_k:
                 # the round's K routes ride the same pass (invalid slots
                 # match nothing); split params still live in the best-*
                 # cache — the scans that overwrite them run in step 4
@@ -489,28 +524,59 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
                         null_route()))(leaves_top, new_leaves, valid)
             else:
                 routes = None
-            st, hist_small = hist_batch(st, targets, block_list, n_un,
-                                        routes, fmeta)
-            if comm.no_subtract:
-                # voting-parallel: election masks differ per call, so the
-                # subtraction trick is invalid — batch-histogram the
-                # larger children from data too (routes already applied)
-                larger = jnp.where(smaller_is_left, new_leaves, leaves_top)
-                targets_l = jnp.where(valid, larger, -1)
-                _, hist_large = hist_batch(st, targets_l, block_list,
-                                           n_un, None, fmeta)
-                scanned = 2 * n_un
-                grid_inc = 2 * grid_of(n_un)
-            else:
-                hist_large = parent_hist - hist_small
+            if fused_k:
+                # fused-K: route + ALL 2K children in one data pass.
+                # Left children keep the parent leaf id after routing,
+                # right children take the new id — so the target list is
+                # simply [parents, new_leaves] and no smaller-child /
+                # subtraction bookkeeping exists on this path (arithmetic
+                # bit-matches comm.no_subtract, which also accumulates
+                # both children from data).
+                targets2 = jnp.concatenate([
+                    jnp.where(valid, leaves_top, -1),
+                    jnp.where(valid, new_leaves, -1)])
+                st, hists2 = hist_batch_fusedk(st, targets2, block_list,
+                                               n_un, routes)
+                hist_left, hist_right = hists2[:K], hists2[K:]
                 scanned = n_un
                 grid_inc = grid_of(n_un)
-            sel = smaller_is_left[:, None, None, None]
-            hist_left = jnp.where(sel, hist_small, hist_large)
-            hist_right = jnp.where(sel, hist_large, hist_small)
+                fk_rounds = fk_rounds + 1
+            else:
+                smaller = jnp.where(smaller_is_left, leaves_top,
+                                    new_leaves)
+                targets = jnp.where(valid, smaller, -1)
+                st, hist_small = hist_batch(st, targets, block_list, n_un,
+                                            routes, fmeta)
+                if comm.no_subtract:
+                    # voting-parallel: election masks differ per call, so
+                    # the subtraction trick is invalid — batch-histogram
+                    # the larger children from data too (routes applied)
+                    larger = jnp.where(smaller_is_left, new_leaves,
+                                       leaves_top)
+                    targets_l = jnp.where(valid, larger, -1)
+                    _, hist_large = hist_batch(st, targets_l, block_list,
+                                               n_un, None, fmeta)
+                    scanned = 2 * n_un
+                    grid_inc = 2 * grid_of(n_un)
+                else:
+                    hist_large = parent_hist - hist_small
+                    scanned = n_un
+                    grid_inc = grid_of(n_un)
+                sel = smaller_is_left[:, None, None, None]
+                hist_left = jnp.where(sel, hist_small, hist_large)
+                hist_right = jnp.where(sel, hist_large, hist_small)
             idx_l = jnp.where(valid, leaves_top, L)
             idx_r = jnp.where(valid, new_leaves, L)
-            if hist_stage:
+            if fused_k:
+                # children go straight to the step-4 scans; leaf_hist is
+                # never read on this path, so neither of the per-round
+                # [L, G, B, 3] staging copies happens
+                st = st._replace(
+                    scanned_since=st.scanned_since + scanned,
+                    scanned_total=st.scanned_total + scanned,
+                    grid_total=st.grid_total + grid_inc,
+                )
+            elif hist_stage:
                 # the children stay in the stage this round; the flush at
                 # the top of the NEXT round persists them (a fresh stage
                 # entry shadows any stale carry slot until then)
@@ -556,7 +622,7 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
             # 5) adaptive compaction, same rule as the strict grower
             st = cond_narrow(st.scanned_since >= limit_blocks,
                              compact, st, _COMPACT_MUT)
-            return st, stage_ids, stage_hist, s_hits, s_looks
+            return st, stage_ids, stage_hist, s_hits, s_looks, fk_rounds
 
         limit_blocks = min(max(1, int(COMPACT_WASTE * max_blocks)),
                            2**31 - 1)
@@ -564,13 +630,21 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         st = fresh_state(binsT, w8, n, L, G_cols, B, F, max_blocks,
                          G0, H0, C0, fmeta, p)
         if root_hist is None:
-            root_targets = jnp.full(K, -1, jnp.int32).at[0].set(0)
-            # all-null routes on the fused path: same kernel as the round
-            # passes, so the root costs no extra Mosaic compile
-            root_routes = (jnp.tile(null_route(), (K, 1))
-                           if fused_route else None)
-            _, rh = hist_batch(st, root_targets, all_blocks,
-                               jnp.int32(max_blocks), root_routes, fmeta)
+            # all-null routes on the fused paths: same kernel as the
+            # round passes, so the root costs no extra Mosaic compile
+            if fused_k:
+                root_targets2 = (jnp.full(2 * K, -1, jnp.int32)
+                                 .at[0].set(0))
+                _, rh = hist_batch_fusedk(st, root_targets2, all_blocks,
+                                          jnp.int32(max_blocks),
+                                          jnp.tile(null_route(), (K, 1)))
+            else:
+                root_targets = jnp.full(K, -1, jnp.int32).at[0].set(0)
+                root_routes = (jnp.tile(null_route(), (K, 1))
+                               if fused_route else None)
+                _, rh = hist_batch(st, root_targets, all_blocks,
+                                   jnp.int32(max_blocks), root_routes,
+                                   fmeta)
             root_hist = rh[0]
         st = st._replace(leaf_hist=st.leaf_hist.at[0].set(root_hist),
                          scanned_since=jnp.int32(max_blocks),
@@ -597,19 +671,24 @@ def make_grow_tree_frontier(num_bins: int, params: GrowerParams,
         else:
             stage_ids0 = jnp.zeros(0, jnp.int32)
             stage_hist0 = jnp.zeros((0, G_cols, B, 3), jnp.float32)
-        carry = (st, stage_ids0, stage_hist0, jnp.int32(0), jnp.int32(0))
+        carry = (st, stage_ids0, stage_hist0, jnp.int32(0), jnp.int32(0),
+                 jnp.int32(0))
         carry = lax.while_loop(lambda c: cond(c[0]), round_body, carry)
-        st, _sid, _shist, s_hits, s_looks = carry
+        st, _sid, _shist, s_hits, s_looks, fk_rounds = carry
         leaf_id_orig = _unpermute(st.order, st.leaf_id)
         # counters as a third jit output with stable arity (axon rejects
         # in-jit host callbacks); printing is env-gated at call sites
         stats = jnp.stack([st.scanned_total, st.num_sorts, st.grid_total,
                            jnp.int32(max_blocks), jnp.int32(K),
-                           jnp.int32(0), qclips.astype(jnp.int32),
+                           fk_rounds, qclips.astype(jnp.int32),
                            s_hits, s_looks])
         return st.tree, leaf_id_orig, stats
 
     if wrap is not None:
         return wrap(grow)
     from ..utils.jitcost import cost_jit
-    return cost_jit("grow/frontier", jax.jit(grow))
+    # the fused-K label keeps "hist" in it so bench_suite's hist-pass
+    # rollup (and bench_gate's latency gate) see fused rounds
+    label = (f"grow/frontier[fused_hist_k{K}]" if fused_k
+             else "grow/frontier")
+    return cost_jit(label, jax.jit(grow))

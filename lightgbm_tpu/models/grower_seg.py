@@ -38,7 +38,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.pallas_histogram import (NUM_CHANNELS, _segment_buckets,
-                                    bucket_index, fused_route_decisions,
+                                    bucket_index, fused_packed_optin,
+                                    fused_route_decisions,
                                     fused_route_policy,
                                     histogram_segment,
                                     histogram_segment_routed, null_route,
@@ -75,16 +76,16 @@ COMPACT_WASTE = float(_os.environ.get("LIGHTGBM_TPU_COMPACT_WASTE", "9.0"))
 
 # the growers' third jit output: i32 counter vector, one row per device
 # under the data-parallel wrappers.  Fixed width so every grower/wrapper
-# agrees; slots [quant_clips, stage_hits, stage_lookups] stay 0 on paths
-# that don't quantize / don't stage.
+# agrees; slots [fused_k_rounds, quant_clips, stage_hits, stage_lookups]
+# stay 0 on paths that don't fuse-K / quantize / stage.
 SEG_STATS_SLOTS = 9
 
 
 def seg_stats_enabled() -> bool:
     """When LIGHTGBM_TPU_SEG_STATS is set, the counters the growers
     return — [scanned_blocks, compactions, grid_steps, max_blocks, K,
-    reserved, quant_clips, stage_hits, stage_lookups] — are printed
-    per tree."""
+    fused_k_rounds, quant_clips, stage_hits, stage_lookups] — are
+    printed per tree."""
     return bool(_os.environ.get("LIGHTGBM_TPU_SEG_STATS"))
 
 
@@ -102,11 +103,13 @@ def print_seg_stats(stats) -> None:
     import numpy as np
 
     rows = np.asarray(stats).reshape(-1, SEG_STATS_SLOTS)
-    for d, (scanned, sorts, grid, max_blocks, k, _r, clips, shits,
+    for d, (scanned, sorts, grid, max_blocks, k, fkr, clips, shits,
             slooks) in enumerate(rows):
         dev = f" dev{d}" if len(rows) > 1 else ""
         nb = max(int(max_blocks), 1)
         extra = ""
+        if fkr:
+            extra += f", fused-K rounds {int(fkr)}"
         if clips:
             extra += f", quant clips {int(clips)}"
         if slooks:
@@ -477,13 +480,14 @@ def make_grow_tree_segment(num_bins: int, params: GrowerParams,
     # Feature-parallel stripes (column_block) keep the unfused pair: the
     # histogram scans a column SLICE while the route needs the full
     # matrix (the winning split may live on another shard's stripe).
-    # The packed stream keeps the unfused pair too: packed+fused has no
+    # The packed stream keeps the unfused pair too — packed+fused has no
     # on-chip number yet (docs/KERNELS.md), so the A/B isolates one
-    # variant at a time.
+    # variant at a time — unless LIGHTGBM_TPU_FUSED_PACKED opts the
+    # combined variant in for its own A/B.
     fused_route = (fused_route_policy(1, p.num_columns or 64, B, rb,
-                                      p.packed4)
+                                      p.packed4) == "k1"
                    and comm.column_block is None
-                   and not packed_acc)
+                   and (not packed_acc or fused_packed_optin()))
     fused_route_decisions["segment"] = fused_route
     route_kernel = route_kernel_available()
 

@@ -1170,10 +1170,15 @@ def histogram_segment_routed(binsT: jax.Array, w8: jax.Array,
 
 def _kernel_frontier_routed(sref, binsT_ref, w_ref, frows_ref, lid_ref,
                             lid_out_ref, out_ref, acc_ref, *, num_bins, K,
-                            packed4, onehot_build="iota"):
+                            packed4, onehot_build="iota", n_targets=0):
     # frows_ref: [K, rb] — the K split features' bin-row blocks
-    # sref: [2 + K + K*_ROUTE_WORDS + n_grid] =
-    #   (n_blocks, pad, targets[K], routes[K*19], block_list[n_grid])
+    # sref: [2 + KT + K*_ROUTE_WORDS + n_grid] =
+    #   (n_blocks, pad, targets[KT], routes[K*19], block_list[n_grid])
+    # KT (n_targets) decouples the histogram width from the route count:
+    # the round-pass fusion histograms the K smaller children (KT == K),
+    # the fused-K kernel histograms ALL 2K children of the K routes
+    # (KT == 2K) so no parent gather / subtraction survives the round
+    KT = n_targets or K
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -1186,11 +1191,11 @@ def _kernel_frontier_routed(sref, binsT_ref, w_ref, frows_ref, lid_ref,
     lid = lid_ref[...]
     frows = frows_ref[...]
     for k in range(K):
-        lid = _route_block_ids(sref, 2 + K + k * _ROUTE_WORDS,
+        lid = _route_block_ids(sref, 2 + KT + k * _ROUTE_WORDS,
                                frows[k:k + 1], lid, packed4)
     lid_out_ref[...] = lid
 
-    # 2) batched accumulate of the K targets from the UPDATED ids
+    # 2) batched accumulate of the KT targets from the UPDATED ids
     @pl.when(i < sref[0])
     def _():
         def wfn(c, chunk):
@@ -1199,7 +1204,7 @@ def _kernel_frontier_routed(sref, binsT_ref, w_ref, frows_ref, lid_ref,
                 wc = _packed_wrows(wc)
             lc = lid_out_ref[:, pl.ds(c * chunk, chunk)]
             rows = []
-            for k in range(K):
+            for k in range(KT):
                 mask = (lc == sref[2 + k]).astype(jnp.bfloat16)
                 rows.append(mask * wc)
             return jnp.concatenate(rows, axis=0)
@@ -1214,7 +1219,8 @@ def _kernel_frontier_routed(sref, binsT_ref, w_ref, frows_ref, lid_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "block_rows", "K",
-                                    "interpret", "packed4", "onehot_build"))
+                                    "interpret", "packed4", "onehot_build",
+                                    "n_targets"))
 def _histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
                                leaf_id: jax.Array, block_list: jax.Array,
                                n_blocks: jax.Array, targets: jax.Array,
@@ -1222,9 +1228,12 @@ def _histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
                                block_rows: int = 0, K: int = 0,
                                interpret: bool | None = None,
                                packed4: bool = False,
-                               onehot_build: str = "iota"):
+                               onehot_build: str = "iota",
+                               n_targets: int = 0):
     F, n = binsT.shape
-    K = K or int(targets.shape[0])
+    K = K or int(routes.shape[0])
+    KT = n_targets or K
+    assert int(targets.shape[0]) == KT, (targets.shape, KT)
     F_log = 2 * F if packed4 else F
     CHW = int(w8.shape[0])
     och = PACKED_CHANNELS if w8.dtype == jnp.int32 else NUM_CHANNELS
@@ -1240,7 +1249,7 @@ def _histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
         jnp.stack([n_blocks.astype(jnp.int32), jnp.int32(0)]),
         targets.astype(jnp.int32), routes.astype(jnp.int32).reshape(-1),
         bl])
-    blk_base = 2 + K + K * _ROUTE_WORDS
+    blk_base = 2 + KT + K * _ROUTE_WORDS
     # the K split features' physical bin rows (routes[:, 2]), pre-sliced
     # into one [K, n] operand (whole-sublane block: Mosaic-legal)
     frows = jnp.take(binsT, routes[:, 2].astype(jnp.int32), axis=0,
@@ -1261,30 +1270,32 @@ def _histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
         ],
         out_specs=[
             pl.BlockSpec((1, block_rows), im_data),
-            pl.BlockSpec((F_log * num_bins, K * och),
+            pl.BlockSpec((F_log * num_bins, KT * och),
                          lambda i, s: (0, 0)),
         ],
-        scratch_shapes=[pltpu.VMEM((F_log * num_bins, K * och),
+        scratch_shapes=[pltpu.VMEM((F_log * num_bins, KT * och),
                                    jnp.float32)],
     )
     lid_out, hist = pl.pallas_call(
         functools.partial(_kernel_frontier_routed, num_bins=num_bins, K=K,
-                          packed4=packed4, onehot_build=onehot_build),
+                          packed4=packed4, onehot_build=onehot_build,
+                          n_targets=KT),
         out_shape=[jax.ShapeDtypeStruct((1, n), jnp.int32),
                    jax.ShapeDtypeStruct((F_log * num_bins,
-                                         K * och), jnp.float32)],
+                                         KT * och), jnp.float32)],
         grid_spec=grid_spec,
         # inputs: scalars, binsT, w8, frows, leaf_id
         input_output_aliases={4: 0},
         # see _histogram_segment_routed: the K frow rows + lid streams
         # exceed the 16 MB default scoped-vmem limit at K=16 production
-        # shapes — auto-sized from the computed need
+        # shapes — auto-sized from the computed need (the fused-K call
+        # carries a KT == 2K wide accumulator, so the limit follows KT)
         compiler_params=_TPUCompilerParams(
             vmem_limit_bytes=fused_vmem_limit(F, num_bins, K, block_rows,
-                                              packed4)),
+                                              packed4, targets_k=KT)),
         interpret=interpret,
     )(scalars, binsT, w8, frows, leaf_id.reshape(1, -1))
-    return lid_out[0], hist.reshape(F_log, num_bins, K,
+    return lid_out[0], hist.reshape(F_log, num_bins, KT,
                                     och).transpose(2, 0, 1, 3)
 
 
@@ -1309,27 +1320,74 @@ def histogram_frontier_routed(binsT: jax.Array, w8: jax.Array,
                                       onehot_build_mode())
 
 
+def histogram_frontier_fusedk(binsT: jax.Array, w8: jax.Array,
+                              leaf_id: jax.Array, block_list: jax.Array,
+                              n_blocks: jax.Array, targets2: jax.Array,
+                              routes: jax.Array, num_bins: int,
+                              block_rows: int = 0, K: int = 0,
+                              interpret: bool | None = None,
+                              packed4: bool = False):
+    """Frontier-K fusion: apply the round's K routes AND histogram all
+    2K children in ONE pass over the union block list.
+
+    ``routes`` is [K, _ROUTE_WORDS] i32 (invalid slots: null_route());
+    ``targets2`` is [2K] i32 = (left children = the K routed parents,
+    which keep their leaf id, then right children = the K new leaves),
+    -1 skipping a slot.  Returns ``(leaf_id', [2K, F, B, 8])``
+    ([2K, F, B, 4] for a packed i32 ``w8``), child order matching
+    ``targets2`` — so the round needs NO parent histogram: both
+    children come straight off the data pass and the subtraction trick
+    plus both ``[L, G, B, 3]`` leaf_hist staging copies disappear.
+    Bit-identical to the unfused pair (route, then
+    ``histogram_frontier`` over the same 2K targets): the accumulator
+    columns per channel set are independent dot products of the same
+    one-hot blocks in the same chunk order.  Dynamic-grid only, like
+    every fused variant.
+    """
+    K = K or int(routes.shape[0])
+    assert int(targets2.shape[0]) == 2 * K, (targets2.shape, K)
+    return _histogram_frontier_routed(binsT, w8, leaf_id, block_list,
+                                      n_blocks, targets2, routes, num_bins,
+                                      block_rows, K, interpret, packed4,
+                                      onehot_build_mode(), n_targets=2 * K)
+
+
 _FUSED_VMEM_CAP = 64 * 1024 * 1024  # ceiling for the auto-sized limit
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_vmem_est_cached(F_phys: int, num_bins: int, K: int, KT: int,
+                           block_rows: int, packed4: bool) -> int:
+    F_log = 2 * F_phys if packed4 else F_phys
+    streams = block_rows * (F_phys + K + 2 * NUM_CHANNELS + 8)
+    out = F_log * num_bins * KT * NUM_CHANNELS * 4
+    return 2 * (3 * streams + 3 * out)
+
+
 def _fused_vmem_est(F_phys: int, num_bins: int, K: int = 1,
-                    block_rows: int = 0, packed4: bool = False) -> int:
+                    block_rows: int = 0, packed4: bool = False,
+                    targets_k: int | None = None) -> int:
     """Scoped-VMEM working-set estimate (bytes) for the fused kernels.
 
     DELIBERATELY conservative: ~2x the plain double-buffered sum,
     calibrated so the measured K=16/F=28/rb=32768 case lands near its
     real 17.14 MB (v5e).  Shared by the ``fused_route_fits`` veto and
-    the ``fused_vmem_limit`` auto-sizing so the two can never drift."""
+    the ``fused_vmem_limit`` auto-sizing so the two can never drift.
+    ``targets_k`` widens the accumulator term independently of the
+    route count (the fused-K kernel carries 2K channel sets over K
+    routes); default = K, the round-pass fusion.  Memoized per
+    (K, KT, F, row_block) shape — policy + dispatch consult it on
+    every grower build and the shape set per process is tiny."""
     F_log = 2 * F_phys if packed4 else F_phys
     if block_rows <= 0:
         block_rows = pick_block_rows(F_log, num_bins)
-    streams = block_rows * (F_phys + K + 2 * NUM_CHANNELS + 8)
-    out = F_log * num_bins * K * NUM_CHANNELS * 4
-    return 2 * (3 * streams + 3 * out)
+    return _fused_vmem_est_cached(F_phys, num_bins, K, targets_k or K,
+                                  block_rows, bool(packed4))
 
 
 def fused_vmem_limit(F_phys: int, num_bins: int, K: int = 1,
-                     block_rows: int = 0, packed4: bool = False) -> int:
+                     block_rows: int = 0, packed4: bool = False,
+                     targets_k: int | None = None) -> int:
     """Auto-sized ``vmem_limit_bytes`` for the fused kernels: 2x the
     conservative working-set estimate, MB-rounded, clamped to
     [16 MB, 64 MB] — the derived replacement for the former hand-set
@@ -1337,7 +1395,8 @@ def fused_vmem_limit(F_phys: int, num_bins: int, K: int = 1,
     Mosaic's 16 MB default).  Recorded as the ``hist/vmem_limit_bytes``
     gauge at dispatch so traces show what the compiler was given."""
     mb = 1024 * 1024
-    est = 2 * _fused_vmem_est(F_phys, num_bins, K, block_rows, packed4)
+    est = 2 * _fused_vmem_est(F_phys, num_bins, K, block_rows, packed4,
+                              targets_k)
     limit = int(min(max(-(-est // mb) * mb, 16 * mb), _FUSED_VMEM_CAP))
     try:
         from ..utils.telemetry import TELEMETRY
@@ -1348,47 +1407,142 @@ def fused_vmem_limit(F_phys: int, num_bins: int, K: int = 1,
 
 
 def fused_route_fits(F_phys: int, num_bins: int, K: int = 1,
-                     block_rows: int = 0, packed4: bool = False) -> bool:
+                     block_rows: int = 0, packed4: bool = False,
+                     targets_k: int | None = None) -> bool:
     """Whether the fused kernels' scoped-VMEM working set fits at this
     shape.  The small-shape self-check can't see production-shape OOMs
     (measured: K=16, F=28, rb=32768 needs 17.14 MB against Mosaic's
     16 MB default), so the auto policy consults this conservative
     estimate against the auto-limit ceiling; LIGHTGBM_TPU_FUSED_ROUTE=1
-    bypasses it for A/Bs on shapes it vetoes."""
-    est = _fused_vmem_est(F_phys, num_bins, K, block_rows, packed4)
+    / LIGHTGBM_TPU_FUSED_K=force bypass it for A/Bs on shapes it
+    vetoes."""
+    est = _fused_vmem_est(F_phys, num_bins, K, block_rows, packed4,
+                          targets_k)
     return est <= int(0.9 * _FUSED_VMEM_CAP)
 
 
 # build-time decisions, keyed "segment"/"frontier" — benches read this to
 # report the kernel that actually ran (the env gate + fits veto make the
-# bare self-check result misleading)
+# bare self-check result misleading).  Values: False, True (K-target
+# round-pass fusion) or the string "fusedk" (2K-children fused-K kernel).
 fused_route_decisions: dict = {}
 
 
-def fused_route_policy(K: int, F_log: int, num_bins: int,
-                       block_rows: int, packed4: bool) -> bool:
-    """The growers' single dispatch policy for the fused route+histogram
-    kernels.
-
-    env force (LIGHTGBM_TPU_FUSED_ROUTE=1) -> on wherever the kernels
-    lower (bypasses both the K policy and the vmem fit veto, for A/Bs);
-    =0 -> off.  Auto: K == 1 only — on-chip (v5e, 2026-08-01) the K=16
-    fused frontier measured 1.43 s/iter vs 1.02-1.04 unfused at the
-    HIGGS shape (the K serial in-block route updates plus K frow
-    streams cost more than the ONE union-pass windowed route they
-    replace) while the K=1 segment fusion won 1.28 vs 1.43 — plus the
-    self-check and the vmem fit estimate."""
+def fused_packed_optin() -> bool:
+    """``LIGHTGBM_TPU_FUSED_PACKED=1``: allow the fused route+histogram
+    kernels to ride the packed int16-accumulator stream.  Default OFF —
+    the growers force the unfused pair whenever packed_acc is on so the
+    on-chip A/B isolates one variant at a time (docs/KERNELS.md); this
+    opt-in makes the combined variant reachable for its own A/B instead
+    of structurally excluded."""
     import os
+    return (os.environ.get("LIGHTGBM_TPU_FUSED_PACKED", "").lower()
+            in ("1", "on", "true", "force"))
+
+
+def fused_k_mode() -> str:
+    """Raw ``LIGHTGBM_TPU_FUSED_K`` ladder: '' (off, the default) |
+    'on' (self-check gated) | 'force' ('force' or a trailing '!'
+    bypasses the check for on-chip A/B plumbing)."""
+    import os
+    env = os.environ.get("LIGHTGBM_TPU_FUSED_K", "").lower()
+    if env in ("", "0", "off", "false"):
+        return ""
+    if env == "force" or env.endswith("!"):
+        return "force"
+    return "on"
+
+
+def fused_k_enabled() -> bool:
+    """Whether the frontier grower may use the fused-K kernel
+    (``histogram_frontier_fusedk``): route + ALL-2K-children histogram
+    in one pass, no parent gather / subtraction.
+
+    Default OFF — no variant flips to default without a v5e number
+    (the expected win — the route passes' ~0.07-0.2 s/iter plus one of
+    the two 0.17 s/iter leaf_hist staging copies — lands in PERF_NOTES
+    round 7 first).  ``1/on`` runs the one-shot bit-identity self-check
+    vs the unfused pair on the live backend, memoized, with clean
+    fallback; ``force``/trailing '!' bypasses.  Dynamic-grid only,
+    like every fused variant."""
+    global _FUSED_K_CHECK
+    mode = fused_k_mode()
+    if not mode:
+        return False
+    if not dyn_grid_enabled():
+        return False
+    if mode == "force":
+        return True
+    if _FUSED_K_CHECK is None:
+        try:
+            _FUSED_K_CHECK = _fused_k_self_check()
+        except Exception:
+            import sys
+            import traceback
+            sys.stderr.write("fused-K self-check raised:\n"
+                             + traceback.format_exc()[-2000:] + "\n")
+            _FUSED_K_CHECK = False
+    return _FUSED_K_CHECK
+
+
+def _fused_k_fallback(reason: str) -> None:
+    """Requested-but-vetoed fused-K build: count it so A/B drivers can
+    tell a measured off leg from a silently fallen-back force leg."""
+    import sys
+    try:
+        from ..utils.telemetry import TELEMETRY
+        TELEMETRY.counter_add("hist/fused_k_fallbacks", 1)
+    except Exception:
+        pass
+    sys.stderr.write(f"fused-K requested but fell back: {reason}\n")
+
+
+def fused_route_policy(K: int, F_log: int, num_bins: int,
+                       block_rows: int, packed4: bool) -> str:
+    """The growers' single dispatch policy for the fused route+histogram
+    kernels.  Returns a tier: "off" | "k1" (K-target round-pass fusion,
+    the kernel the unfused pair's targets match) | "fusedk" (2K-children
+    fused-K kernel, frontier K > 1).
+
+    LIGHTGBM_TPU_FUSED_K (off by default) owns the K > 1 tier: 'on'
+    self-checks + consults the vmem fit at the 2K-wide carry, 'force'
+    bypasses both, and a requested-but-vetoed build counts a
+    ``hist/fused_k_fallbacks`` event before falling through to the
+    LIGHTGBM_TPU_FUSED_ROUTE handling below.
+
+    LIGHTGBM_TPU_FUSED_ROUTE keeps its meaning: =1 -> the K-target
+    fusion wherever the kernels lower (bypasses the K policy and the
+    vmem fit veto, for A/Bs); =0 -> off.  Auto: K == 1 only — on-chip
+    (v5e, 2026-08-01) the K=16 K-target fusion measured 1.43 s/iter vs
+    1.02-1.04 unfused at the HIGGS shape (K serial in-block route
+    updates plus K frow streams cost more than the ONE union-pass
+    windowed route they replace, and the subtraction still ran) while
+    the K=1 segment fusion won 1.28 vs 1.43 — plus the self-check and
+    the vmem fit estimate.  The fused-K tier is the re-cut that also
+    deletes the parent gather + subtraction; its verdict slot is
+    PERF_NOTES round 7."""
+    import os
+    F_phys = (F_log + 1) // 2 if packed4 else F_log
+    if K > 1 and fused_k_mode():
+        if not fused_k_enabled():
+            _fused_k_fallback("self-check failed or dyn-grid off")
+        elif (fused_k_mode() == "force"
+              or fused_route_fits(F_phys, num_bins, K, block_rows,
+                                  packed4, targets_k=2 * K)):
+            return "fusedk"
+        else:
+            _fused_k_fallback("2K-wide carry fails the vmem fit veto")
     env = os.environ.get("LIGHTGBM_TPU_FUSED_ROUTE", "auto").lower()
     if env in ("0", "off", "false"):
-        return False
+        return "off"
     if env in ("1", "on", "true"):
-        return fused_route_available()
+        return "k1" if fused_route_available() else "off"
     if K > 1:
-        return False
-    F_phys = (F_log + 1) // 2 if packed4 else F_log
-    return (fused_route_available()
-            and fused_route_fits(F_phys, num_bins, K, block_rows, packed4))
+        return "off"
+    return ("k1" if (fused_route_available()
+                     and fused_route_fits(F_phys, num_bins, K, block_rows,
+                                          packed4))
+            else "off")
 
 
 def _kernel_route_window(sref, frow_ref, lid_ref, lid_out_ref, *, packed4):
@@ -1741,6 +1895,135 @@ def _fused_route_self_check() -> bool:
     return True
 
 
+_FUSED_K_CHECK: bool | None = None
+
+
+def _fused_k_self_check() -> bool:
+    """Bit-identity of the fused-K kernel (route + ALL 2K children in
+    one pass) vs the unfused pair: numpy-route the ids, then
+    ``histogram_frontier`` over the SAME 2K targets.  Exact equality is
+    the contract — both kernels concat the same masked channel sets
+    into the same one-hot matmul in the same chunk order, so every
+    accumulator column is the identical f32 dot product.  Legs:
+    numeric zero-missing / NaN-missing / categorical-bitset routes,
+    packed4 nibble rows (both parities), EFB group reconstruction."""
+    import numpy as np
+    rng = np.random.default_rng(11)
+
+    def _fail(leg):
+        import sys
+        sys.stderr.write(f"fused-K self-check FAILED leg: {leg}\n")
+        return False
+
+    F, B, rb, nblk = 4, 16, 512, 6
+    n = rb * nblk
+    binsT = jnp.asarray(rng.integers(0, B, (F, n)), jnp.uint8)
+    grad = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    hess = jnp.asarray(rng.uniform(0.5, 1.5, n), jnp.float32)
+    w8 = pack_channels(grad, hess, jnp.ones(n, jnp.float32))
+    # two leaves confined to blocks [1, 4); leaf 7 elsewhere
+    lid_np = np.full(n, 7, np.int32)
+    lid_np[rb:4 * rb] = np.where(rng.random(3 * rb) < 0.5, 3, 5)
+    lid = jnp.asarray(lid_np)
+    bitset = jnp.asarray(rng.integers(0, 2**32, 8, dtype=np.uint64)
+                         .astype(np.uint32))
+    bl = jnp.asarray([1, 2, 3, 0, 0, 0], jnp.int32)
+    nb = jnp.int32(3)
+
+    class _M:  # minimal FeatureMeta-alike for pack_route
+        feat_group = None
+        feat_offset = None
+        missing_type = jnp.asarray([1, 2, 2, 0], jnp.int32)
+        default_bin = jnp.asarray([3, 0, 0, 0], jnp.int32)
+        num_bin = jnp.full((4,), B, jnp.int32)
+
+    def _np_go_left(f, thr, dl, cat):
+        fcol = np.asarray(binsT[f]).astype(np.int64)
+        mt = int(_M.missing_type[f])
+        miss = ((mt == 1) & (fcol == int(_M.default_bin[f]))
+                | (mt == 2) & (fcol == B - 1))
+        if cat:
+            w = np.asarray(bitset)[np.clip(fcol, 0, 255) // 32]
+            return (w >> (np.clip(fcol, 0, 255) % 32)) & 1 > 0
+        return np.where(miss, dl, fcol <= thr)
+
+    # K=2: route flavor under test on leaf 3 + a plain numeric route on
+    # leaf 5 riding along, so the 2K=4-wide accumulate always runs;
+    # f=0 is the zero-missing branch, f=2 the NaN branch (bin B-1
+    # routed by default_left, here False), f=1 the categorical bitset
+    for f, cat, dl in ((0, False, True), (1, True, True),
+                       (2, False, False)):
+        routes = jnp.stack([
+            pack_route(3, 9, f, B // 2, dl, cat, bitset, _M, False),
+            pack_route(5, 10, 3, B // 3, False, False,
+                       jnp.zeros(8, jnp.uint32), _M, False)])
+        targets2 = jnp.asarray([3, 5, 9, 10], jnp.int32)
+        lid2, hist = histogram_frontier_fusedk(
+            binsT, w8, lid, bl, nb, targets2, routes, B, rb, 2)
+        exp = lid_np.copy()
+        exp[(exp == 3) & ~_np_go_left(f, B // 2, dl, cat)] = 9
+        exp[(exp == 5) & ~_np_go_left(3, B // 3, False, False)] = 10
+        if not np.array_equal(np.asarray(lid2), exp):
+            return _fail(f"lid (f={f}, cat={cat})")
+        ref = histogram_frontier(binsT, w8, jnp.asarray(exp), bl, nb,
+                                 targets2, B, rb)
+        if not np.array_equal(np.asarray(hist), np.asarray(ref)):
+            return _fail(f"hist (f={f}, cat={cat})")
+
+    # packed4: both nibble parities across the K routes
+    bins4 = rng.integers(0, 15, (F, n))
+    packedT = jnp.asarray(pack_bins_4bit(bins4))
+
+    class _M4(_M):
+        num_bin = jnp.full((4,), 15, jnp.int32)
+        missing_type = jnp.zeros(4, jnp.int32)
+        default_bin = jnp.zeros(4, jnp.int32)
+
+    routes4 = jnp.stack([pack_route(3, 9, 1, 7, False, False,
+                                    jnp.zeros(8, jnp.uint32), _M4, True),
+                         pack_route(5, 10, 2, 7, False, False,
+                                    jnp.zeros(8, jnp.uint32), _M4, True)])
+    targets2 = jnp.asarray([3, 5, 9, 10], jnp.int32)
+    lid4, hist4 = histogram_frontier_fusedk(
+        packedT, w8, lid, bl, nb, targets2, routes4, 16, rb, 2,
+        packed4=True)
+    exp4 = lid_np.copy()
+    exp4[(exp4 == 3) & (bins4[1].astype(np.int64) > 7)] = 9
+    exp4[(exp4 == 5) & (bins4[2].astype(np.int64) > 7)] = 10
+    if not np.array_equal(np.asarray(lid4), exp4):
+        return _fail("packed4 lid")
+    ref4 = histogram_frontier(packedT, w8, jnp.asarray(exp4), bl, nb,
+                              targets2, 16, rb, packed4=True)
+    if not np.array_equal(np.asarray(hist4), np.asarray(ref4)):
+        return _fail("packed4 hist")
+
+    # EFB: group column carries feature 1 at offset 6; K=1 keeps the
+    # KT=2 > K corner covered (one route, both children accumulated)
+    class _ME(_M):
+        feat_group = jnp.asarray([0, 0, 1, 1], jnp.int32)
+        feat_offset = jnp.asarray([0, 6, 0, 6], jnp.int32)
+        num_bin = jnp.full((4,), 6, jnp.int32)
+        missing_type = jnp.zeros(4, jnp.int32)
+        default_bin = jnp.zeros(4, jnp.int32)
+
+    routes_e = pack_route(3, 9, 1, 2, False, False,
+                          jnp.zeros(8, jnp.uint32), _ME, False)[None]
+    targets_e = jnp.asarray([3, 9], jnp.int32)
+    lid5, hist5 = histogram_frontier_fusedk(
+        binsT, w8, lid, bl, nb, targets_e, routes_e, B, rb, 1)
+    g = np.asarray(binsT[0]).astype(np.int64)
+    fcol = np.where((g >= 6) & (g < 12), g - 6, 0)
+    exp5 = lid_np.copy()
+    exp5[(exp5 == 3) & (fcol > 2)] = 9
+    if not np.array_equal(np.asarray(lid5), exp5):
+        return _fail("efb lid")
+    ref5 = histogram_frontier(binsT, w8, jnp.asarray(exp5), bl, nb,
+                              targets_e, B, rb)
+    if not np.array_equal(np.asarray(hist5), np.asarray(ref5)):
+        return _fail("efb hist")
+    return True
+
+
 # build-time decisions, keyed "segment"/"frontier"/"plain" — benches and
 # telemetry read this to report whether the packed stream actually ran
 # (the env gate + self-check fallback make the bare env value misleading)
@@ -1957,6 +2240,7 @@ def run_kernel_self_checks(verbose: bool = True) -> int:
     process exit code (0 = all green)."""
     checks = [
         ("fused_route", _fused_route_self_check),
+        ("fused_k", _fused_k_self_check),
         ("route_kernel", _route_kernel_self_check),
         ("packed_acc", _packed_acc_self_check),
         ("onehot_gather", lambda: _onehot_build_self_check("gather")),

@@ -91,8 +91,12 @@ def test_bundle_spec_offsets_disjoint():
 
 
 # ------------------------------------------------------- dataset-level EFB
+@pytest.mark.slow
 def test_dataset_bundles_and_matches_dense(rng):
-    # the VERDICT acceptance shape: ~1000 features, 95% sparse
+    # the VERDICT acceptance shape: ~1000 features, 95% sparse.  The two
+    # 30-round trains at F=1000 are ~10 min of CPU histogram compute —
+    # slow tier; test_dataset_bundles_smoke keeps the same on/off parity
+    # assertion in tier-1 at a small shape.
     X, y = make_sparse_binary(rng)
     F = X.shape[1]
     assert F == 1000 and (X == 0).mean() > 0.94
@@ -121,6 +125,30 @@ def test_dataset_bundles_and_matches_dense(rng):
     # (p=0.509 -> logloss ~0.693)
     assert abs(out["on"] - out["off"]) < 0.02
     assert out["on"] < 0.55
+
+
+def test_dataset_bundles_smoke(rng):
+    # tier-1 version of the VERDICT-shape test above: same generator and
+    # same bundled-vs-dense logloss parity assertion at a shape whose two
+    # trains are seconds, not minutes.
+    X, y = make_sparse_binary(rng, n=2000, blocks=12, width=10)
+    F = X.shape[1]
+    cfg_on = Config(objective="binary", verbosity=-1)
+    ds_on = TpuDataset.from_numpy(X, y, config=cfg_on)
+    assert ds_on.bundle is not None
+    assert ds_on.num_columns < F // 2
+    assert ds_on.binned.shape == (X.shape[0], ds_on.num_columns)
+
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbose": -1, "num_leaves": 15, "min_data_in_leaf": 5}
+    out = {}
+    for name, flag in (("on", True), ("off", False)):
+        p = dict(params, enable_bundle=flag)
+        d = lgb.Dataset(X, y, params=p)
+        bst = lgb.train(p, d, num_boost_round=10, verbose_eval=False)
+        out[name] = log_loss(y, bst.predict(X))
+    assert abs(out["on"] - out["off"]) < 0.02
+    assert out["on"] < 0.60
 
 
 def test_bundled_valid_set_and_binary_cache(rng, tmp_path):

@@ -260,7 +260,7 @@ def test_run_kernel_self_checks_green(capsys):
     out = capsys.readouterr().out
     assert "kernel self-checks: PASS" in out
     for name in ("packed_acc", "onehot_gather", "onehot_twolevel",
-                 "hist_stage", "fused_route"):
+                 "hist_stage", "fused_route", "fused_k"):
         assert f"ok {name}" in out, name
 
 
@@ -277,3 +277,25 @@ def test_vmem_limit_autosize():
     gauges = getattr(TELEMETRY, "_gauges", None)
     if gauges is not None:
         assert gauges.get("hist/vmem_limit_bytes") == 16 * mb
+
+
+def test_vmem_est_fused_k_and_memoized():
+    """The fused-K pass carries a 2K-target accumulator: the estimate
+    (and hence the auto limit) must grow with targets_k, stay clamped to
+    the 64 MB cap, and the per-shape estimate is lru_cache-memoized so
+    every grower build at a repeated shape skips the arithmetic."""
+    mb = 1024 * 1024
+    base = ph._fused_vmem_est(28, 64, 16, 32768)
+    wide = ph._fused_vmem_est(28, 64, 16, 32768, targets_k=32)
+    assert wide > base
+    # the 2K carry at the calibration shape still fits under the cap
+    assert ph.fused_vmem_limit(28, 64, 16, 32768, targets_k=32) <= 64 * mb
+    info_before = ph._fused_vmem_est_cached.cache_info()
+    ph._fused_vmem_est(28, 64, 16, 32768, targets_k=32)
+    ph._fused_vmem_est(28, 64, 16, 32768, targets_k=32)
+    info_after = ph._fused_vmem_est_cached.cache_info()
+    assert info_after.misses == info_before.misses
+    assert info_after.hits >= info_before.hits + 2
+    # the fit veto consults the same estimate at the wide carry
+    assert isinstance(ph.fused_route_fits(28, 64, 16, 32768, False,
+                                          targets_k=32), bool)

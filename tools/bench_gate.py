@@ -262,6 +262,38 @@ def self_test():
             {"config": "h", "value": 1.0, "unit": "s/iter",
              "quality_ok": True, "hist_pass_mean_s": None})),
     ]
+    # fused-K ladder records (tools/onchip_r7.py): the fused rounds
+    # dispatch under the grower's own label, so hist_pass_label takes
+    # the "grow/frontier[fused_hist_kK]" shape, and SUITE_CONFIG_TAG
+    # makes the cell its own config series — the gate must baseline the
+    # tagged series against itself, never the untagged defaults
+    fkhist = [{"config": "goss_regression+fusedk8_force", "value": 30.0,
+               "unit": "s", "quality_ok": True,
+               "hist_pass_label": "grow/frontier[fused_hist_k8]",
+               "hist_pass_mean_s": 0.41 + 0.002 * i} for i in range(4)]
+
+    def fkverdict(newest):
+        failures, _ = evaluate(hhist + fkhist + [newest])
+        return bool(failures)
+
+    checks += [
+        ("fused-K labeled record steady passes", not fkverdict(
+            {"config": "goss_regression+fusedk8_force", "value": 30.2,
+             "unit": "s", "quality_ok": True,
+             "hist_pass_label": "grow/frontier[fused_hist_k8]",
+             "hist_pass_mean_s": 0.413})),
+        ("fused-K hist pass regression fails", fkverdict(
+            {"config": "goss_regression+fusedk8_force", "value": 30.2,
+             "unit": "s", "quality_ok": True,
+             "hist_pass_label": "grow/frontier[fused_hist_k8]",
+             "hist_pass_mean_s": 0.60})),
+        ("tagged cell never reads the untagged baseline", not evaluate(
+            hhist + fkhist
+            + [{"config": "goss_regression", "value": 200.0, "unit": "s",
+                "quality_ok": True,
+                "hist_pass_label": "grow/frontier[fused_hist_k8]",
+                "hist_pass_mean_s": 5.0}])[0]),
+    ]
     shist = [{"config": "serve-s-b16-d0", "qps": 1000.0 - 5 * i,
               "p50_s": 0.001, "p99_s": 0.004 + 0.0001 * i,
               "quality_ok": True} for i in range(4)]

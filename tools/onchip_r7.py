@@ -1,0 +1,118 @@
+"""Round-7 unattended on-chip measurement plan: the fused-K ladder.
+
+PR 16 fuses the frontier round's K route updates and ALL 2K child
+histograms into one Pallas pass (``histogram_frontier_fusedk``),
+retiring both the standalone route passes and the per-round
+``[L, G, B, 3]`` leaf_hist gather/scatter.  Interpret-validated only so
+far — the env gate stays OFF until the A/B numbers from this plan land
+in PERF_NOTES.md (same no-default-flip rule every r6 variant followed).
+
+Every bench_suite cell below appends its own ``device_timing`` measured
+record to BENCH_TRAJECTORY.jsonl: DEVICE_TIMING=1 turns on the synced
+dispatch timers, and SUITE_CONFIG_TAG gives each env cell its own
+config series so tools/bench_gate.py's per-config latency baselines
+never mix a forced variant with the defaults.  The fused rounds dispatch
+under the ``grow/frontier[fused_hist_k{K}]`` label ("hist" in the name
+keys the suite's hist-pass rollup to it).
+
+Ordered by value-per-chip-minute:
+
+  1. kernel self-checks on REAL hardware — run_kernel_self_checks now
+     includes ``fused_k``; interpret-green is not lowering-green
+     (ONCHIP_LOG round 4), and the fused-K pass carries the 2K-wide
+     accumulator the auto VMEM limit must absorb.
+  2. fused-K force-vs-off A/B at K ∈ {4, 8, 16} — the headline ladder.
+     "force" bypasses the self-check memo so a lowering failure aborts
+     loudly instead of silently measuring the off leg; the off leg pins
+     FUSED_K=0 so auto can never flip mid-ladder.
+  3. round-carry-staging reference cell (FUSED_K=0 HIST_STAGE=force,
+     K=8) — the best unfused variant from r6, measured in the same
+     session so the fused-vs-staged comparison shares a machine state.
+  4. fused-K x staging combined cell (both forced, K=8) — fused-K
+     disables staging at build time (nothing left to stage); this cell
+     confirms the combination degrades to pure fused-K rather than
+     compounding, and its seg-stats print records the decision.
+
+Usage:
+    python tools/onchip_r7.py          # run everything now
+    python tools/onchip_r7.py --wait   # poll until the chip answers
+    python tools/onchip_r7.py --if-up  # exit fast when the chip is down
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from onchip import PY, REPO, chip_up, log, run_step, wait_for_chip  # noqa: E402
+
+SUITE_CONFIG = "goss_regression"   # frontier-eligible suite config with
+#                                    a CPU-fallback tier, so the whole
+#                                    ladder also runs end-to-end off-chip
+
+BASE_ENV = {
+    "LIGHTGBM_TPU_DEVICE_TIMING": "1",
+    "LIGHTGBM_TPU_SEG_STATS": "1",
+    "LIGHTGBM_TPU_IMPL": "frontier",
+}
+
+
+def suite_cell(name: str, tag: str, env: dict, timeout_s: int = 2400):
+    suite = os.path.join(REPO, "bench_suite.py")
+    run_step(name, [PY, suite, SUITE_CONFIG], timeout_s,
+             dict(BASE_ENV, SUITE_CONFIG_TAG=tag, **env))
+
+
+def main():
+    if "--wait" in sys.argv:
+        if not wait_for_chip(max_wait_s=10 * 3600):
+            log("r7 probe: backend never came up; giving up")
+            sys.exit(3)
+        log("r7 probe: backend UP — running plan r7")
+    elif not chip_up():
+        if "--if-up" in sys.argv:
+            print("backend down; skipping (--if-up)")
+            sys.exit(3)
+        log("r7 probe: backend DOWN; proceeding anyway (CPU fallback)")
+    else:
+        log("r7 probe: backend UP — running plan r7")
+
+    # 1. every kernel-variant self-check (now including fused_k) on the
+    # live backend — same entry point verify_t1.sh --with-kernel-checks
+    # runs on interpret
+    run_step("r7 kernel self-checks on chip", [PY, "-c", (
+        "import sys;"
+        "from lightgbm_tpu.ops.pallas_histogram import "
+        "run_kernel_self_checks;"
+        "sys.exit(run_kernel_self_checks())")], 1800)
+
+    # 2. fused-K force-vs-off ladder.  The off leg pins FUSED_K=0 (auto
+    # could flip once numbers land); both legs pin the frontier width so
+    # the cells measure the K they name.
+    for k in (4, 8, 16):
+        suite_cell(f"r7 fused-K=force K={k}", f"fusedk{k}_force",
+                   {"LIGHTGBM_TPU_FUSED_K": "force",
+                    "LIGHTGBM_TPU_FRONTIER_K": str(k)})
+        suite_cell(f"r7 fused-K=0 K={k}", f"fusedk{k}_off",
+                   {"LIGHTGBM_TPU_FUSED_K": "0",
+                    "LIGHTGBM_TPU_FRONTIER_K": str(k)})
+
+    # 3. round-carry staging reference (the r6 winner candidate) in the
+    # same session as the fused cells it is compared against
+    suite_cell("r7 staged-unfused reference K=8", "stage_ref_k8",
+               {"LIGHTGBM_TPU_FUSED_K": "0",
+                "LIGHTGBM_TPU_HIST_STAGE": "force",
+                "LIGHTGBM_TPU_FRONTIER_K": "8"})
+
+    # 4. combined cell: fused-K wins the conflict at build time (staging
+    # has nothing to stage when no round reads leaf_hist) — confirm the
+    # combination degrades to pure fused-K instead of compounding
+    suite_cell("r7 fused-K x HIST_STAGE combined K=8", "fusedk8_stage",
+               {"LIGHTGBM_TPU_FUSED_K": "force",
+                "LIGHTGBM_TPU_HIST_STAGE": "force",
+                "LIGHTGBM_TPU_FRONTIER_K": "8"})
+
+    log("plan r7 complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,8 @@
 #                                             writes no artifacts)
 #        bash tools/verify_t1.sh --with-kernel-checks (also run every
 #                                             kernel variant self-check —
-#                                             fused route, packed
+#                                             fused route, fused-K
+#                                             route+histogram, packed
 #                                             accumulator, one-hot builds,
 #                                             round-carry staging — on the
 #                                             CPU interpret backend so CI
