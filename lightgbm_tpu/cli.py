@@ -156,7 +156,7 @@ class Application:
         # killed+resumed run yields ONE contiguous stream
         health_path = HEALTH.resolve_path(cfg)
         if health_path:
-            meta = {"source": "cli",
+            meta = {"source": "cli", "stream": "train",
                     "num_iterations": int(cfg.num_iterations)}
             if dist_active:
                 meta["rank"] = distributed.rank()
@@ -165,6 +165,14 @@ class Application:
                 health_path,
                 resume_iter=done if resume_snap is not None else None,
                 meta=meta)
+
+        # fleet observability plane (obs/, metrics v6): measure the
+        # clock-offset table here — the one aligned point where the
+        # blocking ping/pong collective cannot interleave with any
+        # other — then post/collect attribution windows at iteration
+        # boundaries (never blocking) and once, blocking, at summary
+        from .obs import fleet as fleet_obs
+        fleet_obs.start(cfg)
 
         log_info(f"Started training for {cfg.num_iterations} iterations")
         start = time.perf_counter()
@@ -287,6 +295,11 @@ class Application:
                             and (it + 1) % cfg.snapshot_freq == 0):
                         self._write_snapshot(booster, it + 1)
                     FAULTS.maybe_raise("train/kill", n=it)
+                    if dist_active:
+                        # fleet plane window post/collect — non-blocking
+                        # by contract, so it cannot race the preemption
+                        # negotiate below
+                        fleet_obs.maybe_sync(done)
                     if dist_active and preempt_target is None:
                         # deterministic preemption injection: the
                         # dist/preempt site stands in for a scheduler
@@ -321,6 +334,12 @@ class Application:
                         break
                     log_info(f"{time.perf_counter() - start:.6f} seconds "
                              f"elapsed, finished iteration {it + 1}")
+                if dist_active and not preempted:
+                    # summary sync: post the final attribution window
+                    # and collect everything pending.  Blocking is safe
+                    # (and bounded) only here: every rank reaches this
+                    # aligned point on the normal-completion path
+                    fleet_obs.final_sync(done)
         except BaseException:
             failed = True
             raise

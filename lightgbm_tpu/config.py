@@ -327,6 +327,16 @@ _PARAMS: Dict[str, _P] = {
     # same never-torn O_APPEND writer training uses; tail it with
     # tools/sched_monitor.py.  "" = no stream
     "sched_health_out": _P(""),
+    # fleet observability plane (obs/, metrics v6): every N iterations
+    # ranks kv-allgather their per-collective enter/duration windows,
+    # split collective wall into wait vs work seconds, and name the
+    # straggler rank in a dist_window health record.  0 = sync only at
+    # summary.  Multi-host runs only; host-side timing, so trained
+    # models stay byte-identical with any value
+    "fleet_obs_sync_iters": _P(0),
+    # ping/pong exchanges per clock-offset estimate (obs/clockskew.py);
+    # the minimum-RTT sample wins, so more pings tighten the bound
+    "fleet_obs_clock_pings": _P(5),
 }
 
 # runtime-only knobs excluded from a saved model's ``parameters:``
@@ -346,7 +356,11 @@ RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection",
                                  "serve_health_window_s",
                                  "sched", "sched_quantum_chunks",
                                  "sched_policy", "sched_max_jobs",
-                                 "sched_health_out"])
+                                 "sched_health_out",
+                                 "telemetry_level", "metrics_out",
+                                 "health_out",
+                                 "fleet_obs_sync_iters",
+                                 "fleet_obs_clock_pings"])
 
 # alias -> canonical name
 ALIAS_TABLE: Dict[str, str] = {}
@@ -571,6 +585,10 @@ class Config:
             raise ValueError("sched_quantum_chunks must be >= 1")
         if self.sched_max_jobs < 1:
             raise ValueError("sched_max_jobs must be >= 1")
+        if self.fleet_obs_sync_iters < 0:
+            raise ValueError("fleet_obs_sync_iters must be >= 0")
+        if self.fleet_obs_clock_pings < 1:
+            raise ValueError("fleet_obs_clock_pings must be >= 1")
 
     # -- accessors --
     def to_dict(self) -> Dict[str, Any]:

@@ -1,0 +1,22 @@
+"""Fleet observability plane (metrics schema v6).
+
+Per-rank telemetry (utils/telemetry.py) and per-subsystem health
+streams answer "what did THIS process do" — this package answers the
+cross-rank question those cannot: *which rank is the straggler, and is
+it compute or the collective?*
+
+  * :mod:`clockskew` — per-rank monotonic clock offsets estimated from
+    KV-store ping/pong exchanges (NTP midpoint method, error bounded by
+    the exchange RTT), so per-rank ``mono_ts`` stamps and trace epochs
+    map onto one fleet timeline.
+  * :mod:`fleet` — the attribution sync: ranks kv-allgather their
+    per-collective {call, enter, seconds} windows, split collective
+    wall into *wait* (skew-corrected idle before the slowest rank
+    arrives) vs *work* (transfer/reduce) seconds, and name the
+    straggler rank per window in the health stream.
+
+Everything here is host-side timing and IO — trained models stay
+byte-identical with the plane on or off.
+"""
+
+from . import clockskew, fleet  # noqa: F401

@@ -1,0 +1,197 @@
+"""Per-rank monotonic clock-offset estimation over the KV store.
+
+Each host's ``time.monotonic()`` has an arbitrary epoch, so per-rank
+``mono_ts`` stamps and trace timestamps cannot be compared across ranks
+directly.  This module estimates, for every rank, the offset that maps
+its monotonic clock onto rank 0's — the classic NTP midpoint method
+(RFC 5905 §8) run over the same coordination-service KV store the
+barriers use:
+
+    rank r                         rank 0 (time server)
+    t1 = mono(); post ping ───────▶ t2 = mono() on receipt
+                                    t3 = mono(); post pong(t2, t3)
+    t4 = mono() ◀──────────────────
+
+    offset(rank0 − rank r) = ((t2 − t1) + (t3 − t4)) / 2
+    error bound            = ((t4 − t1) − (t3 − t2)) / 2   (± RTT/2)
+
+Several exchanges are run and the minimum-RTT sample wins (queueing
+delay only ever inflates the bound, never deflates it).  The resulting
+offset table is allgathered so every rank can correct every other
+rank's timestamps, and is emitted as a ``dist_clock`` health record —
+the anchor ``tools/fleet_trace.py`` and ``obs/fleet.py`` use to build
+one skew-corrected fleet timeline.
+
+The estimator core (:func:`midpoint_offset`, :func:`combine_pings`) is
+pure so the unit tests can drive it with synthetic clocks; only
+:func:`measure_fleet_offsets` touches the KV store.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.log import LightGBMError
+
+# KV namespace for ping/pong exchanges (under the coordination service's
+# flat store, like lgbm/ag and lgbm/bar in parallel/distributed.py)
+_CLK_PREFIX = "lgbm/clk"
+
+# per-process exchange generation — every rank must run the same number
+# of measurement rounds in the same order (same contract as the
+# allgather/barrier generation counters)
+_clk_gen = 0
+
+# the last measured fleet offset table: {rank: {"offset_s", "bound_s"}}
+_offsets: Optional[Dict[int, Dict[str, float]]] = None
+
+
+# ------------------------------------------------------------------ estimator
+def midpoint_offset(t1: float, t2: float, t3: float, t4: float,
+                    ) -> Tuple[float, float]:
+    """NTP midpoint estimate from one ping/pong exchange.
+
+    ``t1``/``t4`` are the client's clock at send/receive; ``t2``/``t3``
+    the server's at receive/send.  Returns ``(offset, bound)`` where
+    ``offset`` is (server clock − client clock) and the true offset
+    lies within ``offset ± bound`` (bound = half the one-way ambiguity,
+    i.e. RTT/2 minus the server's processing time)."""
+    offset = ((t2 - t1) + (t3 - t4)) / 2.0
+    bound = max(0.0, ((t4 - t1) - (t3 - t2)) / 2.0)
+    return offset, bound
+
+
+def combine_pings(samples: Sequence[Tuple[float, float, float, float]],
+                  ) -> Tuple[float, float, float]:
+    """Fold several ping/pong exchanges into one estimate by taking the
+    minimum-RTT sample (delay is strictly additive noise: a queued
+    exchange widens the bound but cannot shrink it).  Returns
+    ``(offset, bound, rtt)`` of the winning sample."""
+    if not samples:
+        raise ValueError("combine_pings needs at least one sample")
+    best = None
+    for t1, t2, t3, t4 in samples:
+        rtt = max(0.0, (t4 - t1) - (t3 - t2))
+        offset, bound = midpoint_offset(t1, t2, t3, t4)
+        if best is None or rtt < best[2]:
+            best = (offset, bound, rtt)
+    return best
+
+
+def correct(mono_ts: float, rank: int,
+            offsets: Optional[Dict[int, Dict[str, float]]] = None,
+            ) -> float:
+    """Map ``rank``'s monotonic timestamp onto the fleet timeline
+    (rank 0's clock).  Identity when no table is available — correct
+    for single-host fleets, where every process shares one clock."""
+    table = offsets if offsets is not None else _offsets
+    if not table:
+        return mono_ts
+    entry = table.get(rank) or table.get(str(rank))
+    if not entry:
+        return mono_ts
+    return mono_ts + float(entry["offset_s"])
+
+
+def current_offsets() -> Optional[Dict[int, Dict[str, float]]]:
+    """The last measured offset table, or ``None``."""
+    return _offsets
+
+
+def reset() -> None:
+    """Drop measurement state (test windows / dispose)."""
+    global _clk_gen, _offsets
+    _clk_gen = 0
+    _offsets = None
+
+
+# ------------------------------------------------------------- KV measurement
+def measure_fleet_offsets(pings: int = 5,
+                          timeout_s: Optional[float] = None,
+                          ) -> Dict[int, Dict[str, float]]:
+    """Collective: estimate every rank's monotonic offset to rank 0.
+
+    Every rank must call this at the same logical point (obs/fleet.py
+    calls it from its synchronized window sync).  Rank 0 acts as the
+    time server: for each peer rank and each of ``pings`` rounds it
+    blocks on the peer's ping key, stamps ``t2``/``t3``, and posts the
+    pong; peers time ``t1``/``t4`` around the exchange and keep the
+    minimum-RTT sample.  The per-rank results are then allgathered so
+    all ranks hold the same table, which is stored module-wide, emitted
+    as a ``dist_clock`` health record, and returned.
+
+    Single-process worlds return the trivial ``{0: 0}`` table without
+    touching the KV store."""
+    global _clk_gen, _offsets
+    from ..parallel import distributed, network
+
+    me, n = distributed.rank(), distributed.world()
+    if not distributed.is_active():
+        _offsets = {0: {"offset_s": 0.0, "bound_s": 0.0, "rtt_s": 0.0}}
+        return _offsets
+    c = distributed.client()
+    if timeout_s is None:
+        timeout_s = network.collective_policy()[1]
+    gen = _clk_gen
+    _clk_gen += 1
+    prefix = f"{_CLK_PREFIX}/{gen}"
+    deadline = time.perf_counter() + max(0.001, timeout_s)
+    pings = max(1, int(pings))
+
+    try:
+        if me == 0:
+            # time server: serve each peer's exchanges in rank order.
+            # Waiting inflates that exchange's RTT (and so its bound) —
+            # never its accuracy — and min-RTT selection discards it.
+            for r in range(1, n):
+                for i in range(pings):
+                    c.blocking_key_value_get(
+                        f"{prefix}/{r}/{i}/ping",
+                        distributed._remaining_ms(deadline))
+                    t2 = time.monotonic()
+                    t3 = time.monotonic()
+                    c.key_value_set(f"{prefix}/{r}/{i}/pong",
+                                    f"{t2!r},{t3!r}",
+                                    allow_overwrite=True)
+            mine = {"rank": 0, "offset_s": 0.0, "bound_s": 0.0,
+                    "rtt_s": 0.0}
+        else:
+            samples: List[Tuple[float, float, float, float]] = []
+            for i in range(pings):
+                t1 = time.monotonic()
+                c.key_value_set(f"{prefix}/{me}/{i}/ping", "1",
+                                allow_overwrite=True)
+                pong = c.blocking_key_value_get(
+                    f"{prefix}/{me}/{i}/pong",
+                    distributed._remaining_ms(deadline))
+                t4 = time.monotonic()
+                t2_s, t3_s = pong.split(",")
+                samples.append((t1, float(t2_s), float(t3_s), t4))
+            offset, bound, rtt = combine_pings(samples)
+            mine = {"rank": me, "offset_s": round(offset, 6),
+                    "bound_s": round(bound, 6), "rtt_s": round(rtt, 6)}
+    except LightGBMError:
+        raise
+    except Exception as e:  # noqa: BLE001 — deadline or service loss
+        raise LightGBMError(
+            f"clock-offset exchange timed out after {timeout_s:g}s "
+            f"(rank {me} of world {n}, generation {gen}) — a host died "
+            f"or is partitioned: {e}") from e
+
+    table = {}
+    for entry in network.allgather_obj(mine):
+        table[int(entry["rank"])] = {
+            "offset_s": float(entry["offset_s"]),
+            "bound_s": float(entry["bound_s"]),
+            "rtt_s": float(entry["rtt_s"])}
+    _offsets = table
+    distributed._health(
+        "clock", offset_s=table.get(me, {}).get("offset_s", 0.0),
+        bound_s=table.get(me, {}).get("bound_s", 0.0))
+    from ..utils.telemetry import HEALTH
+    if HEALTH.active:
+        HEALTH.record("dist_clock", {
+            "rank": me, "world": n, "pings": pings,
+            "offsets": {str(r): v for r, v in sorted(table.items())}})
+    return table

@@ -1,0 +1,359 @@
+"""Cross-rank collective wait-vs-work attribution (the v6 fleet sync).
+
+PR 2's per-collective counters reproduce the reference fork's
+linkers.h byte/time accounting, but per rank: a collective's measured
+wall conflates *waiting for the slowest rank to arrive* with *actually
+moving bytes*.  This module completes them cross-rank.  At every sync
+point (the ``fleet_obs_sync_iters`` cadence plus once at summary) all
+ranks kv-allgather the per-collective ``(call_index, enter_mono,
+seconds)`` windows ``parallel/network.py`` accumulated since the last
+sync.  Because every rank issues collectives in the same order,
+``(kind, call_index)`` names the same logical collective on every
+rank; with the clock-offset table from :mod:`clockskew` the per-rank
+entry times become comparable and each rank's wall splits into
+
+    wait = min(dur, slowest corrected enter − own corrected enter)
+    work = dur − wait
+
+accumulated into the ``dist/wait_s`` / ``dist/work_s`` counter pair
+and a ``dist_window`` health record naming the straggler (the rank
+with the largest total lateness) per window.
+
+The attribution core (:func:`attribute_window`) is pure.  The sync
+protocol is deliberately **eager-post / lazy-collect**: at each
+deterministic iteration threshold every rank *posts* its drained
+window under ``lgbm/fleet/{seq}/{rank}`` (a non-blocking KV set) and
+*tries* to collect peers' tables with a non-blocking directory read,
+deferring attribution until all ranks' tables for a seq are present.
+Mid-loop blocking gathers are forbidden here because their pairing
+would race the preemption flow's notice-triggered allgather (notice
+visibility differs across ranks, so blind generation counters could
+cross-pair payloads or deadlock); only :func:`final_sync` — called at
+the aligned end-of-training point, where no other collective can
+interleave — blocks, with a bounded deadline and graceful degradation.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from . import clockskew
+
+# KV namespace for posted windows (coordination-service flat store).
+# Keys are never deleted mid-run: a peer may collect a window
+# arbitrarily late, and a finite run posts a bounded number of small
+# (≤ ~100KB) tables — final_sync's own-key GC reclaims them at exit.
+_FLEET_PREFIX = "lgbm/fleet"
+
+# config knobs, bound by configure(); runtime-only — they never enter a
+# model's parameter section, so the plane cannot break byte-identity
+_sync_iters = 0
+_clock_pings = 5
+_next_sync: Optional[int] = None
+_sync_seq = 0                 # next window sequence number to post
+_pending: List[int] = []      # posted seqs not yet fully collected
+
+# window aggregates feeding the stats() ``fleet`` section
+_windows = 0
+_per_rank: Dict[int, Dict[str, float]] = {}
+_straggler_hist: Dict[int, int] = {}
+
+
+def configure(config=None) -> None:
+    """Bind the ``fleet_obs_*`` knobs and reset window aggregates.
+    Called at the top of every training run (same lifecycle as
+    ``TELEMETRY.set_config_level``)."""
+    global _sync_iters, _clock_pings, _next_sync
+    reset()
+    if config is not None:
+        _sync_iters = int(getattr(config, "fleet_obs_sync_iters", 0) or 0)
+        _clock_pings = max(1, int(
+            getattr(config, "fleet_obs_clock_pings", 5) or 5))
+    _next_sync = _sync_iters if _sync_iters > 0 else None
+
+
+def reset() -> None:
+    """Drop knobs and aggregates (test/bench windows)."""
+    global _sync_iters, _clock_pings, _next_sync, _windows
+    global _per_rank, _straggler_hist, _sync_seq, _pending
+    _sync_iters = 0
+    _clock_pings = 5
+    _next_sync = None
+    _sync_seq = 0
+    _pending = []
+    _windows = 0
+    _per_rank = {}
+    _straggler_hist = {}
+    clockskew.reset()
+
+
+# ---------------------------------------------------------------- attribution
+def attribute_window(tables: Dict[int, Dict[str, list]],
+                     offsets: Optional[Dict[int, Dict[str, float]]] = None,
+                     ) -> Optional[Dict[str, Any]]:
+    """Split each rank's collective wall into wait vs work seconds.
+
+    ``tables`` maps rank -> {kind: [(call_index, enter_mono, seconds),
+    ...]} as drained by ``network.take_collective_window()`` on each
+    rank; ``offsets`` is the clockskew table (identity when ``None``).
+    Only ``(kind, call_index)`` pairs present on EVERY rank are
+    attributed — a call one rank dropped from its bounded window (or
+    has not issued yet) cannot be split and is skipped.  Returns
+    ``None`` when nothing pairs, else::
+
+        {"calls": N, "per_rank": {rank: {wait_s, work_s, calls}},
+         "straggler": rank-or-None, "lateness_s": {rank: total}}
+
+    The straggler is the rank with the largest summed lateness (its
+    corrected enter minus the earliest rank's, over paired calls)."""
+    ranks = sorted(tables)
+    if len(ranks) < 2:
+        return None
+    per_rank = {r: {"wait_s": 0.0, "work_s": 0.0, "calls": 0}
+                for r in ranks}
+    lateness = {r: 0.0 for r in ranks}
+    paired = 0
+    kinds = set()
+    for k in tables[ranks[0]]:
+        if all(k in tables[r] for r in ranks):
+            kinds.add(k)
+    for kind in sorted(kinds):
+        by_rank = {r: {int(i): (float(e), float(s))
+                       for i, e, s in tables[r][kind]} for r in ranks}
+        common = set(by_rank[ranks[0]])
+        for r in ranks[1:]:
+            common &= set(by_rank[r])
+        for idx in sorted(common):
+            enters = {r: clockskew.correct(by_rank[r][idx][0], r, offsets)
+                      for r in ranks}
+            slowest = max(enters.values())
+            earliest = min(enters.values())
+            paired += 1
+            for r in ranks:
+                dur = by_rank[r][idx][1]
+                wait = min(max(0.0, slowest - enters[r]), max(0.0, dur))
+                per_rank[r]["wait_s"] += wait
+                per_rank[r]["work_s"] += max(0.0, dur - wait)
+                per_rank[r]["calls"] += 1
+                lateness[r] += enters[r] - earliest
+    if not paired:
+        return None
+    straggler = max(ranks, key=lambda r: lateness[r])
+    if lateness[straggler] <= 0.0:
+        straggler = None
+    return {
+        "calls": paired,
+        "per_rank": {r: {"wait_s": round(v["wait_s"], 6),
+                         "work_s": round(v["work_s"], 6),
+                         "calls": v["calls"]}
+                     for r, v in per_rank.items()},
+        "straggler": straggler,
+        "lateness_s": {r: round(v, 6) for r, v in lateness.items()},
+    }
+
+
+# ----------------------------------------------------------------- sync points
+def start(config=None) -> None:
+    """Bring the plane up for a training run: bind knobs and measure
+    the clock-offset table.  The measurement is a COLLECTIVE (blocking
+    ping/pong + allgather), so the CLI calls this at the one guaranteed
+    aligned point — after data loading/resume, before the training
+    loop — where no other collective can interleave.  No-op beyond
+    configure() on 1-process worlds."""
+    from ..parallel import distributed
+    configure(config)
+    if distributed.is_active():
+        clockskew.measure_fleet_offsets(_clock_pings)
+
+
+def maybe_sync(done: int) -> None:
+    """Iteration-boundary hook (never blocks): when ``done`` crosses
+    the ``fleet_obs_sync_iters`` cadence, drain-and-post this rank's
+    window; then opportunistically collect any fully-posted pending
+    windows.  ``done`` advances identically on every rank, so all
+    ranks post the same window sequence at the same thresholds."""
+    global _next_sync
+    from ..parallel import distributed
+    if not distributed.is_active():
+        return
+    if _next_sync is not None and done >= _next_sync:
+        while _next_sync <= done:
+            _next_sync += _sync_iters
+        _post_window(done)
+    if _pending:
+        _collect_pending(blocking=False)
+
+
+def final_sync(done: int, timeout_s: Optional[float] = None) -> None:
+    """Summary sync: post the final window and collect everything
+    pending, BLOCKING with a bounded deadline.  Safe to block only
+    because every rank calls this at the same aligned point (normal
+    end of training, never the preempt/crash path).  A peer that died
+    degrades to a warning — observability must not fail a finished
+    run."""
+    from ..parallel import distributed, network
+    from ..utils.log import log_warning
+    if not distributed.is_active():
+        return
+    if timeout_s is None:
+        timeout_s = network.collective_policy()[1]
+    _post_window(done)
+    try:
+        _collect_pending(blocking=True, timeout_s=timeout_s)
+    except Exception as e:  # noqa: BLE001 — peer death degrades
+        log_warning(f"fleet final sync incomplete ({e}); "
+                    f"{len(_pending)} window(s) unattributed")
+    # GC own posted payloads: every peer that will ever collect them
+    # has just finished its own blocking collection or died
+    c = distributed.client()
+    me = distributed.rank()
+    if c is not None:
+        for seq in range(_sync_seq):
+            try:
+                c.key_value_delete(f"{_FLEET_PREFIX}/{seq}/{me}")
+            except Exception:  # noqa: BLE001 — GC is best-effort
+                pass
+
+
+def _post_window(iteration: int) -> None:
+    """Drain this rank's collective window and post it (one
+    non-blocking KV set) under the next window sequence number."""
+    global _sync_seq
+    from ..parallel import distributed, network
+    from ..utils.log import log_warning
+    c = distributed.client()
+    if c is None:
+        return
+    me = distributed.rank()
+    window = network.take_collective_window()
+    seq = _sync_seq
+    _sync_seq += 1
+    payload = json.dumps({"rank": me, "iter": int(iteration),
+                          "window": window}, separators=(",", ":"))
+    try:
+        c.key_value_set(f"{_FLEET_PREFIX}/{seq}/{me}", payload,
+                        allow_overwrite=True)
+    except Exception as e:  # noqa: BLE001 — coordinator loss degrades
+        log_warning(f"fleet window post failed ({e}); window dropped")
+        return
+    _pending.append(seq)
+
+
+def _collect_pending(blocking: bool,
+                     timeout_s: float = 0.0) -> None:
+    """Attribute every pending window whose tables are complete.
+    Non-blocking mode peeks with one directory read per window and
+    leaves incomplete ones pending; blocking mode waits (shared
+    deadline) for every rank's table."""
+    from ..parallel import distributed
+    c = distributed.client()
+    if c is None:
+        return
+    n = distributed.world()
+    deadline = time.perf_counter() + max(0.001, timeout_s)
+    for seq in list(_pending):
+        tables: Dict[int, Dict[str, list]] = {}
+        iteration = 0
+        try:
+            if blocking:
+                vals = [c.blocking_key_value_get(
+                            f"{_FLEET_PREFIX}/{seq}/{r}",
+                            distributed._remaining_ms(deadline))
+                        for r in range(n)]
+            else:
+                pairs = c.key_value_dir_get(f"{_FLEET_PREFIX}/{seq}/")
+                if len(pairs) < n:
+                    continue            # a rank has not posted yet
+                vals = [v for _k, v in pairs]
+        except Exception:  # noqa: BLE001 — absent key / deadline
+            if blocking:
+                raise
+            continue
+        for v in vals:
+            entry = json.loads(v)
+            tables[int(entry["rank"])] = entry["window"]
+            iteration = max(iteration, int(entry["iter"]))
+        _pending.remove(seq)
+        _attribute_and_emit(tables, iteration, seq)
+
+
+def _attribute_and_emit(tables: Dict[int, Dict[str, list]],
+                        iteration: int, seq: int) -> None:
+    """Run attribution over one complete window set, bump the
+    ``dist/wait_s``/``dist/work_s`` counters, fold the aggregates, and
+    emit the ``dist_window`` health record naming the straggler."""
+    global _windows
+    from ..parallel import distributed
+    from ..utils.telemetry import HEALTH, TELEMETRY
+    report = attribute_window(tables, clockskew.current_offsets())
+    if report is None:
+        return
+    me, n = distributed.rank(), distributed.world()
+    mine = report["per_rank"].get(me, {"wait_s": 0.0, "work_s": 0.0})
+    TELEMETRY.counter_add("dist/wait_s", mine["wait_s"])
+    TELEMETRY.counter_add("dist/work_s", mine["work_s"])
+    _windows += 1
+    for r, v in report["per_rank"].items():
+        agg = _per_rank.setdefault(r, {"wait_s": 0.0, "work_s": 0.0,
+                                       "calls": 0})
+        agg["wait_s"] += v["wait_s"]
+        agg["work_s"] += v["work_s"]
+        agg["calls"] += v["calls"]
+    if report["straggler"] is not None:
+        _straggler_hist[report["straggler"]] = (
+            _straggler_hist.get(report["straggler"], 0) + 1)
+    if HEALTH.active:
+        HEALTH.record("dist_window", {
+            "rank": me, "world": n, "iter": int(iteration),
+            "seq": int(seq), "calls": report["calls"],
+            "wait_s": mine["wait_s"], "work_s": mine["work_s"],
+            "straggler": report["straggler"],
+            "per_rank": {str(r): v
+                         for r, v in report["per_rank"].items()},
+            "lateness_s": {str(r): v
+                           for r, v in report["lateness_s"].items()},
+        })
+
+
+# -------------------------------------------------------------------- digests
+def fleet_section() -> Optional[Dict[str, Any]]:
+    """The ``fleet`` section of ``TELEMETRY.stats()`` — ``None`` until
+    a window synced, so v6 blobs from non-fleet runs stay v5-shaped."""
+    if not _windows:
+        return None
+    out: Dict[str, Any] = {
+        "windows": _windows,
+        "sync_iters": _sync_iters,
+        "per_rank": {},
+        "straggler_hist": {str(r): c
+                           for r, c in sorted(_straggler_hist.items())},
+    }
+    for r, v in sorted(_per_rank.items()):
+        total = v["wait_s"] + v["work_s"]
+        out["per_rank"][str(r)] = {
+            "wait_s": round(v["wait_s"], 6),
+            "work_s": round(v["work_s"], 6),
+            "calls": v["calls"],
+            "wait_fraction": round(v["wait_s"] / total, 6) if total else 0.0,
+        }
+    offsets = clockskew.current_offsets()
+    if offsets:
+        out["clock_offsets"] = {str(r): v
+                                for r, v in sorted(offsets.items())}
+    return out
+
+
+def summary_line() -> str:
+    """One-line rendering for the phase summary; empty until a window
+    synced."""
+    if not _windows:
+        return ""
+    wait = sum(v["wait_s"] for v in _per_rank.values())
+    work = sum(v["work_s"] for v in _per_rank.values())
+    parts = [f"fleet windows={_windows} wait={wait:.3f}s work={work:.3f}s"]
+    if _straggler_hist:
+        top = max(_straggler_hist, key=_straggler_hist.get)
+        parts.append(f"straggler=rank{top}({_straggler_hist[top]}x)")
+    return " ".join(parts)

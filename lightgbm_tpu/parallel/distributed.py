@@ -152,6 +152,27 @@ def _health(event: str, **fields) -> None:
     HEALTH.record("dist", rec)
 
 
+def probe_slow() -> None:
+    """Deterministic straggler injection: the ``dist/slow`` fault site,
+    probed at every host-side collective ENTRY.  Unlike every other
+    site it does not fail the operation — a fired spec converts into a
+    fixed sleep (``LIGHTGBM_TPU_SLOW_MS``, default 300ms) before this
+    rank enters the collective, making the armed rank arrive last and
+    exercising the fleet plane's wait-vs-work attribution end to end
+    (the fault_matrix fleet pass and the 2-process straggler test)."""
+    from ..utils.faults import FAULTS, InjectedFault
+    if not FAULTS.enabled:
+        return
+    try:
+        FAULTS.maybe_raise("dist/slow")
+    except InjectedFault:
+        from ..utils.telemetry import TELEMETRY
+        delay = float(os.environ.get("LIGHTGBM_TPU_SLOW_MS", "300")) / 1e3
+        TELEMETRY.fault_event("injected_slow", site="dist/slow",
+                              detail=f"sleep {delay:g}s rank {rank()}")
+        time.sleep(delay)
+
+
 # ------------------------------------------------------------------ detection
 def detect_launch(config=None) -> Optional[Tuple[str, int, int]]:
     """Resolve ``(coordinator_address, num_hosts, host_rank)`` from the
@@ -327,12 +348,15 @@ def barrier(name: str, timeout_s: Optional[float] = None) -> float:
     announcement against the shared budget; on expiry the error names
     exactly the ranks that never arrived.  Probes the deterministic
     ``collective/barrier`` fault site per call, and records the wait in
-    the per-collective counters plus a ``dist`` health record."""
+    the per-collective counters plus a ``dist`` health record carrying
+    this rank's monotonic enter/exit pair (the raw material for the
+    fleet plane's skew-corrected straggler attribution)."""
     from ..utils.faults import FAULTS
     from . import network
     if not is_active():
         return 0.0
     FAULTS.maybe_raise("collective/barrier")
+    probe_slow()
     if timeout_s is None:
         timeout_s = network.collective_policy()[1]
     c = client()
@@ -340,6 +364,7 @@ def barrier(name: str, timeout_s: Optional[float] = None) -> float:
     gen = _state.bar_gen
     _state.bar_gen += 1
     prefix = f"{_BAR_PREFIX}/{name}/{gen}"
+    enter_mono = time.monotonic()
     c.key_value_set(f"{prefix}/{me}", "1", allow_overwrite=True)
     t0 = time.perf_counter()
     deadline = t0 + max(0.001, timeout_s)
@@ -361,8 +386,11 @@ def barrier(name: str, timeout_s: Optional[float] = None) -> float:
             f"rank(s) {arrived or '[]'} arrived) — a host died or is "
             "partitioned; restart the fleet with resume=true to "
             "continue from the elected snapshot")
-    network.record_collective("barrier", 0, wait)
-    _health("barrier", name=name, wait_s=round(wait, 6))
+    exit_mono = time.monotonic()
+    network.record_collective("barrier", 0, wait, enter_mono=enter_mono)
+    _health("barrier", name=name, wait_s=round(wait, 6),
+            enter_mono=round(enter_mono, 6),
+            exit_mono=round(exit_mono, 6))
     return wait
 
 

@@ -67,14 +67,28 @@ _coll_lock = threading.Lock()
 _collectives: Dict[str, Dict[str, float]] = {}
 _coll_writer: Optional[int] = None
 _coll_race_warned = False
+# v6 fleet plane: per-kind window of (call_index, enter mono_ts,
+# seconds) samples since the last take_collective_window().  Every rank
+# issues collectives in the same order, so (kind, call_index) pairs the
+# same logical collective across ranks after a kv-allgather — that pair
+# is what obs/fleet.py splits into wait vs work seconds.  Bounded per
+# kind; the call index keeps pairing correct even after drops.
+_COLL_WINDOW_CAP = 4096
+_coll_window: Dict[str, list] = {}
 
 
 def record_collective(kind: str, nbytes: float = 0,
-                      seconds: float = 0.0, calls: int = 1) -> None:
+                      seconds: float = 0.0, calls: int = 1,
+                      enter_mono: Optional[float] = None) -> None:
     """Accumulate one collective's stats under ``kind``.  Thread-safe,
     with the reference Network's single-writer check relaxed to a
     warning (include/LightGBM/network.h keeps all collectives on one
-    thread; here a second writer is flagged, not fatal)."""
+    thread; here a second writer is flagged, not fatal).
+
+    ``enter_mono`` — the ``time.monotonic()`` instant this rank ENTERED
+    the collective (before any peer wait) — additionally feeds the
+    fleet-plane attribution window; callers that cannot observe entry
+    (async device dispatch) omit it and stay out of the window."""
     global _coll_writer, _coll_race_warned
     from ..utils.telemetry import TELEMETRY
     if TELEMETRY.level < 1:
@@ -91,9 +105,35 @@ def record_collective(kind: str, nbytes: float = 0,
                         "per-kind attribution may interleave")
         st = _collectives.setdefault(
             kind, {"calls": 0, "bytes": 0, "seconds": 0.0})
+        idx = int(st["calls"])
         st["calls"] += int(calls)
         st["bytes"] += int(nbytes)
         st["seconds"] += float(seconds)
+        if enter_mono is not None:
+            win = _coll_window.setdefault(kind, [])
+            win.append((idx, round(float(enter_mono), 6),
+                        round(float(seconds), 6)))
+            if len(win) > _COLL_WINDOW_CAP:
+                del win[: len(win) - _COLL_WINDOW_CAP]
+    if enter_mono is not None and TELEMETRY.level >= 2:
+        # span for the fleet trace merge: flow arrows join the per-rank
+        # net/<kind> spans of the same (kind, seq) across lanes
+        now = time.perf_counter()
+        TELEMETRY.record_span(f"net/{kind}", now - float(seconds),
+                              float(seconds), tid="net",
+                              args={"seq": idx, "bytes": int(nbytes)})
+
+
+def take_collective_window() -> Dict[str, list]:
+    """Drain and return this rank's attribution window:
+    ``{kind: [(call_index, enter_mono, seconds), ...]}``.  Samples
+    recorded after this call land in the next window, so synchronized
+    callers (obs/fleet.py syncs at iteration barriers) see aligned
+    windows on every rank."""
+    with _coll_lock:
+        out = {k: list(v) for k, v in _coll_window.items() if v}
+        _coll_window.clear()
+    return out
 
 
 def collective_stats() -> Dict[str, Dict[str, float]]:
@@ -119,6 +159,7 @@ def reset_collective_stats() -> None:
     global _coll_writer, _coll_race_warned
     with _coll_lock:
         _collectives.clear()
+        _coll_window.clear()
         _coll_writer = None
         _coll_race_warned = False
 
@@ -295,12 +336,14 @@ def _allgather_obj_once(obj):
     from ..utils.faults import FAULTS
     from . import distributed
     FAULTS.maybe_raise("collective/allgather")   # probed per attempt
+    distributed.probe_slow()                     # injected straggler delay
     blob = pickle.dumps(obj)
     t0 = time.perf_counter()
+    enter = time.monotonic()
     if _injected is not None:
         out = [pickle.loads(b) for b in _injected["allgather"](blob)]
         record_collective("allgather_obj", len(blob),
-                          time.perf_counter() - t0)
+                          time.perf_counter() - t0, enter_mono=enter)
         return out
     if distributed.is_active():
         # coordinator KV transport: backend-agnostic (XLA's CPU backend
@@ -311,7 +354,7 @@ def _allgather_obj_once(obj):
         out = [pickle.loads(b) for b in blobs]
         record_collective("allgather_obj",
                           sum(len(b) for b in blobs),
-                          time.perf_counter() - t0)
+                          time.perf_counter() - t0, enter_mono=enter)
         return out
     if jax.process_count() == 1:
         return [obj]
@@ -325,7 +368,8 @@ def _allgather_obj_once(obj):
     gathered = multihost_utils.process_allgather(pad)
     out = [pickle.loads(gathered[i, : int(sizes[i])].tobytes())
            for i in range(gathered.shape[0])]
-    record_collective("allgather_obj", maxn, time.perf_counter() - t0)
+    record_collective("allgather_obj", maxn, time.perf_counter() - t0,
+                      enter_mono=enter)
     return out
 
 

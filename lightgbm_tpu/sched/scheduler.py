@@ -216,6 +216,7 @@ class Scheduler:
         if self._health_out:
             budget = self.hbm_budget()
             self._stream.open(self._health_out, meta={
+                "stream": "sched",
                 "policy": self.policy,
                 "quantum_chunks": self.quantum_chunks,
                 "max_jobs": self.max_jobs,

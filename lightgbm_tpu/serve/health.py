@@ -107,7 +107,8 @@ class ServeHealth:
         self._total = defaultdict(int)
         self._closed = False
         self._stream = HealthStream()
-        rec: Dict[str, Any] = {"window_s": round(self.window_s, 3)}
+        rec: Dict[str, Any] = {"stream": "serve",
+                               "window_s": round(self.window_s, 3)}
         if meta:
             rec.update(meta)
         self._stream.open(path, meta=rec, start_kind="serve_start")

@@ -59,6 +59,7 @@ KNOWN_SITES = frozenset([
     "collective/barrier",    # cross-host barrier entry fails
     "dist/init",         # jax.distributed.initialize handshake fails
     "dist/preempt",      # host receives a preemption notice (SIGTERM)
+    "dist/slow",         # rank sleeps before collective entry (straggler)
     "oocore/h2d",        # bin-matrix host->device transfer raises OOM
     "oocore/admit",      # admission check decides the matrix won't fit
     "serve/compile",     # serve executable build fails (named give-up)

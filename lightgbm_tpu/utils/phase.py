@@ -112,6 +112,13 @@ class PhaseTimer:
             net_line = net.collective_summary()
             if net_line:
                 out += " | " + net_line
+        # and the fleet plane's cross-rank wait/work split when it
+        # attributed at least one window this run
+        fleet = sys.modules.get("lightgbm_tpu.obs.fleet")
+        if fleet is not None and hasattr(fleet, "summary_line"):
+            fleet_line = fleet.summary_line()
+            if fleet_line:
+                out += " | " + fleet_line
         return out
 
 

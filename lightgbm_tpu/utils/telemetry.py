@@ -88,6 +88,18 @@ writer as training, ``serve_start``/``serve_window``/``serve_admit``/
 ``serve_fault``/``serve_summary`` record kinds) — deliberately a
 SEPARATE ``HealthStream`` instance, so serving a model can never touch
 a training run's stream or its models.
+
+The v6 schema adds the FLEET observability plane (lightgbm_tpu/obs/):
+every health record carries a paired ``{wall_ts, mono_ts}`` clock stamp
+(:func:`clock_pair`), traces embed ``mono_epoch``/``wall_epoch``/rank
+anchors so ``tools/fleet_trace.py`` can merge per-rank traces onto one
+skew-corrected timeline, and ``obs/fleet.py`` kv-allgathers per-rank
+per-collective enter/duration tables to split collective wall into
+*wait* (skew-corrected idle before the slowest rank arrives) vs *work*
+(transfer/reduce) seconds — the ``dist/wait_s``/``dist/work_s`` counter
+pair, a named straggler rank per window (``dist_window`` records), and
+the ``fleet`` stats section.  All of it is host-side timing and IO:
+trained models stay byte-identical with the plane on or off.
 """
 
 from __future__ import annotations
@@ -101,8 +113,8 @@ from collections import defaultdict, deque
 from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
-METRICS_SCHEMA = "lightgbm_tpu.metrics/v5"
-METRICS_VERSION = 5
+METRICS_SCHEMA = "lightgbm_tpu.metrics/v6"
+METRICS_VERSION = 6
 HEALTH_SCHEMA = "lightgbm_tpu.health/v1"
 HEALTH_ENV = "LIGHTGBM_TPU_HEALTH_JSONL"
 TIMING_ENV = "LIGHTGBM_TPU_DEVICE_TIMING"
@@ -129,6 +141,17 @@ _JAX_COUNT_EVENTS = {
     "/jax/compilation_cache/cache_hits": "compile/cache_hits",
     "/jax/compilation_cache/cache_misses": "compile/cache_misses",
 }
+
+
+def clock_pair() -> Dict[str, float]:
+    """The v6 record timestamp pair: ``wall_ts`` (``time.time()``, for
+    humans and cross-restart ordering) and ``mono_ts``
+    (``time.monotonic()``, for merge ordering — NTP steps and clock
+    slew never reorder it).  Cross-rank, ``mono_ts`` values live on
+    per-host clocks with arbitrary epochs; ``obs/clockskew.py``
+    estimates the per-rank offsets that map them onto one timeline."""
+    return {"wall_ts": round(time.time(), 6),
+            "mono_ts": round(time.monotonic(), 6)}
 
 
 class HealthStream:
@@ -227,6 +250,7 @@ class HealthStream:
                 "ts": round(time.time(), 3),
                 "pid": os.getpid(),
             }
+            rec.update(clock_pair())
             if resuming:
                 rec["iter"] = int(resume_iter)
             if meta:
@@ -281,6 +305,7 @@ class HealthStream:
                     "records": self._records + 1,
                     "aborted": bool(aborted),
                 }
+                rec.update(clock_pair())
                 if self._last_iter is not None:
                     rec["iterations"] = int(self._last_iter["iter"]) + 1
                 if self._nonfinite_total:
@@ -315,6 +340,8 @@ class HealthStream:
             if fields:
                 rec.update(fields)
             rec.setdefault("t", round(time.perf_counter() - self._t0, 6))
+            for k, v in clock_pair().items():
+                rec.setdefault(k, v)
             self._ingest(rec)
             self._write(rec)
 
@@ -1036,7 +1063,11 @@ class TelemetryRegistry:
         capture info), present only when device timing ran or a
         profiler capture was taken.  v5 adds the ``serve`` section:
         the sliding-window QPS/p50/p99 of the serve plane, present
-        only when a request completed inside the window."""
+        only when a request completed inside the window.  v6 adds the
+        ``fleet`` section — cross-rank collective wait-vs-work
+        attribution (per-rank wait seconds, slowest-rank histogram,
+        clock-offset table) — present only when the fleet observability
+        plane synced at least one window."""
         import sys
         from .phase import GLOBAL_TIMER, _sync_enabled
         with self._lock:
@@ -1084,6 +1115,11 @@ class TelemetryRegistry:
         health = HEALTH.summary_section()
         if health is not None:
             out["health"] = health
+        fleet_mod = sys.modules.get("lightgbm_tpu.obs.fleet")
+        if fleet_mod is not None and hasattr(fleet_mod, "fleet_section"):
+            fleet = fleet_mod.fleet_section()
+            if fleet is not None:
+                out["fleet"] = fleet
         return out
 
     def chrome_trace(self) -> Dict[str, Any]:
@@ -1135,8 +1171,24 @@ class TelemetryRegistry:
                            "pid": pid, "tid": 0,
                            "ts": round(ev["t"] * 1e6, 3),
                            "args": args})
+        # clock anchors: event ``ts`` values are µs since ``_epoch`` (a
+        # perf_counter instant).  ``mono_epoch``/``wall_epoch`` pin that
+        # instant on the monotonic and wall clocks so fleet_trace.py can
+        # map per-rank traces onto one skew-corrected timeline.
+        now_pc = time.perf_counter()
+        other: Dict[str, Any] = {
+            "schema": METRICS_SCHEMA,
+            "mono_epoch": round(time.monotonic() - (now_pc - self._epoch),
+                                6),
+            "wall_epoch": round(time.time() - (now_pc - self._epoch), 6),
+        }
+        import sys
+        dist = sys.modules.get("lightgbm_tpu.parallel.distributed")
+        if dist is not None and getattr(dist, "is_active", lambda: False)():
+            other["rank"] = dist.rank()
+            other["world"] = dist.world()
         return {"traceEvents": events, "displayTimeUnit": "ms",
-                "otherData": {"schema": METRICS_SCHEMA}}
+                "otherData": other}
 
     def export_trace(self, path: str) -> None:
         try:

@@ -1,0 +1,602 @@
+"""Fleet observability plane tests (metrics v6): the NTP-midpoint
+clock-skew estimator, the pure wait-vs-work attribution core, the
+shared stream-tailing machinery, the skew-corrected Chrome-trace merge,
+the fleet summary rollup + gate, and — slow-marked — a real 2-process
+``jax.distributed`` CPU fleet with an injected ``dist/slow`` straggler
+exercising the ISSUE acceptance criteria: the armed rank is NAMED in
+the ``dist_window`` health records, the merged trace holds one
+monotone lane per rank joined by flow arrows, and the trained models
+stay byte-identical with the plane on vs off.
+"""
+
+import io
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.obs import clockskew, fleet
+from lightgbm_tpu.parallel import network
+from lightgbm_tpu.utils.telemetry import HealthStream, TELEMETRY
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_gate           # noqa: E402
+import fleet_monitor        # noqa: E402
+import fleet_trace          # noqa: E402
+import streamtail           # noqa: E402
+import trace_report         # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    TELEMETRY.reset()
+    fleet.reset()
+    yield
+    TELEMETRY.reset()
+    fleet.reset()
+
+
+# ------------------------------------------------------------- clock skew
+def _ping(theta, d_up, d_down, t1=100.0, proc=0.0):
+    """One synthetic exchange: server clock = client clock + theta."""
+    t2 = t1 + d_up + theta
+    t3 = t2 + proc
+    t4 = t1 + d_up + proc + d_down
+    return (t1, t2, t3, t4)
+
+
+def test_midpoint_offset_recovers_symmetric_offset():
+    # symmetric path delay: the midpoint estimate is exact
+    off, bound = clockskew.midpoint_offset(*_ping(3.5, 0.01, 0.01))
+    assert off == pytest.approx(3.5, abs=1e-9)
+    assert bound == pytest.approx(0.01, abs=1e-9)
+
+
+def test_midpoint_offset_error_within_rtt_bound():
+    # asymmetric delay biases the estimate, but never past the bound
+    theta = -2.0
+    off, bound = clockskew.midpoint_offset(*_ping(theta, 0.030, 0.002))
+    assert abs(off - theta) <= bound + 1e-12
+    assert bound == pytest.approx(0.016, abs=1e-9)
+
+
+def test_combine_pings_min_rtt_sample_wins():
+    noisy = _ping(1.0, 0.5, 0.001)      # queued: huge RTT, biased
+    clean = _ping(1.0, 0.002, 0.002)    # fast: tight, accurate
+    off, bound, rtt = clockskew.combine_pings([noisy, clean, noisy])
+    assert off == pytest.approx(1.0, abs=1e-9)
+    assert rtt == pytest.approx(0.004, abs=1e-9)
+    assert bound <= 0.01
+
+
+def test_combine_pings_rejects_empty():
+    with pytest.raises(ValueError):
+        clockskew.combine_pings([])
+
+
+def test_correct_maps_onto_rank0_clock():
+    table = {1: {"offset_s": -5.0, "bound_s": 0.001, "rtt_s": 0.002}}
+    assert clockskew.correct(10.0, 1, table) == pytest.approx(5.0)
+    # str keys (JSON round-trip) resolve the same way
+    assert clockskew.correct(10.0, 1, {"1": {"offset_s": -5.0}}) \
+        == pytest.approx(5.0)
+    # identity: no table, or a rank the table does not know
+    assert clockskew.correct(10.0, 1, None) == 10.0
+    assert clockskew.correct(10.0, 7, table) == 10.0
+
+
+# ------------------------------------------------------- wait/work split
+def _tables(slow_rank=1, delay=0.2):
+    """Two ranks, two barrier calls: ``slow_rank`` enters late, so the
+    other rank's measured wall is pure waiting."""
+    fast, slow = (0, 1) if slow_rank == 1 else (1, 0)
+    return {
+        fast: {"barrier": [(0, 10.0, delay + 0.01),
+                           (1, 20.0, delay + 0.01)]},
+        slow: {"barrier": [(0, 10.0 + delay, 0.01),
+                           (1, 20.0 + delay, 0.01)]},
+    }
+
+
+def test_attribute_window_splits_wait_vs_work_exactly():
+    report = fleet.attribute_window(_tables())
+    assert report["calls"] == 2
+    assert report["straggler"] == 1
+    # wait + work == that rank's own measured wall, by construction
+    walls = {0: 2 * 0.21, 1: 2 * 0.01}
+    for r in (0, 1):
+        v = report["per_rank"][r]
+        assert v["wait_s"] + v["work_s"] == pytest.approx(walls[r],
+                                                          abs=1e-6)
+        assert v["calls"] == 2
+    # the early rank's wall is (almost) all waiting for the straggler
+    assert report["per_rank"][0]["wait_s"] == pytest.approx(0.4, abs=1e-6)
+    assert report["per_rank"][1]["wait_s"] == pytest.approx(0.0, abs=1e-6)
+    assert report["lateness_s"][1] == pytest.approx(0.4, abs=1e-6)
+
+
+def test_attribute_window_applies_clock_offsets():
+    # rank 1's clock runs 100s behind rank 0's: uncorrected it looks
+    # like rank 1 entered ages early; the offset table flips the story
+    tables = {0: {"barrier": [(0, 10.0, 0.21)]},
+              1: {"barrier": [(0, -89.8, 0.01)]}}
+    offsets = {0: {"offset_s": 0.0}, 1: {"offset_s": 100.0}}
+    report = fleet.attribute_window(tables, offsets)
+    assert report["straggler"] == 1
+    assert report["per_rank"][0]["wait_s"] == pytest.approx(0.2, abs=1e-6)
+
+
+def test_attribute_window_skips_unpaired_calls():
+    tables = {0: {"barrier": [(0, 10.0, 0.1), (1, 20.0, 0.1)],
+                  "allgather": [(0, 30.0, 0.1)]},
+              1: {"barrier": [(1, 20.0, 0.1)]}}
+    report = fleet.attribute_window(tables)
+    assert report["calls"] == 1          # only barrier#1 pairs
+    tables = {0: {"barrier": [(0, 10.0, 0.1)]},
+              1: {"allgather": [(0, 10.0, 0.1)]}}
+    assert fleet.attribute_window(tables) is None
+    assert fleet.attribute_window({0: {"barrier": [(0, 1.0, 0.1)]}}) \
+        is None                          # < 2 ranks
+
+
+def test_attribute_window_simultaneous_entry_names_no_straggler():
+    tables = {0: {"barrier": [(0, 10.0, 0.05)]},
+              1: {"barrier": [(0, 10.0, 0.05)]}}
+    report = fleet.attribute_window(tables)
+    assert report["straggler"] is None
+    assert report["per_rank"][0]["wait_s"] == 0.0
+
+
+# ------------------------------------------------- collective window drain
+def test_take_collective_window_drains_and_indexes():
+    TELEMETRY.set_config_level(2)
+    network.reset_collective_stats()
+    try:
+        network.record_collective("barrier", 10, 0.5, enter_mono=1.0)
+        network.record_collective("barrier", 10, 0.5, enter_mono=2.0)
+        # no enter stamp -> counters only, never the window
+        network.record_collective("allgather", 99, 0.1)
+        win = network.take_collective_window()
+        assert set(win) == {"barrier"}
+        assert [(i, e) for i, e, _s in win["barrier"]] == [(0, 1.0),
+                                                           (1, 2.0)]
+        # drained: the next window starts empty but keeps indexing
+        assert network.take_collective_window() == {}
+        network.record_collective("barrier", 10, 0.5, enter_mono=3.0)
+        win = network.take_collective_window()
+        assert [i for i, _e, _s in win["barrier"]] == [2]
+        # counters saw everything regardless of the window
+        assert network.collective_stats()["barrier"]["calls"] == 3
+    finally:
+        network.reset_collective_stats()
+        TELEMETRY.set_config_level(None)
+
+
+# ------------------------------------------------------ health clock stamps
+def test_every_health_record_kind_carries_clock_pair(tmp_path):
+    path = str(tmp_path / "h.jsonl")
+    hs = HealthStream()
+    hs.open(path, meta={"stream": "train", "rank": 0, "world": 1})
+    hs.record("iter", {"iter": 0})
+    hs.record("fault", {"site": "x", "event": "armed"})
+    hs.record("dist", {"event": "clock", "offset_s": 0.0})
+    hs.record("dist_clock", {"rank": 0, "world": 1, "offsets": {}})
+    hs.record("dist_window", {"rank": 0, "seq": 0, "wait_s": 0.0,
+                              "work_s": 0.0})
+    hs.close()
+    recs = [json.loads(line) for line in open(path)]
+    kinds = [r["kind"] for r in recs]
+    assert kinds[0] == "start" and kinds[-1] == "summary"
+    for rec in recs:
+        assert isinstance(rec.get("wall_ts"), float), rec["kind"]
+        assert isinstance(rec.get("mono_ts"), float), rec["kind"]
+    # mono stamps never reorder within one process
+    monos = [r["mono_ts"] for r in recs]
+    assert monos == sorted(monos)
+
+
+# ------------------------------------------------------------- streamtail
+class _State(streamtail.JsonlFolder):
+    def __init__(self):
+        super().__init__()
+        self.kinds = []
+        self.recent = []
+
+    def on_record(self, rec):
+        self.kinds.append(rec.get("kind"))
+        if rec.get("t") is not None:
+            self.recent.append((rec["t"], rec.get("kind")))
+        if rec.get("kind") == "summary":
+            self.summary = rec
+
+
+def test_jsonl_folder_tolerates_torn_and_corrupt_lines():
+    st = _State()
+    st.feed(b'{"kind":"start"}\n{"ki')      # torn mid-record
+    assert st.kinds == ["start"]
+    st.feed(b'nd":"iter","t":1}\nnot json\n')
+    assert st.kinds == ["start", "iter"]    # torn line healed, junk skipped
+    assert st.records == 2
+
+
+def test_stream_stale_is_pace_relative():
+    st = _State()
+    for t in (0.0, 1.0, 2.0, 3.0):
+        st.feed(json.dumps({"kind": "iter", "t": t}).encode() + b"\n")
+    assert streamtail.median_record_gap(st) == pytest.approx(1.0)
+    assert streamtail.stream_stale(st, age_s=1.5) is None
+    age, gap = streamtail.stream_stale(st, age_s=5.0)
+    assert (age, gap) == (5.0, 1.0)
+    # a finished stream is never stale, however old the file
+    st.feed(b'{"kind":"summary","t":4}\n')
+    assert streamtail.stream_stale(st, age_s=500.0) is None
+    # too young to judge a pace
+    young = _State()
+    young.feed(b'{"kind":"iter","t":0}\n')
+    assert streamtail.stream_stale(young, age_s=500.0) is None
+
+
+def test_follow_stream_exit_codes(tmp_path):
+    render = lambda state, path: f"{state.records} records"  # noqa: E731
+    out = io.StringIO()
+    # 2: the file never appears before the deadline
+    rc = streamtail.follow_stream(str(tmp_path / "never.jsonl"), _State,
+                                  render, interval=0.01, timeout=0.05,
+                                  out=out, name="t")
+    assert rc == 2 and "never appeared" in out.getvalue()
+    # 3: records flow but no terminal record before the deadline
+    p = tmp_path / "wedged.jsonl"
+    p.write_text('{"kind":"start"}\n')
+    rc = streamtail.follow_stream(str(p), _State, render, interval=0.01,
+                                  timeout=0.05, out=io.StringIO(),
+                                  name="t", timeout_msg="custom\n")
+    assert rc == 3
+    # 0: summary lands while tailing (written from a helper thread)
+    p2 = tmp_path / "done.jsonl"
+    p2.write_text('{"kind":"start"}\n')
+
+    def _finish():
+        with open(p2, "a") as fh:
+            fh.write('{"kind":"summary"}\n')
+
+    t = threading.Timer(0.05, _finish)
+    t.start()
+    try:
+        rc = streamtail.follow_stream(str(p2), _State, render,
+                                      interval=0.01, timeout=10.0,
+                                      out=io.StringIO(), name="t")
+    finally:
+        t.join()
+    assert rc == 0
+
+
+def test_follow_stream_restarts_after_truncation(tmp_path):
+    p = tmp_path / "s.jsonl"
+    p.write_text('{"kind":"start"}\n{"kind":"iter","t":1}\n')
+    seen = []
+
+    def _render(state, path):
+        seen.append(list(state.kinds))
+        if len(seen) == 1:              # a fresh run recreated the file
+            p.write_text('{"kind":"start"}\n{"kind":"summary"}\n')
+        return "."
+
+    rc = streamtail.follow_stream(str(p), _State, _render, interval=0.01,
+                                  timeout=10.0, out=io.StringIO(),
+                                  name="t")
+    assert rc == 0
+    assert seen[0] == ["start", "iter"]
+    assert seen[-1] == ["start", "summary"]    # state restarted, not merged
+
+
+# ------------------------------------------------------------- trace merge
+def _trace(rank, mono_epoch, events):
+    return (rank, {"traceEvents": events, "displayTimeUnit": "ms",
+                   "otherData": {"mono_epoch": mono_epoch,
+                                 "wall_epoch": 1e9, "rank": rank,
+                                 "world": 2}})
+
+
+def test_merge_traces_skew_corrects_and_draws_flow_arrows():
+    # rank 1's monotonic clock runs 100s behind; its true epoch starts
+    # 0.1s after rank 0's once the offset table is applied
+    ev0 = [{"name": "net/barrier", "ph": "X", "ts": 1000.0, "dur": 250.0,
+            "tid": "net", "args": {"seq": 0}},
+           {"name": "grow", "ph": "X", "ts": 0.0, "dur": 900.0,
+            "tid": "train"}]
+    ev1 = [{"name": "net/barrier", "ph": "X", "ts": 1200.0, "dur": 50.0,
+            "tid": "net", "args": {"seq": 0}}]
+    offsets = {0: {"offset_s": 0.0}, 1: {"offset_s": 100.0}}
+    merged = fleet_trace.merge_traces(
+        [_trace(0, 500.0, ev0), _trace(1, 400.1, ev1)], offsets)
+
+    other = merged["otherData"]
+    assert other["schema"] == fleet_trace.FLEET_TRACE_SCHEMA
+    assert other["ranks"] == [0, 1]
+    assert other["base_mono_s"] == pytest.approx(500.0)
+    assert other["flows"] == 1
+
+    evs = merged["traceEvents"]
+    names = {(ev["pid"], ev["args"]["name"]) for ev in evs
+             if ev.get("ph") == "M" and ev["name"] == "process_name"}
+    assert names == {(0, "rank0"), (1, "rank1")}  # one named lane per rank
+
+    xs = {(ev["pid"], ev["name"]): ev for ev in evs
+          if ev.get("ph") == "X"}
+    # rank 0 anchors the timeline; rank 1's lane lands 0.1s later
+    assert xs[(0, "net/barrier")]["ts"] == pytest.approx(1000.0)
+    assert xs[(1, "net/barrier")]["ts"] == pytest.approx(
+        1200.0 + 0.1 * 1e6)
+    # flow arrow: starts at the first-entering rank, finishes bound to
+    # the straggler's enclosing span
+    flows = sorted((ev for ev in evs if ev.get("cat") == "fleet-flow"),
+                   key=lambda ev: ev["ts"])
+    assert [ev["ph"] for ev in flows] == ["s", "f"]
+    assert flows[0]["pid"] == 0 and flows[-1]["pid"] == 1
+    assert flows[-1]["bp"] == "e"
+    assert len({ev["id"] for ev in flows}) == 1
+
+    # merged stream is time-ordered (metadata first), hence monotone
+    # within every lane too
+    ts = [float(ev.get("ts", 0.0)) for ev in evs if ev.get("ph") != "M"]
+    assert ts == sorted(ts)
+    assert all(ev.get("ph") == "M" for ev in evs[:len(evs) - len(ts)])
+
+
+def test_merge_traces_unanchored_lane_is_labelled():
+    merged = fleet_trace.merge_traces(
+        [(0, {"traceEvents": [], "otherData": {}})], None)
+    (meta,) = [ev for ev in merged["traceEvents"]
+               if ev["name"] == "process_name"]
+    assert "(unanchored)" in meta["args"]["name"]
+
+
+# --------------------------------------------------- fleet summary + gate
+def _feed_stream(lines):
+    st = fleet_monitor.FleetStream()
+    for rec in lines:
+        st.feed(json.dumps(rec).encode() + b"\n")
+    return st
+
+
+def _fleet_states(complete=True):
+    win = {"kind": "dist_window", "seq": 0, "iter": 3, "calls": 4,
+           "straggler": 1, "t": 1.0, "mono_ts": 1.0}
+    r0 = [{"kind": "start", "stream": "train", "rank": 0, "world": 2,
+           "mono_ts": 0.0},
+          {"kind": "dist_clock", "rank": 0, "world": 2,
+           "offsets": {"0": {"offset_s": 0.0, "bound_s": 0.0,
+                             "rtt_s": 0.0},
+                       "1": {"offset_s": 0.5, "bound_s": 0.001,
+                             "rtt_s": 0.002}}, "mono_ts": 0.5},
+          dict(win, rank=0, wait_s=0.6, work_s=0.2)]
+    r1 = [{"kind": "start", "stream": "train", "rank": 1, "world": 2,
+           "mono_ts": 0.0},
+          {"kind": "fault", "site": "dist/slow", "event": "armed",
+           "mono_ts": 0.2},
+          dict(win, rank=1, wait_s=0.0, work_s=0.8)]
+    if complete:
+        r0.append({"kind": "summary", "mono_ts": 2.0})
+        r1.append({"kind": "summary", "mono_ts": 2.0})
+    return {"/obs/rank0.health.jsonl": _feed_stream(r0),
+            "/obs/rank1.health.jsonl": _feed_stream(r1)}
+
+
+def test_build_summary_folds_per_rank_and_dedupes_windows():
+    summary = fleet_monitor.build_summary(_fleet_states())
+    assert summary["schema"] == fleet_monitor.FLEET_SUMMARY_SCHEMA
+    # each rank's own split, folded from its OWN stream
+    assert summary["per_rank"]["0"]["wait_s"] == pytest.approx(0.6)
+    assert summary["per_rank"]["0"]["wait_fraction"] == pytest.approx(
+        0.75)
+    assert summary["per_rank"]["1"]["wait_s"] == pytest.approx(0.0)
+    # the shared window fields fold ONCE per seq, not once per stream
+    assert summary["windows"] == 1
+    assert summary["collective_calls"] == 4
+    assert summary["straggler_hist"] == {"1": 1}
+    assert summary["faults"] == {"train": 1}
+    assert summary["clock_offsets"]["1"]["offset_s"] == pytest.approx(0.5)
+    assert summary["complete"] is True
+    assert summary["streams"]["rank1.health.jsonl"]["rank"] == 1
+    # the gate accepts what the monitor writes
+    assert bench_gate.validate_fleet_summary(summary) == []
+
+
+def test_build_summary_incomplete_until_every_terminal_record():
+    summary = fleet_monitor.build_summary(_fleet_states(complete=False))
+    assert summary["complete"] is False
+    assert bench_gate.validate_fleet_summary(summary) == []
+
+
+def test_validate_fleet_summary_rejects_malformed():
+    good = fleet_monitor.build_summary(_fleet_states())
+    assert bench_gate.validate_fleet_summary(
+        dict(good, schema="nope")), "wrong schema must be rejected"
+    bad = json.loads(json.dumps(good))
+    bad["per_rank"]["0"]["wait_fraction"] = 1.5
+    assert bench_gate.validate_fleet_summary(bad)
+    bad = json.loads(json.dumps(good))
+    bad["straggler_hist"] = {"1": 99}     # more wins than windows
+    assert bench_gate.validate_fleet_summary(bad)
+    assert bench_gate.validate_fleet_summary({}), \
+        "empty dict must be rejected"
+
+
+def test_fleet_render_names_straggler_and_wait_bound_rank():
+    out = fleet_monitor.render(_fleet_states(), "/obs")
+    assert "straggler: rank1 slowest in 1 of 1 window(s)" in out
+    assert "WAIT-BOUND rank0" in out
+
+
+# ------------------------------------------------------------ trace report
+def test_trace_report_fleet_lines_na_on_v5_blob():
+    lines = trace_report._fleet_lines({"spans": []})
+    assert len(lines) == 1 and "n/a" in lines[0]
+
+
+def test_trace_report_fleet_lines_render_v6_section():
+    stats = {"fleet": {
+        "windows": 2, "sync_iters": 3,
+        "per_rank": {"0": {"wait_s": 0.6, "work_s": 0.2, "calls": 8,
+                           "wait_fraction": 0.75},
+                     "1": {"wait_s": 0.0, "work_s": 0.8, "calls": 8,
+                           "wait_fraction": 0.0}},
+        "straggler_hist": {"1": 2}}}
+    text = "\n".join(trace_report._fleet_lines(stats))
+    assert "2 attributed window(s)" in text
+    assert "rank0: wait 0.600s / work 0.200s" in text
+    assert "75% waiting" in text
+    assert "rank1 slowest most often" in text
+
+
+# ------------------------------------------------------------ config knobs
+def test_fleet_obs_config_knobs_validate():
+    cfg = Config(task="train", data="d.csv")
+    assert cfg.fleet_obs_sync_iters == 0
+    assert cfg.fleet_obs_clock_pings == 5
+    cfg = Config(task="train", data="d.csv", fleet_obs_sync_iters=3,
+                 fleet_obs_clock_pings=2)
+    assert cfg.fleet_obs_sync_iters == 3
+    with pytest.raises(Exception, match="fleet_obs_sync_iters"):
+        Config(task="train", data="d.csv", fleet_obs_sync_iters=-1)
+    with pytest.raises(Exception, match="fleet_obs_clock_pings"):
+        Config(task="train", data="d.csv", fleet_obs_clock_pings=0)
+
+
+def test_configure_binds_knobs_and_section_stays_v5_shaped():
+    cfg = Config(task="train", data="d.csv", fleet_obs_sync_iters=4)
+    fleet.configure(cfg)
+    assert fleet._sync_iters == 4 and fleet._next_sync == 4
+    # no window synced yet: the stats blob must stay v5-shaped
+    assert fleet.fleet_section() is None
+    assert fleet.summary_line() == ""
+    fleet.configure(None)
+    assert fleet._next_sync is None
+
+
+# ---------------------------------------------- 2-process acceptance (slow)
+def _write_csv(path, seed, n=300):
+    r = np.random.RandomState(seed)
+    X = r.rand(n, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.1 * r.rand(n)
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+
+
+def _fleet_argv(extra=()):
+    # relative paths + per-rank cwd: identical argv across runs keeps
+    # the saved model byte-comparable (parameters section included)
+    return [sys.executable, "-m", "lightgbm_tpu", "task=train",
+            "data=train.csv", "label_column=0", "objective=regression",
+            "num_iterations=8", "num_leaves=7", "min_data_in_leaf=5",
+            "verbosity=1", "snapshot_freq=2", "tpu_boost_chunk=1",
+            "seed=7", "collective_timeout_s=60",
+            "output_model=model.txt", *extra]
+
+
+def _run_fleet(dirs, argvs, extra_env, timeout_s=240.0):
+    from launch_multihost import launch
+    logs = [open(os.path.join(d, "run.log"), "a") for d in dirs]
+    try:
+        run = launch(argvs, cwds=[str(d) for d in dirs],
+                     extra_env=extra_env, stdouts=logs)
+        return run.wait(timeout_s=timeout_s)
+    finally:
+        for fh in logs:
+            fh.close()
+
+
+@pytest.mark.slow
+def test_fleet_plane_names_injected_straggler_byte_identical(tmp_path):
+    """ISSUE acceptance: a 2-rank CPU fleet with ``dist/slow`` armed on
+    rank 1 produces (a) ``dist_window`` records naming rank 1 as the
+    straggler with rank 0's wall dominated by waiting, (b) a merged
+    skew-corrected trace with one monotone lane per rank and flow
+    arrows, (c) a complete gate-accepted fleet summary, and (d) a model
+    byte-identical to the same fleet with the plane disabled."""
+    obs = tmp_path / "obs"
+    obs.mkdir()
+    dirs = {}
+    for mode in ("on", "off"):
+        for r in (0, 1):
+            d = tmp_path / f"{mode}{r}"
+            d.mkdir()
+            _write_csv(d / "train.csv", 4321)
+            dirs[mode, r] = d
+
+    slow = "fault_injection=dist/slow@0x*"
+    plane = ["telemetry_level=2", "fleet_obs_sync_iters=3",
+             f"health_out={obs}/rank{{rank}}.health.jsonl"]
+    slow_env = {"LIGHTGBM_TPU_SLOW_MS": "150"}
+
+    # plane ON, rank 1 sleeps 150ms before every collective entry
+    codes = _run_fleet(
+        [dirs["on", 0], dirs["on", 1]],
+        [_fleet_argv(plane), _fleet_argv(plane + [slow])],
+        [{"LIGHTGBM_TPU_TRACE_JSON": str(obs / "rank0.trace.json")},
+         dict(slow_env, LIGHTGBM_TPU_TRACE_JSON=str(
+             obs / "rank1.trace.json"))])
+    assert codes == [0, 0]
+
+    # (a) the armed rank is the NAMED straggler, and the fast rank's
+    # collective wall is dominated by waiting for it
+    recs = [json.loads(line)
+            for line in open(obs / "rank0.health.jsonl")]
+    for rec in recs:
+        assert "wall_ts" in rec and "mono_ts" in rec, rec["kind"]
+    clocks = [r for r in recs if r["kind"] == "dist_clock"]
+    assert clocks and set(clocks[-1]["offsets"]) == {"0", "1"}
+    windows = [r for r in recs if r["kind"] == "dist_window"]
+    assert windows, "no dist_window records synced"
+    named = [w["straggler"] for w in windows if w["straggler"] is not None]
+    assert named and max(set(named), key=named.count) == 1
+    wait0 = sum(w["per_rank"]["0"]["wait_s"] for w in windows)
+    wait1 = sum(w["per_rank"]["1"]["wait_s"] for w in windows)
+    assert wait0 > 0.2, f"rank0 barely waited ({wait0:.3f}s)"
+    assert wait0 > 2 * wait1, (wait0, wait1)
+    # wait + work sums to each window's attributed collective wall
+    for w in windows:
+        for r in ("0", "1"):
+            v = w["per_rank"][r]
+            assert v["wait_s"] >= 0 and v["work_s"] >= 0
+
+    # (b) merged trace: a lane per rank, monotone, flow arrows present
+    merged_path = obs / "fleet.merged.json"
+    assert fleet_trace.main([str(obs), "-o", str(merged_path)]) == 0
+    merged = json.load(open(merged_path))
+    assert merged["otherData"]["schema"] == fleet_trace.FLEET_TRACE_SCHEMA
+    assert merged["otherData"]["ranks"] == [0, 1]
+    assert merged["otherData"]["flows"] >= 1
+    evs = merged["traceEvents"]
+    assert {ev["pid"] for ev in evs if ev.get("ph") == "X"} == {0, 1}
+    for lane in (0, 1):
+        ts = [float(ev["ts"]) for ev in evs
+              if ev.get("pid") == lane and ev.get("ph") != "M"]
+        assert ts == sorted(ts), f"lane {lane} not monotone"
+
+    # (c) fleet summary: complete, straggler attributed, gate-accepted
+    states = fleet_monitor.load_dir(str(obs))
+    summary = fleet_monitor.build_summary(states)
+    assert summary["complete"] is True
+    assert summary["windows"] >= 1
+    assert max(summary["straggler_hist"],
+               key=summary["straggler_hist"].get) == "1"
+    assert bench_gate.validate_fleet_summary(summary) == []
+
+    # (d) plane OFF (no telemetry, no syncs, no streams), same fault:
+    # the trained models must be byte-identical — observability can
+    # never leak into the model
+    codes = _run_fleet(
+        [dirs["off", 0], dirs["off", 1]],
+        [_fleet_argv(), _fleet_argv([slow])],
+        [{}, dict(slow_env)])
+    assert codes == [0, 0]
+    for r in (0, 1):
+        on = (dirs["on", r] / "model.txt").read_bytes()
+        off = (dirs["off", r] / "model.txt").read_bytes()
+        assert on == off, f"rank {r} model differs with plane on/off"

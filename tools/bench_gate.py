@@ -28,6 +28,11 @@ Usage:
   python tools/bench_gate.py                     # repo trajectory
   python tools/bench_gate.py --path X.jsonl --window 8 --wall-tol 0.10
   python tools/bench_gate.py --self-test         # fast CI smoke
+  python tools/bench_gate.py --fleet-summary fleet_summary.json
+
+``--fleet-summary`` gates a tools/fleet_monitor.py rollup instead of
+the trajectory: schema pin, per-rank wait fractions in [0, 1],
+straggler histogram consistency, per-subsystem fault counts.
 """
 
 import argparse
@@ -37,6 +42,8 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_PATH = os.path.join(REPO, "BENCH_TRAJECTORY.jsonl")
+
+FLEET_SUMMARY_SCHEMA = "lightgbm_tpu.fleet_summary/v1"
 
 
 def load(path):
@@ -197,6 +204,76 @@ def gate(path, window=5, wall_tol=0.15, hbm_tol=0.20, latency_tol=0.20,
     out.write(f"bench_gate: {'FAIL' if failures else 'PASS'} "
               f"({len(failures)} regression(s), {path})\n")
     return 1 if failures else 0
+
+
+def validate_fleet_summary(summary):
+    """Structural gate over a tools/fleet_monitor.py
+    ``fleet_summary.json``: returns a list of problems (empty = valid).
+    The CI fleet-smoke leg feeds its freshly-written summary through
+    this, so a malformed v6 rollup fails the build, not the reader."""
+    problems = []
+    if not isinstance(summary, dict):
+        return ["fleet summary is not a JSON object"]
+    if summary.get("schema") != FLEET_SUMMARY_SCHEMA:
+        problems.append(f"schema {summary.get('schema')!r} != "
+                        f"{FLEET_SUMMARY_SCHEMA!r}")
+    streams = summary.get("streams")
+    if not isinstance(streams, dict) or not streams:
+        problems.append("streams section missing or empty")
+    else:
+        for name, view in streams.items():
+            if not isinstance(view, dict) or "status" not in view:
+                problems.append(f"stream {name}: malformed view")
+            elif not isinstance(view.get("records"), int) \
+                    or view["records"] < 0:
+                problems.append(f"stream {name}: bad record count "
+                                f"{view.get('records')!r}")
+    per_rank = summary.get("per_rank", {})
+    if not isinstance(per_rank, dict):
+        problems.append("per_rank is not an object")
+    else:
+        for rank, slot in per_rank.items():
+            frac = slot.get("wait_fraction") \
+                if isinstance(slot, dict) else None
+            if not isinstance(frac, (int, float)) \
+                    or not 0.0 <= frac <= 1.0:
+                problems.append(f"rank {rank}: wait_fraction "
+                                f"{frac!r} outside [0, 1]")
+            for key in ("wait_s", "work_s"):
+                v = slot.get(key) if isinstance(slot, dict) else None
+                if not isinstance(v, (int, float)) or v < 0:
+                    problems.append(f"rank {rank}: {key} {v!r} "
+                                    f"negative or missing")
+    hist = summary.get("straggler_hist", {})
+    if not isinstance(hist, dict) or any(
+            not isinstance(n, int) or n < 1 for n in hist.values()):
+        problems.append("straggler_hist counts must be positive ints")
+    elif isinstance(summary.get("windows"), int) \
+            and sum(hist.values()) > summary["windows"]:
+        problems.append("straggler_hist exceeds the window count")
+    faults = summary.get("faults", {})
+    if not isinstance(faults, dict) or any(
+            not isinstance(n, int) or n < 0 for n in faults.values()):
+        problems.append("faults section counts must be ints >= 0")
+    if not isinstance(summary.get("complete"), bool):
+        problems.append("complete flag missing or not a bool")
+    return problems
+
+
+def gate_fleet_summary(path, out=sys.stdout):
+    try:
+        with open(path) as fh:
+            summary = json.load(fh)
+    except (OSError, ValueError) as e:
+        out.write(f"bench_gate: FAIL unreadable fleet summary "
+                  f"{path}: {e}\n")
+        return 1
+    problems = validate_fleet_summary(summary)
+    for p in problems:
+        out.write(f"bench_gate: FAIL fleet summary: {p}\n")
+    out.write(f"bench_gate: fleet summary "
+              f"{'FAIL' if problems else 'PASS'} ({path})\n")
+    return 1 if problems else 0
 
 
 def self_test():
@@ -372,6 +449,39 @@ def self_test():
             [{"config": "sched-rr-2job", "value": 3.0, "unit": "s",
               "fairness_index": 0.99}])[0]),
     ]
+    # fleet-summary structural gate (tools/fleet_monitor.py output)
+    good_fleet = {
+        "schema": FLEET_SUMMARY_SCHEMA,
+        "streams": {"rank0.health.jsonl": {
+            "stream": "train", "status": "finished", "records": 20,
+            "rank": 0, "faults": 0}},
+        "per_rank": {"0": {"wait_s": 0.5, "work_s": 1.5,
+                           "windows": 2, "wait_fraction": 0.25}},
+        "straggler_hist": {"1": 2}, "windows": 2,
+        "collective_calls": 9, "faults": {"train": 1},
+        "clock_offsets": {}, "complete": True,
+    }
+    checks += [
+        ("well-formed fleet summary passes",
+         validate_fleet_summary(good_fleet) == []),
+        ("fleet schema mismatch fails",
+         bool(validate_fleet_summary(
+             dict(good_fleet, schema="lightgbm_tpu.fleet_summary/v0")))),
+        ("fleet wait_fraction out of range fails",
+         bool(validate_fleet_summary(dict(
+             good_fleet,
+             per_rank={"0": {"wait_s": 0.5, "work_s": 1.5,
+                             "wait_fraction": 1.5}})))),
+        ("fleet straggler hist over window count fails",
+         bool(validate_fleet_summary(
+             dict(good_fleet, straggler_hist={"1": 5})))),
+        ("fleet empty streams fails",
+         bool(validate_fleet_summary(dict(good_fleet, streams={})))),
+        ("fleet missing complete flag fails",
+         bool(validate_fleet_summary(
+             {k: v for k, v in good_fleet.items()
+              if k != "complete"}))),
+    ]
     bad = [name for name, ok in checks if not ok]
     for name, ok in checks:
         print(f"bench_gate self-test: {'ok' if ok else 'FAIL'} {name}")
@@ -396,9 +506,15 @@ def main(argv=None):
                          "(default 0.20; only gates device_timing runs)")
     ap.add_argument("--self-test", action="store_true",
                     help="run the built-in smoke checks and exit")
+    ap.add_argument("--fleet-summary", default=None,
+                    help="validate a tools/fleet_monitor.py "
+                         "fleet_summary.json instead of the "
+                         "trajectory")
     args = ap.parse_args(argv)
     if args.self_test:
         return self_test()
+    if args.fleet_summary:
+        return gate_fleet_summary(args.fleet_summary)
     return gate(args.path, args.window, args.wall_tol, args.hbm_tol,
                 args.latency_tol)
 

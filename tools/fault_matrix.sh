@@ -27,6 +27,12 @@
 # preemption snapshot must retry once then fail THAT JOB ONLY — the
 # scheduler and every sibling tenant run to completion.
 #
+# A sixth pass runs the fleet observability suite (tests/test_fleet_obs.py)
+# over the dist/slow delay site at world=2: the armed rank must surface as
+# the NAMED straggler in the dist_window health records and the wait/work
+# split must account for the injected delay — a fault that slows a rank
+# is attributed, never silently absorbed.
+#
 #   tools/fault_matrix.sh [extra pytest args...]
 #
 # FAULT_MATRIX_CHUNK is deliberately NOT LIGHTGBM_TPU_-prefixed: the test
@@ -63,6 +69,13 @@ echo "=== fault matrix: serve sites=serve/compile,serve/enqueue ==="
 if ! JAX_PLATFORMS=cpu \
     python -m pytest tests/test_serve.py -q -p no:cacheprovider \
     -k "fault" "$@"; then
+  status=1
+fi
+
+echo "=== fault matrix: fleet sites=dist/slow (world=2) ==="
+if ! JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_fleet_obs.py -q -p no:cacheprovider \
+    "$@"; then
   status=1
 fi
 

@@ -1,0 +1,449 @@
+"""One fleet view over every health-stream kind (metrics v6 plane).
+
+run_monitor / serve_monitor / sched_monitor each tail ONE stream kind.
+This tool tails a directory holding ALL of them at once — the per-rank
+training streams of a multi-host run, a serve session's stream, a
+scheduler's stream — and folds them into one time-ordered view:
+
+  * one status line per stream (classified by the ``stream`` field the
+    v6 writers stamp into their start meta: train / serve / sched; the
+    start-record kind is the fallback for v5 streams);
+  * the v6 ``dist_window`` records' collective wait-vs-work split: per
+    rank, how much of its collective wall was idle waiting for the
+    slowest rank (skew-corrected), and WHICH rank was the straggler in
+    each window;
+  * stall/straggler/fault rollups across every subsystem, with the
+    pace-relative staleness detector (tools/streamtail.py) flagging any
+    stream that has gone quiet mid-run;
+  * a merged tail of the newest records across all streams, ordered by
+    the monotonic ``mono_ts`` stamps (corrected by the ``dist_clock``
+    offsets when present) — never by wall clocks.
+
+``--summary-out`` additionally writes a machine-readable
+``fleet_summary.json`` (schema ``lightgbm_tpu.fleet_summary/v1``):
+per-rank wait fraction, slowest-rank histogram, per-subsystem fault
+counts — the shape ``bench_gate.py --fleet-summary`` gates.
+
+``--smoke`` is the self-contained CI leg: it launches a real 2-rank
+localhost CPU fleet (tools/launch_multihost.py), waits it out, merges
+the per-rank traces with tools/fleet_trace.py, renders the fleet view,
+writes the summary and validates it with bench_gate — exercising the
+whole v6 observability plane in one command.
+
+Usage:
+  python tools/fleet_monitor.py obsdir/
+  python tools/fleet_monitor.py obsdir/ --follow --timeout 300
+  python tools/fleet_monitor.py obsdir/ --summary-out fleet_summary.json
+  python tools/fleet_monitor.py --smoke
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import deque
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import streamtail  # noqa: E402  (shared tail loop + staleness)
+
+FLEET_SUMMARY_SCHEMA = "lightgbm_tpu.fleet_summary/v1"
+
+# start-record kind -> subsystem, for v5 streams without the meta field
+_START_KINDS = {"start": "train", "serve_start": "serve",
+                "sched_start": "sched"}
+_SUMMARY_KINDS = ("summary", "serve_summary", "sched_summary")
+# cap on retained dist_window records per stream: totals keep folding,
+# only the raw records rotate
+_WINDOW_KEEP = 64
+
+
+class FleetStream(streamtail.JsonlFolder):
+    """Subsystem-agnostic fold of ONE health stream: classification,
+    progress, faults, and the v6 dist records."""
+
+    def __init__(self):
+        super().__init__()
+        self.stream = None              # train / serve / sched / ?
+        self.meta = None
+        self.rank = None
+        self.world = None
+        self.last_iter = None
+        self.faults = 0
+        self.recent = deque(maxlen=64)  # (mono_ts, kind, detail)
+        self.dist_windows = deque(maxlen=_WINDOW_KEEP)
+        self.wait_s = 0.0               # this stream's own rank totals
+        self.work_s = 0.0
+        self.clock = None               # newest dist_clock offset table
+
+    def on_record(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        if kind in _START_KINDS or kind == "resume":
+            self.meta = rec
+            self.stream = (rec.get("stream")
+                           or _START_KINDS.get(kind, self.stream))
+            if rec.get("rank") is not None:
+                self.rank = rec.get("rank")
+                self.world = rec.get("world")
+        detail = rec.get("iter")
+        if detail is None:
+            detail = rec.get("job") or rec.get("event")
+        self.recent.append((rec.get("mono_ts"), kind, detail))
+        if kind == "iter":
+            self.last_iter = rec.get("iter")
+        elif kind in ("fault", "serve_fault"):
+            self.faults += 1
+        elif kind == "dist_window":
+            self.dist_windows.append(rec)
+            self.wait_s += float(rec.get("wait_s") or 0.0)
+            self.work_s += float(rec.get("work_s") or 0.0)
+            if rec.get("rank") is not None:
+                self.rank = rec.get("rank")
+        elif kind == "dist_clock":
+            self.clock = rec.get("offsets")
+        elif kind in _SUMMARY_KINDS:
+            self.summary = rec
+
+    @property
+    def status(self):
+        if self.summary is not None:
+            return ("aborted" if self.summary.get("aborted")
+                    else "finished")
+        return "running" if self.records else "empty"
+
+    def label(self):
+        parts = [self.stream or "?"]
+        if self.rank is not None:
+            parts.append(f"rank{self.rank}" +
+                         (f"/{self.world}" if self.world else ""))
+        return ":".join(parts)
+
+
+def load_dir(dirpath):
+    """{path: FleetStream} over every *.jsonl stream under a dir."""
+    states = {}
+    for name in sorted(os.listdir(dirpath)):
+        if not name.endswith(".jsonl"):
+            continue
+        path = os.path.join(dirpath, name)
+        try:
+            streamtail.read_stream(path, states.setdefault(
+                path, FleetStream()))
+        except OSError:
+            states.pop(path, None)
+    return states
+
+
+def _clock_table(states):
+    """The fleet's clock-offset table (any stream carries the whole
+    allgathered table; the newest record wins within each stream)."""
+    for state in states.values():
+        if state.clock:
+            return {int(r): v for r, v in state.clock.items()}
+    return {}
+
+
+def build_summary(states):
+    """The machine-readable rollup bench_gate.py gates."""
+    offsets = _clock_table(states)
+    per_rank = {}
+    straggler_by_seq = {}
+    calls = 0
+    for state in states.values():
+        for rec in state.dist_windows:
+            r = rec.get("rank")
+            if r is None:
+                continue
+            # each rank's stream carries its OWN wait/work split; the
+            # shared fields (straggler, per-window calls) are folded
+            # once per window via the seq key, not once per stream
+            slot = per_rank.setdefault(str(r), {"wait_s": 0.0,
+                                                "work_s": 0.0,
+                                                "windows": 0})
+            slot["wait_s"] += float(rec.get("wait_s") or 0.0)
+            slot["work_s"] += float(rec.get("work_s") or 0.0)
+            slot["windows"] += 1
+            seq = rec.get("seq")
+            if seq is not None and seq not in straggler_by_seq:
+                straggler_by_seq[seq] = rec.get("straggler")
+                calls += int(rec.get("calls") or 0)
+    straggler_hist = {}
+    for straggler in straggler_by_seq.values():
+        if straggler is not None:
+            key = str(straggler)
+            straggler_hist[key] = straggler_hist.get(key, 0) + 1
+    for slot in per_rank.values():
+        wall = slot["wait_s"] + slot["work_s"]
+        slot["wait_s"] = round(slot["wait_s"], 6)
+        slot["work_s"] = round(slot["work_s"], 6)
+        slot["wait_fraction"] = round(slot["wait_s"] / wall, 6) \
+            if wall > 0 else 0.0
+    faults = {}
+    streams = {}
+    for path, state in states.items():
+        sub = state.stream or "?"
+        if state.faults:
+            faults[sub] = faults.get(sub, 0) + state.faults
+        streams[os.path.basename(path)] = {
+            "stream": sub, "status": state.status,
+            "records": state.records, "rank": state.rank,
+            "faults": state.faults,
+        }
+    return {
+        "schema": FLEET_SUMMARY_SCHEMA,
+        "streams": streams,
+        "per_rank": per_rank,
+        "straggler_hist": straggler_hist,
+        "windows": len(straggler_by_seq),
+        "collective_calls": calls,
+        "faults": faults,
+        "clock_offsets": {str(r): v for r, v in sorted(offsets.items())},
+        "complete": bool(states) and all(
+            s.summary is not None for s in states.values()),
+    }
+
+
+def render(states, dirpath, tail=14):
+    """The one fleet plane: per-stream lines, wait/work rollup,
+    stall/straggler flags, merged mono-ordered tail."""
+    lines = [f"fleet {dirpath}: {len(states)} stream(s)"]
+    if not states:
+        lines.append("  no *.jsonl streams found")
+        return "\n".join(lines)
+    offsets = _clock_table(states)
+
+    def corrected(mono, rank):
+        if not isinstance(mono, (int, float)):
+            return None
+        entry = offsets.get(rank) if rank is not None else None
+        return mono + float(entry["offset_s"]) if entry else mono
+
+    merged = []
+    for path, state in sorted(states.items(),
+                              key=lambda kv: kv[1].label()):
+        line = f"  {state.label()}: [{state.status}] " \
+               f"{state.records} records"
+        if state.last_iter is not None:
+            line += f", iter {state.last_iter}"
+        if state.wait_s or state.work_s:
+            line += (f", collectives wait {state.wait_s:.3f}s / "
+                     f"work {state.work_s:.3f}s")
+        if state.faults:
+            line += f", {state.faults} fault(s)"
+        lines.append(line)
+        for mono, kind, detail in state.recent:
+            merged.append((corrected(mono, state.rank) or 0.0,
+                           state.label(), kind, detail))
+
+    summary = build_summary(states)
+    hist = summary["straggler_hist"]
+    if hist:
+        worst = max(hist, key=hist.get)
+        lines.append(
+            f"  straggler: rank{worst} slowest in {hist[worst]} of "
+            f"{summary['windows']} window(s) "
+            + " ".join(f"rank{r}={n}" for r, n in sorted(hist.items())))
+    for rank, slot in sorted(summary["per_rank"].items()):
+        if slot["wait_fraction"] >= 0.5:
+            lines.append(
+                f"  !! WAIT-BOUND rank{rank}: {slot['wait_fraction']:.0%}"
+                f" of its collective wall spent waiting for slower "
+                f"ranks")
+    for path, state in states.items():
+        hit = streamtail.stream_stale(state,
+                                      streamtail.stream_age_s(path))
+        if hit is not None:
+            lines.append(
+                f"  !! STALE {state.label()}: no new record for "
+                f"{hit[0]:.1f}s, over {streamtail.STALL_GAP_FACTOR:g}x "
+                f"its median inter-record gap {hit[1]:.2f}s")
+    merged.sort(key=lambda r: r[0])
+    if merged:
+        lines.append(f"  tail ({min(tail, len(merged))} newest, "
+                     f"mono-ordered):")
+        for mono, label, kind, detail in merged[-tail:]:
+            at = f"@{detail}" if detail is not None else ""
+            lines.append(f"    [{mono:12.3f}] {label} {kind}{at}")
+    return "\n".join(lines)
+
+
+def write_summary(states, out_path):
+    summary = build_summary(states)
+    with open(out_path, "w") as fh:
+        json.dump(summary, fh, indent=1, sort_keys=True)
+    return summary
+
+
+def follow(dirpath, interval, timeout, out=sys.stdout,
+           summary_out=None):
+    """Re-render until every stream has its terminal record (exit 0);
+    2 when the directory never yields a stream, 3 on timeout."""
+    deadline = time.monotonic() + timeout if timeout > 0 else None
+    while True:
+        states = load_dir(dirpath) if os.path.isdir(dirpath) else {}
+        if states:
+            out.write(render(states, dirpath) + "\n")
+            out.flush()
+            if all(s.summary is not None for s in states.values()):
+                if summary_out:
+                    write_summary(states, summary_out)
+                return 0
+        if deadline is not None and time.monotonic() >= deadline:
+            if not states:
+                out.write(f"fleet_monitor: no streams under "
+                          f"{dirpath}\n")
+                return 2
+            if summary_out:
+                write_summary(states, summary_out)
+            out.write("fleet_monitor: timeout waiting for every "
+                      "stream's terminal record\n")
+            return 3
+        time.sleep(interval)
+
+
+# ------------------------------------------------------------------ smoke
+def _write_csv(path, seed, n=240):
+    """Deterministic toy regression CSV (no numpy dependency here —
+    the fleet children load it with the normal data path)."""
+    import random
+    r = random.Random(seed)
+    with open(path, "w") as fh:
+        for _ in range(n):
+            x = [r.random() for _ in range(4)]
+            y = 2.0 * x[0] + x[1] + 0.1 * r.random()
+            fh.write(",".join(f"{v:.6f}" for v in [y] + x) + "\n")
+
+
+def smoke(workdir=None, hosts=2, out=sys.stdout):
+    """End-to-end CI leg: real 2-rank CPU fleet -> merged trace ->
+    fleet view -> validated fleet_summary.json.  Returns 0 on PASS."""
+    import shutil
+    import tempfile
+    import bench_gate
+    import fleet_trace
+    from launch_multihost import launch
+
+    keep = workdir is not None
+    base = os.path.abspath(workdir or tempfile.mkdtemp(
+        prefix="lgbm_fleet_smoke_"))
+    obs = os.path.join(base, "obs")
+    os.makedirs(obs, exist_ok=True)
+    try:
+        argvs, cwds, extra_env, logs = [], [], [], []
+        for r in range(hosts):
+            d = os.path.join(base, f"r{r}")
+            os.makedirs(d, exist_ok=True)
+            _write_csv(os.path.join(d, "train.csv"), seed=1234)
+            argvs.append([
+                sys.executable, "-m", "lightgbm_tpu", "task=train",
+                "data=train.csv", "label_column=0",
+                "objective=regression", "num_iterations=8",
+                "num_leaves=7", "min_data_in_leaf=5", "verbosity=1",
+                "tpu_boost_chunk=1", "seed=7", "snapshot_freq=2",
+                "collective_timeout_s=60", "telemetry_level=2",
+                "fleet_obs_sync_iters=3", "output_model=model.txt",
+                f"health_out={obs}/rank{{rank}}.health.jsonl"])
+            cwds.append(d)
+            extra_env.append({"LIGHTGBM_TPU_TRACE_JSON":
+                              os.path.join(obs,
+                                           f"rank{r}.trace.json")})
+            logs.append(open(os.path.join(d, "run.log"), "w"))
+        try:
+            run = launch(argvs, cwds=cwds, extra_env=extra_env,
+                         stdouts=logs)
+            codes = run.wait(timeout_s=240.0)
+        finally:
+            for fh in logs:
+                fh.close()
+        checks = [("all ranks exited 0 " + str(codes),
+                   codes == [0] * hosts)]
+
+        merged_path = os.path.join(obs, "smoke.fleet.json")
+        rc = fleet_trace.main([obs, "-o", merged_path])
+        checks.append(("fleet_trace merged the per-rank traces",
+                       rc == 0 and os.path.exists(merged_path)))
+        if os.path.exists(merged_path):
+            with open(merged_path) as fh:
+                merged = json.load(fh)
+            pids = {ev.get("pid") for ev in merged["traceEvents"]
+                    if ev.get("ph") == "X"}
+            checks.append(
+                (f"merged trace has one lane per rank {sorted(pids)}",
+                 pids == set(range(hosts))))
+
+        states = load_dir(obs)
+        out.write(render(states, obs) + "\n")
+        summary_path = os.path.join(obs, "fleet_summary.json")
+        summary = write_summary(states, summary_path)
+        checks.append(("every stream reached its terminal record",
+                       summary["complete"]))
+        checks.append((f"windows attributed ({summary['windows']})",
+                       summary["windows"] >= 1))
+        errors = bench_gate.validate_fleet_summary(summary)
+        checks.append(("bench_gate accepts fleet_summary.json "
+                       + "; ".join(errors), not errors))
+
+        bad = [name for name, ok in checks if not ok]
+        for name, ok in checks:
+            out.write(f"fleet_monitor smoke: {'ok' if ok else 'FAIL'} "
+                      f"{name}\n")
+        out.write(f"fleet_monitor smoke: "
+                  f"{'FAIL' if bad else 'PASS'} ({base})\n")
+        if bad:
+            for r in range(hosts):
+                log = os.path.join(base, f"r{r}", "run.log")
+                if os.path.exists(log):
+                    with open(log) as fh:
+                        tail = fh.read()[-2000:]
+                    out.write(f"--- rank {r} log tail ---\n{tail}\n")
+        return 1 if bad else 0
+    finally:
+        if not keep:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge every health-stream kind in a directory "
+                    "into one fleet view (train/serve/sched/dist)")
+    ap.add_argument("path", nargs="?",
+                    help="directory of health JSONL streams")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep tailing until every stream's terminal "
+                         "record lands")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--timeout", type=float, default=0.0,
+                    help="--follow gives up after this many seconds "
+                         "(0 = wait forever)")
+    ap.add_argument("--summary-out", default=None,
+                    help="also write the machine-readable "
+                         "fleet_summary.json here")
+    ap.add_argument("--tail", type=int, default=14,
+                    help="merged-tail length (default 14)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the self-contained 2-rank CPU fleet "
+                         "smoke (ignores PATH unless given as the "
+                         "work dir to keep)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke(workdir=args.path)
+    if not args.path:
+        ap.error("PATH is required unless --smoke")
+    if args.follow:
+        return follow(args.path, max(0.05, args.interval),
+                      args.timeout, summary_out=args.summary_out)
+    if not os.path.isdir(args.path):
+        print(f"fleet_monitor: not a directory: {args.path}")
+        return 2
+    states = load_dir(args.path)
+    print(render(states, args.path, tail=args.tail))
+    if args.summary_out:
+        write_summary(states, args.summary_out)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)

@@ -1,0 +1,245 @@
+"""Merge per-rank Chrome traces onto one skew-corrected fleet timeline.
+
+Every rank of a multi-host run exports its own Chrome trace
+(``LIGHTGBM_TPU_TRACE_JSON``; utils/telemetry.chrome_trace).  Each
+file's event timestamps are microseconds since that PROCESS's telemetry
+epoch on that HOST's clock — overlaying them naively puts rank 1's
+iteration 40 under rank 0's iteration 2.  This tool rebases them onto
+one timeline:
+
+  * each v6 trace carries a ``mono_epoch`` anchor in ``otherData`` (the
+    telemetry epoch pinned on the host monotonic clock), so an event's
+    host-monotonic instant is ``mono_epoch + ts/1e6``;
+  * the ``dist_clock`` health record (obs/clockskew.py, in every rank's
+    health stream) carries the measured per-rank monotonic offsets onto
+    rank 0's clock, bounded by ping RTT — adding ``offset_s`` yields
+    the fleet instant;
+  * the earliest fleet instant across all ranks becomes t=0 of the
+    merged trace.
+
+The merged file gives each rank its own process lane (``pid`` = rank,
+with ``process_name``/``process_sort_index`` metadata) and draws flow
+arrows between the per-rank spans of the same logical collective —
+``net/*`` spans share a ``seq`` argument (the collective call index,
+identical across ranks because every rank issues collectives in the
+same order), so arrow N runs from the first rank to enter collective N
+to the last: the straggler is the rank every arrow points at.
+
+v5 traces (no ``mono_epoch``) still merge — their lanes are flagged
+``unanchored`` and keep their own zero, which is only correct for
+single-host fleets.
+
+Usage:
+  python tools/fleet_trace.py rundir/ -o fleet.trace.json
+  python tools/fleet_trace.py r0.trace.json r1.trace.json \\
+      --offsets-from rundir/ -o fleet.trace.json
+
+``rundir/`` is scanned for ``*.trace.json`` per-rank traces and
+``*.jsonl`` health streams (the newest ``dist_clock`` record wins).
+Open the output in Perfetto / chrome://tracing like any other trace.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+FLEET_TRACE_SCHEMA = "lightgbm_tpu.fleet_trace/v1"
+
+# trace-event phases that carry a point timestamp we must rebase
+_POINT_PHASES = ("X", "C", "i", "I", "s", "t", "f", "b", "e", "n")
+
+
+def _rank_of(trace, path, fallback):
+    """Rank for a per-rank trace: otherData.rank (v6 multi-host), a
+    rankN hint in the filename, else the file's position."""
+    other = trace.get("otherData") or {}
+    if isinstance(other.get("rank"), int):
+        return int(other["rank"])
+    m = re.search(r"rank(\d+)", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    return fallback
+
+
+def load_offsets_from_streams(paths):
+    """Newest ``dist_clock`` offset table found across health streams:
+    ``{rank: {"offset_s", "bound_s", "rtt_s"}}`` (the table is
+    allgathered, so any rank's stream carries the whole fleet)."""
+    best = None
+    for path in paths:
+        try:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+        except OSError:
+            continue
+        for line in raw.split(b"\n"):
+            line = line.strip()
+            if not line or b'"dist_clock"' not in line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") != "dist_clock":
+                continue
+            table = rec.get("offsets") or {}
+            key = rec.get("mono_ts") or rec.get("t") or 0.0
+            if best is None or key >= best[0]:
+                best = (key, {int(r): dict(v) for r, v in table.items()})
+    return best[1] if best else {}
+
+
+def _offset_s(offsets, rank):
+    entry = offsets.get(rank) if offsets else None
+    return float(entry["offset_s"]) if entry else 0.0
+
+
+def merge_traces(traces, offsets=None):
+    """Pure merge core: ``traces`` is ``[(rank, trace_dict), ...]``;
+    ``offsets`` the clockskew table (may be empty/None — single-host
+    fleets share one clock).  Returns the merged Chrome trace dict."""
+    offsets = offsets or {}
+    lanes = []          # (rank, mono_epoch|None, events)
+    anchored = []
+    for rank, trace in traces:
+        other = trace.get("otherData") or {}
+        epoch = other.get("mono_epoch")
+        mono = (float(epoch) + _offset_s(offsets, rank)
+                if isinstance(epoch, (int, float)) else None)
+        lanes.append((rank, mono, trace.get("traceEvents") or []))
+        if mono is not None:
+            anchored.append(mono)
+    # t=0 of the merged trace = the earliest anchored epoch, so every
+    # lane starts at a small positive offset and relative gaps between
+    # ranks are real (startup skew included)
+    base = min(anchored) if anchored else 0.0
+
+    merged = []
+    net_spans = {}      # (name, seq) -> [(fleet_ts, rank, tid)]
+    for rank, mono, events in lanes:
+        shift_us = 0.0 if mono is None else (mono - base) * 1e6
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": f"rank{rank}" +
+                                ("" if mono is not None
+                                 else " (unanchored)")}})
+        merged.append({"name": "process_sort_index", "ph": "M",
+                       "pid": rank, "args": {"sort_index": rank}})
+        for ev in events:
+            ev = dict(ev)
+            ev["pid"] = rank
+            if ev.get("ph") in _POINT_PHASES and "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + shift_us, 3)
+            merged.append(ev)
+            if (ev.get("ph") == "X"
+                    and str(ev.get("name", "")).startswith("net/")):
+                seq = (ev.get("args") or {}).get("seq")
+                if seq is not None:
+                    net_spans.setdefault(
+                        (ev["name"], int(seq)), []).append(
+                            (ev["ts"], rank, ev.get("tid", 0)))
+
+    # one flow arrow per logical collective, first-entering rank ->
+    # last (the straggler every arrow converges on)
+    flow_id = 0
+    for (name, seq), hits in sorted(net_spans.items()):
+        if len(hits) < 2:
+            continue
+        hits.sort()
+        flow_id += 1
+        for i, (ts, rank, tid) in enumerate(hits):
+            ph = "s" if i == 0 else ("f" if i == len(hits) - 1 else "t")
+            ev = {"name": name, "cat": "fleet-flow", "ph": ph,
+                  "id": flow_id, "pid": rank, "tid": tid, "ts": ts}
+            if ph == "f":
+                ev["bp"] = "e"      # bind the arrow head to the
+            merged.append(ev)       # enclosing (straggler's) span
+
+    # stable time order per lane (metadata events carry no ts: sort
+    # them first so Perfetto names lanes before drawing into them)
+    merged.sort(key=lambda ev: (ev.get("ph") != "M",
+                                float(ev.get("ts", 0.0)),
+                                ev.get("pid", 0)))
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "schema": FLEET_TRACE_SCHEMA,
+            "ranks": sorted(r for r, _m, _e in lanes),
+            "base_mono_s": round(base, 6),
+            "offsets": {str(r): v for r, v in sorted(offsets.items())},
+            "flows": flow_id,
+        },
+    }
+
+
+def _collect_inputs(paths):
+    """Expand dirs into (trace_files, stream_files); pass files
+    through by extension."""
+    traces, streams = [], []
+    for p in paths:
+        if os.path.isdir(p):
+            traces.extend(sorted(glob.glob(os.path.join(
+                p, "*.trace.json"))))
+            streams.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        elif p.endswith(".jsonl"):
+            streams.append(p)
+        else:
+            traces.append(p)
+    return traces, streams
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge per-rank Chrome traces onto one "
+                    "skew-corrected fleet timeline")
+    ap.add_argument("paths", nargs="+",
+                    help="per-rank *.trace.json files and/or a run "
+                         "directory holding them (plus the health "
+                         "streams the clock offsets come from)")
+    ap.add_argument("--offsets-from", default=None,
+                    help="health stream file/dir to read the "
+                         "dist_clock offset table from (default: the "
+                         "*.jsonl streams found next to the traces)")
+    ap.add_argument("-o", "--out", default="fleet.trace.json",
+                    help="merged trace destination "
+                         "(default fleet.trace.json)")
+    args = ap.parse_args(argv)
+
+    trace_files, stream_files = _collect_inputs(args.paths)
+    if args.offsets_from:
+        _ignored, extra = _collect_inputs([args.offsets_from])
+        stream_files = extra or [args.offsets_from]
+    if not trace_files:
+        print("fleet_trace: no *.trace.json inputs found")
+        return 2
+
+    traces = []
+    for i, path in enumerate(trace_files):
+        try:
+            with open(path) as fh:
+                trace = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"fleet_trace: skipping unreadable trace {path}: {e}")
+            continue
+        traces.append((_rank_of(trace, path, i), trace))
+    if not traces:
+        print("fleet_trace: no readable traces")
+        return 2
+
+    offsets = load_offsets_from_streams(stream_files)
+    merged = merge_traces(traces, offsets)
+    with open(args.out, "w") as fh:
+        json.dump(merged, fh)
+    other = merged["otherData"]
+    print(f"fleet_trace: {len(traces)} rank(s) -> {args.out} "
+          f"({len(merged['traceEvents'])} events, "
+          f"{other['flows']} collective flow arrow(s), "
+          f"offsets for {len(offsets)} rank(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,7 +88,7 @@ def generate() -> str:
         "  a set `LIGHTGBM_TPU_TRACE_JSON=<path>` forces level >= 2 and",
         "  writes the trace there.",
         "- `metrics_out` — CLI training only: write the versioned",
-        "  telemetry JSON blob (schema `lightgbm_tpu.metrics/v5`) to this",
+        "  telemetry JSON blob (schema `lightgbm_tpu.metrics/v6`) to this",
         "  path after training.  Written even when training crashes, so",
         "  the blob's `faults` section survives for post-mortems.",
         "- `device_timing` — measured per-dispatch device timing",

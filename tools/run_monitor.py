@@ -25,6 +25,13 @@ new line within 2x its own median inter-record gap — this catches a
 wedge the lag check can't (every rank stuck at the same iteration)
 and is reused by ``tools/sched_monitor.py`` for per-job streams.
 
+The tail loop and the staleness detectors live in
+``tools/streamtail.py`` (shared with serve_monitor / sched_monitor /
+fleet_monitor); this module re-exports them under their historical
+names.  For a fleet view that merges serve and scheduler streams too
+(plus the v6 dist/straggler records), see ``tools/fleet_monitor.py`` —
+``--fleet`` here remains the train-only per-rank view.
+
 Usage:
   python tools/run_monitor.py run.health.jsonl
   python tools/run_monitor.py run.health.jsonl --follow --interval 2
@@ -32,71 +39,53 @@ Usage:
 """
 
 import argparse
-import json
 import os
 import sys
 import time
 from collections import deque
 
-# a rank whose newest iteration trails the fleet median by at least
-# this many iterations (with no summary record) is flagged as stalled
-STALL_LAG_ITERS = 2
-# an unfinished stream with no new line for longer than this factor
-# times its own median inter-record gap is flagged as stale — catches
-# a wedged single rank (or a whole wedged fleet) that the iteration-lag
-# check can't see because every stream stopped at the same iteration
-STALL_GAP_FACTOR = 2.0
-# a stream too young/sparse to have a meaningful gap history is never
-# flagged; require this many timestamped records first
-STALE_MIN_RECORDS = 4
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import streamtail  # noqa: E402  (shared tail loop + staleness)
+from streamtail import (  # noqa: E402,F401  (re-exported API)
+    STALL_LAG_ITERS, STALL_GAP_FACTOR, STALE_MIN_RECORDS,
+    median_record_gap, stream_stale)
+
+# re-export under the historical name (sched_monitor/tests import it)
+_stream_age_s = streamtail.stream_age_s
 
 
-class StreamState:
-    """Folded view of a health stream; feed() accepts raw JSONL bytes
-    incrementally and tolerates a torn trailing line (kept in the tail
-    buffer until its newline arrives)."""
+class StreamState(streamtail.JsonlFolder):
+    """Folded view of a health stream; feed() (streamtail.JsonlFolder)
+    accepts raw JSONL bytes incrementally and tolerates a torn trailing
+    line (kept in the tail buffer until its newline arrives)."""
 
     def __init__(self):
+        super().__init__()
         self.start = None
         self.resumes = []
         self.iters = {}                 # iter -> last record wins
         self.evals = {}                 # iter -> last record wins
         self.snapshots = []
         self.faults = []
-        self.summary = None
-        self.records = 0
         self.recent = deque(maxlen=64)  # (t, kind, iter) tail for --fleet
-        self._tail = b""
 
-    def feed(self, data: bytes) -> None:
-        buf = self._tail + data
-        lines = buf.split(b"\n")
-        self._tail = lines.pop()        # b"" when data ended in newline
-        for raw in lines:
-            raw = raw.strip()
-            if not raw:
-                continue
-            try:
-                rec = json.loads(raw)
-            except ValueError:
-                continue
-            self.records += 1
-            kind = rec.get("kind")
-            self.recent.append((rec.get("t"), kind, rec.get("iter")))
-            if kind == "start":
-                self.start = rec
-            elif kind == "resume":
-                self.resumes.append(rec)
-            elif kind == "iter":
-                self.iters[int(rec.get("iter", -1))] = rec
-            elif kind == "eval":
-                self.evals[int(rec.get("iter", -1))] = rec
-            elif kind == "snapshot":
-                self.snapshots.append(rec)
-            elif kind == "fault":
-                self.faults.append(rec)
-            elif kind == "summary":
-                self.summary = rec
+    def on_record(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        self.recent.append((rec.get("t"), kind, rec.get("iter")))
+        if kind == "start":
+            self.start = rec
+        elif kind == "resume":
+            self.resumes.append(rec)
+        elif kind == "iter":
+            self.iters[int(rec.get("iter", -1))] = rec
+        elif kind == "eval":
+            self.evals[int(rec.get("iter", -1))] = rec
+        elif kind == "snapshot":
+            self.snapshots.append(rec)
+        elif kind == "fault":
+            self.faults.append(rec)
+        elif kind == "summary":
+            self.summary = rec
 
 
 def _fmt_bytes(n):
@@ -271,46 +260,6 @@ def _fleet_median_iter(states):
             else (last[mid - 1] + last[mid]) // 2)
 
 
-def median_record_gap(state: StreamState):
-    """Median inter-record gap in seconds over the stream's recent
-    timestamped records; None when fewer than STALE_MIN_RECORDS carry
-    a timestamp (too young to judge a pace from)."""
-    ts = [t for t, _kind, _it in state.recent
-          if isinstance(t, (int, float))]
-    if len(ts) < STALE_MIN_RECORDS:
-        return None
-    gaps = sorted(max(0.0, b - a) for a, b in zip(ts, ts[1:]))
-    mid = len(gaps) // 2
-    return (gaps[mid] if len(gaps) % 2
-            else 0.5 * (gaps[mid - 1] + gaps[mid]))
-
-
-def stream_stale(state: StreamState, age_s):
-    """``(age_s, gap)`` when an unfinished stream has appended nothing
-    for longer than STALL_GAP_FACTOR x its own median inter-record gap
-    (``age_s`` = seconds since the file last grew), else None.  Pure —
-    the caller supplies the age so this works on mtimes, synthetic
-    clocks in tests, and sched streams alike."""
-    if state.summary is not None or age_s is None:
-        return None
-    gap = median_record_gap(state)
-    if gap is None or gap <= 0:
-        return None
-    if age_s > STALL_GAP_FACTOR * gap:
-        return (float(age_s), float(gap))
-    return None
-
-
-def _stream_age_s(path, now=None):
-    """Seconds since the stream file last grew (mtime age); None when
-    the file can't be statted."""
-    try:
-        mtime = os.path.getmtime(path)
-    except OSError:
-        return None
-    return max(0.0, (time.time() if now is None else now) - mtime)
-
-
 def fleet_stale(states, ages=None):
     """[(label, age_s, median_gap)] for every unfinished stream whose
     file has gone quiet for > STALL_GAP_FACTOR x its median
@@ -419,35 +368,11 @@ def follow_fleet(dirpath, interval, timeout, out=sys.stdout):
 def follow(path, interval, timeout, out=sys.stdout):
     """Tail the stream until its summary record lands.  Returns 0 on a
     completed stream, 2 when the file never appears, 3 on timeout."""
-    state = StreamState()
-    offset = 0
-    deadline = time.monotonic() + timeout if timeout > 0 else None
-    waited_for_file = False
-    while True:
-        if os.path.exists(path):
-            size = os.path.getsize(path)
-            if size < offset:            # truncated (fresh run): restart
-                state, offset = StreamState(), 0
-            if size > offset:
-                with open(path, "rb") as fh:
-                    fh.seek(offset)
-                    data = fh.read()
-                offset += len(data)
-                state.feed(data)
-                out.write(render(state, path) + "\n")
-                out.flush()
-        else:
-            waited_for_file = True
-        if state.summary is not None:
-            return 0
-        if deadline is not None and time.monotonic() >= deadline:
-            if waited_for_file and state.records == 0:
-                out.write(f"run_monitor: {path} never appeared\n")
-                return 2
-            out.write("run_monitor: timeout waiting for the summary "
-                      "record (run still alive?)\n")
-            return 3
-        time.sleep(interval)
+    return streamtail.follow_stream(
+        path, StreamState, render, interval, timeout, out,
+        name="run_monitor",
+        timeout_msg="run_monitor: timeout waiting for the summary "
+                    "record (run still alive?)\n")
 
 
 def main(argv=None):

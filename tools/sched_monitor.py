@@ -14,7 +14,7 @@ One-shot mode renders the stream as it stands — running OR closed.
 incremental reads), re-rendering every ``--interval`` seconds until
 the ``sched_summary`` record lands (exit 0) or ``--timeout`` seconds
 pass without one (exit 3).  Staleness detection reuses
-run_monitor.stream_stale: an unfinished stream whose file has no new
+streamtail.stream_stale: an unfinished stream whose file has no new
 line within 2x its own median inter-record gap gets a LOUD flag — the
 signature of a wedged tenant holding the whole scheduler loop.
 
@@ -24,73 +24,61 @@ Usage:
 """
 
 import argparse
-import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from run_monitor import (  # noqa: E402  (shared staleness detector)
-    STALL_GAP_FACTOR, _stream_age_s, stream_stale)
+import streamtail  # noqa: E402  (shared tail loop)
+from streamtail import (  # noqa: E402  (shared staleness detector)
+    STALL_GAP_FACTOR, stream_stale)
+
+_stream_age_s = streamtail.stream_age_s
 
 
-class SchedStreamState:
-    """Folded view of a sched health stream; feed() accepts raw JSONL
-    bytes incrementally and tolerates a torn trailing line."""
+class SchedStreamState(streamtail.JsonlFolder):
+    """Folded view of a sched health stream; feed()
+    (streamtail.JsonlFolder) accepts raw JSONL bytes incrementally and
+    tolerates a torn trailing line."""
 
     TAIL_KEEP = 64
 
     def __init__(self):
+        super().__init__()
         self.start = None
         self.admits = []
         self.slices = 0                 # sched_slice records seen
         self.jobs = {}                  # name -> last slice/done view
         self.preempts = []
         self.done = []                  # job_done records in order
-        self.summary = None
-        self.records = 0
         self.recent = []                # (t, kind, job) tail
-        self._tail = b""
 
-    def feed(self, data: bytes) -> None:
-        buf = self._tail + data
-        lines = buf.split(b"\n")
-        self._tail = lines.pop()
-        for raw in lines:
-            raw = raw.strip()
-            if not raw:
-                continue
-            try:
-                rec = json.loads(raw)
-            except ValueError:
-                continue
-            self.records += 1
-            kind = rec.get("kind")
-            self.recent.append((rec.get("t"), kind, rec.get("job")))
-            del self.recent[: -self.TAIL_KEEP]
-            if kind == "sched_start":
-                self.start = rec
-            elif kind == "sched_admit":
-                self.admits.append(rec)
-            elif kind == "sched_slice":
-                self.slices += 1
-                view = self.jobs.setdefault(rec.get("job", "?"), {})
-                view.update(rec)
-            elif kind == "sched_preempt_job":
-                self.preempts.append(rec)
-            elif kind == "job_done":
-                self.done.append(rec)
-                view = self.jobs.setdefault(rec.get("job", "?"), {})
-                view.update(rec)
-                view["terminal"] = ("failed" if rec.get("failed")
-                                    else "done")
-            elif kind == "sched_summary":
-                self.summary = rec
+    def on_record(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        self.recent.append((rec.get("t"), kind, rec.get("job")))
+        del self.recent[: -self.TAIL_KEEP]
+        if kind == "sched_start":
+            self.start = rec
+        elif kind == "sched_admit":
+            self.admits.append(rec)
+        elif kind == "sched_slice":
+            self.slices += 1
+            view = self.jobs.setdefault(rec.get("job", "?"), {})
+            view.update(rec)
+        elif kind == "sched_preempt_job":
+            self.preempts.append(rec)
+        elif kind == "job_done":
+            self.done.append(rec)
+            view = self.jobs.setdefault(rec.get("job", "?"), {})
+            view.update(rec)
+            view["terminal"] = ("failed" if rec.get("failed")
+                                else "done")
+        elif kind == "sched_summary":
+            self.summary = rec
 
 
-# run_monitor's fleet staleness helpers expect a StreamState with
-# .recent tuples carrying a leading timestamp and a .summary attribute
-# — SchedStreamState satisfies both, so stream_stale works unchanged.
+# streamtail's staleness helpers expect a state with .recent tuples
+# carrying a leading timestamp and a .summary attribute —
+# SchedStreamState satisfies both, so stream_stale works unchanged.
 
 
 def render(state: SchedStreamState, path: str,
@@ -180,36 +168,13 @@ def render(state: SchedStreamState, path: str,
 def follow(path, interval, timeout, out=sys.stdout):
     """Tail the stream until sched_summary lands.  Returns 0 on a
     closed stream, 2 when the file never appears, 3 on timeout."""
-    state = SchedStreamState()
-    offset = 0
-    deadline = time.monotonic() + timeout if timeout > 0 else None
-    waited_for_file = False
-    while True:
-        if os.path.exists(path):
-            size = os.path.getsize(path)
-            if size < offset:            # truncated (fresh scheduler)
-                state, offset = SchedStreamState(), 0
-            if size > offset:
-                with open(path, "rb") as fh:
-                    fh.seek(offset)
-                    data = fh.read()
-                offset += len(data)
-                state.feed(data)
-                out.write(render(state, path,
-                                 age_s=_stream_age_s(path)) + "\n")
-                out.flush()
-        else:
-            waited_for_file = True
-        if state.summary is not None:
-            return 0
-        if deadline is not None and time.monotonic() >= deadline:
-            if waited_for_file and state.records == 0:
-                out.write(f"sched_monitor: {path} never appeared\n")
-                return 2
-            out.write("sched_monitor: timeout waiting for the "
-                      "sched_summary record (scheduler still alive?)\n")
-            return 3
-        time.sleep(interval)
+    return streamtail.follow_stream(
+        path, SchedStreamState,
+        lambda state, p: render(state, p, age_s=_stream_age_s(p)),
+        interval, timeout, out,
+        name="sched_monitor",
+        timeout_msg="sched_monitor: timeout waiting for the "
+                    "sched_summary record (scheduler still alive?)\n")
 
 
 def main(argv=None):

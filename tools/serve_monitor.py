@@ -20,56 +20,44 @@ Usage:
 """
 
 import argparse
-import json
 import os
 import sys
-import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import streamtail  # noqa: E402  (shared tail loop)
 
 
-class ServeStreamState:
-    """Folded view of a serve health stream; feed() accepts raw JSONL
-    bytes incrementally and tolerates a torn trailing line."""
+class ServeStreamState(streamtail.JsonlFolder):
+    """Folded view of a serve health stream; feed()
+    (streamtail.JsonlFolder) accepts raw JSONL bytes incrementally and
+    tolerates a torn trailing line."""
 
     WINDOW_KEEP = 12
 
     def __init__(self):
+        super().__init__()
         self.start = None
         self.windows = []               # newest WINDOW_KEEP kept
         self.admits = []
         self.faults = []
-        self.summary = None
-        self.records = 0
         self.total_requests = 0
         self.total_rows = 0
-        self._tail = b""
 
-    def feed(self, data: bytes) -> None:
-        buf = self._tail + data
-        lines = buf.split(b"\n")
-        self._tail = lines.pop()
-        for raw in lines:
-            raw = raw.strip()
-            if not raw:
-                continue
-            try:
-                rec = json.loads(raw)
-            except ValueError:
-                continue
-            self.records += 1
-            kind = rec.get("kind")
-            if kind == "serve_start":
-                self.start = rec
-            elif kind == "serve_window":
-                self.total_requests += rec.get("requests", 0)
-                self.total_rows += rec.get("rows", 0)
-                self.windows.append(rec)
-                del self.windows[: -self.WINDOW_KEEP]
-            elif kind == "serve_admit":
-                self.admits.append(rec)
-            elif kind == "serve_fault":
-                self.faults.append(rec)
-            elif kind == "serve_summary":
-                self.summary = rec
+    def on_record(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        if kind == "serve_start":
+            self.start = rec
+        elif kind == "serve_window":
+            self.total_requests += rec.get("requests", 0)
+            self.total_rows += rec.get("rows", 0)
+            self.windows.append(rec)
+            del self.windows[: -self.WINDOW_KEEP]
+        elif kind == "serve_admit":
+            self.admits.append(rec)
+        elif kind == "serve_fault":
+            self.faults.append(rec)
+        elif kind == "serve_summary":
+            self.summary = rec
 
 
 def _ms(v):
@@ -147,35 +135,11 @@ def render(state: ServeStreamState, path: str) -> str:
 def follow(path, interval, timeout, out=sys.stdout):
     """Tail the stream until serve_summary lands.  Returns 0 on a
     closed stream, 2 when the file never appears, 3 on timeout."""
-    state = ServeStreamState()
-    offset = 0
-    deadline = time.monotonic() + timeout if timeout > 0 else None
-    waited_for_file = False
-    while True:
-        if os.path.exists(path):
-            size = os.path.getsize(path)
-            if size < offset:            # truncated (fresh session)
-                state, offset = ServeStreamState(), 0
-            if size > offset:
-                with open(path, "rb") as fh:
-                    fh.seek(offset)
-                    data = fh.read()
-                offset += len(data)
-                state.feed(data)
-                out.write(render(state, path) + "\n")
-                out.flush()
-        else:
-            waited_for_file = True
-        if state.summary is not None:
-            return 0
-        if deadline is not None and time.monotonic() >= deadline:
-            if waited_for_file and state.records == 0:
-                out.write(f"serve_monitor: {path} never appeared\n")
-                return 2
-            out.write("serve_monitor: timeout waiting for the "
-                      "serve_summary record (session still alive?)\n")
-            return 3
-        time.sleep(interval)
+    return streamtail.follow_stream(
+        path, ServeStreamState, render, interval, timeout, out,
+        name="serve_monitor",
+        timeout_msg="serve_monitor: timeout waiting for the "
+                    "serve_summary record (session still alive?)\n")
 
 
 def main(argv=None):

@@ -1,0 +1,161 @@
+"""Shared health-stream tailing for the monitor CLIs.
+
+run_monitor.py, serve_monitor.py, sched_monitor.py and fleet_monitor.py
+all consume the same append-only JSONL health streams
+(lightgbm_tpu/utils/telemetry.py, schema ``lightgbm_tpu.health/v1``)
+and used to duplicate three pieces of machinery, which live here once:
+
+  * :class:`JsonlFolder` — incremental byte-offset folding of a JSONL
+    stream, tolerating a torn trailing line (kept in a tail buffer
+    until its newline arrives; the O_APPEND writer never tears a line,
+    but a reader can race the write).
+  * :func:`follow_stream` — the tail loop: byte-offset incremental
+    reads (a multi-hour stream is not re-parsed every tick),
+    truncation restart (a fresh run recreating the file), re-render on
+    growth, and the 0/2/3 exit-code contract every monitor shares
+    (0 = summary landed, 2 = file never appeared, 3 = timeout).
+  * pace-relative staleness — :func:`median_record_gap` /
+    :func:`stream_stale` / :func:`stream_age_s`: an unfinished stream
+    whose file has no new line within ``STALL_GAP_FACTOR`` x its own
+    median inter-record gap is flagged, catching a wedge that
+    iteration-lag checks can't see (every rank stuck at the same
+    iteration, or a single wedged tenant).
+
+State classes need only subclass :class:`JsonlFolder`, implement
+``on_record``, and keep a ``summary`` attribute (None until the
+stream's terminal record lands) plus a ``recent`` sequence of
+``(t, ...)`` tuples if they want staleness detection.
+"""
+
+import json
+import os
+import time
+
+# a rank whose newest iteration trails the fleet median by at least
+# this many iterations (with no summary record) is flagged as stalled
+STALL_LAG_ITERS = 2
+# an unfinished stream with no new line for longer than this factor
+# times its own median inter-record gap is flagged as stale
+STALL_GAP_FACTOR = 2.0
+# a stream too young/sparse to have a meaningful gap history is never
+# flagged; require this many timestamped records first
+STALE_MIN_RECORDS = 4
+
+
+class JsonlFolder:
+    """Incremental JSONL folding base: feed() accepts raw bytes in any
+    chunking, parses complete lines, and dispatches each record to the
+    subclass's ``on_record``.  A torn trailing line waits in the tail
+    buffer; unparseable lines are skipped (torn/corrupt)."""
+
+    def __init__(self):
+        self.records = 0
+        self.summary = None
+        self._tail = b""
+
+    def feed(self, data: bytes) -> None:
+        buf = self._tail + data
+        lines = buf.split(b"\n")
+        self._tail = lines.pop()        # b"" when data ended in newline
+        for raw in lines:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            self.records += 1
+            self.on_record(rec)
+
+    def on_record(self, rec: dict) -> None:
+        raise NotImplementedError
+
+
+def read_stream(path, state):
+    """Fold a whole stream file into ``state`` (one-shot mode).
+    Returns the state; OSError propagates to the caller."""
+    with open(path, "rb") as fh:
+        state.feed(fh.read())
+    return state
+
+
+def median_record_gap(state):
+    """Median inter-record gap in seconds over the stream's recent
+    timestamped records; None when fewer than STALE_MIN_RECORDS carry
+    a timestamp (too young to judge a pace from)."""
+    ts = [entry[0] for entry in state.recent
+          if isinstance(entry[0], (int, float))]
+    if len(ts) < STALE_MIN_RECORDS:
+        return None
+    gaps = sorted(max(0.0, b - a) for a, b in zip(ts, ts[1:]))
+    mid = len(gaps) // 2
+    return (gaps[mid] if len(gaps) % 2
+            else 0.5 * (gaps[mid - 1] + gaps[mid]))
+
+
+def stream_stale(state, age_s):
+    """``(age_s, gap)`` when an unfinished stream has appended nothing
+    for longer than STALL_GAP_FACTOR x its own median inter-record gap
+    (``age_s`` = seconds since the file last grew), else None.  Pure —
+    the caller supplies the age so this works on mtimes, synthetic
+    clocks in tests, and any stream kind alike."""
+    if state.summary is not None or age_s is None:
+        return None
+    gap = median_record_gap(state)
+    if gap is None or gap <= 0:
+        return None
+    if age_s > STALL_GAP_FACTOR * gap:
+        return (float(age_s), float(gap))
+    return None
+
+
+def stream_age_s(path, now=None):
+    """Seconds since the stream file last grew (mtime age); None when
+    the file can't be statted."""
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    return max(0.0, (time.time() if now is None else now) - mtime)
+
+
+def follow_stream(path, state_factory, render, interval, timeout, out,
+                  name="monitor", timeout_msg=None):
+    """Tail one stream until its terminal record lands.
+
+    ``state_factory`` builds a fresh :class:`JsonlFolder` (also after a
+    truncation — a fresh run recreating the file); ``render(state,
+    path)`` returns the view re-printed on every growth.  Returns 0 on
+    a completed stream, 2 when the file never appears before the
+    deadline, 3 on timeout with the stream still unterminated."""
+    state = state_factory()
+    offset = 0
+    deadline = time.monotonic() + timeout if timeout > 0 else None
+    waited_for_file = False
+    while True:
+        if os.path.exists(path):
+            size = os.path.getsize(path)
+            if size < offset:            # truncated (fresh run): restart
+                state, offset = state_factory(), 0
+            if size > offset:
+                with open(path, "rb") as fh:
+                    fh.seek(offset)
+                    data = fh.read()
+                offset += len(data)
+                state.feed(data)
+                out.write(render(state, path) + "\n")
+                out.flush()
+        else:
+            waited_for_file = True
+        if state.summary is not None:
+            return 0
+        if deadline is not None and time.monotonic() >= deadline:
+            if waited_for_file and state.records == 0:
+                out.write(f"{name}: {path} never appeared\n")
+                return 2
+            out.write(timeout_msg or
+                      f"{name}: timeout waiting for the summary "
+                      "record\n")
+            return 3
+        time.sleep(interval)

@@ -4,8 +4,9 @@ Input: a metrics dict as produced by ``TELEMETRY.metrics_blob()`` /
 ``Booster.get_stats()`` — the blob the CLI writes for ``metrics_out=``,
 ``bench.py`` / ``bench_suite.py`` embed under ``"metrics"``, and
 ``engine.train`` attaches as ``booster.train_stats``.  The current
-``lightgbm_tpu.metrics/v5`` schema and the older v4/v3/v2/v1 blobs are all
-accepted: every section is optional and renders as ``n/a`` when absent.
+``lightgbm_tpu.metrics/v6`` schema and the older v5/v4/v3/v2/v1 blobs are
+all accepted: every section is optional and renders as ``n/a`` when
+absent.
 
 Usage:
   python tools/trace_report.py metrics.json          # a raw blob
@@ -18,9 +19,11 @@ Usage:
 Prints top phases, transfer bytes, compile counters/seconds, network
 collective counters, the iteration count, (v2) the HBM memory envelope
 and XLA cost-analysis utilization digest, (v3) the run-health stream
-digest, and (v4) the measured dispatch-timing table with
-measured-vs-estimated utilization — the digest VERDICT / PERF_NOTES
-rounds quote instead of regex-parsing stderr tails.
+digest, (v4) the measured dispatch-timing table with
+measured-vs-estimated utilization, and (v6) the fleet plane's
+collective wait-vs-work split with the straggler histogram — the
+digest VERDICT / PERF_NOTES rounds quote instead of regex-parsing
+stderr tails.
 """
 
 import json
@@ -140,6 +143,7 @@ def summarize(stats: dict, top: int = 6) -> str:
     lines.extend(_timing_lines(stats))
     lines.extend(_fault_lines(stats))
     lines.extend(_health_lines(stats))
+    lines.extend(_fleet_lines(stats))
     return "\n".join(lines)
 
 
@@ -233,6 +237,34 @@ def _health_lines(stats: dict) -> list:
     if nonfinite:
         out.append(f"  health ALERT: {int(nonfinite)} non-finite "
                    f"gradient/hessian values recorded")
+    return out
+
+
+def _fleet_lines(stats: dict) -> list:
+    fleet = stats.get("fleet")
+    if not fleet:
+        return ["  fleet: n/a (single-host run, fleet_obs_sync_iters=0,"
+                " or pre-v6 blob)"]
+    out = [f"  fleet: {int(fleet.get('windows', 0))} attributed "
+           f"window(s), sync every "
+           f"{fleet.get('sync_iters', '?')} iteration(s)"]
+    per_rank = fleet.get("per_rank") or {}
+    for rank, slot in sorted(per_rank.items(),
+                             key=lambda kv: str(kv[0])):
+        frac = slot.get("wait_fraction")
+        out.append(
+            f"    rank{rank}: wait {slot.get('wait_s', 0.0):.3f}s / "
+            f"work {slot.get('work_s', 0.0):.3f}s over "
+            f"{int(slot.get('calls', 0))} collective call(s)"
+            + (f" ({frac:.0%} waiting)"
+               if isinstance(frac, (int, float)) else ""))
+    hist = fleet.get("straggler_hist") or {}
+    if hist:
+        worst = max(hist, key=hist.get)
+        out.append("    stragglers: "
+                   + " ".join(f"rank{r}={n}x"
+                              for r, n in sorted(hist.items()))
+                   + f" — rank{worst} slowest most often")
     return out
 
 

@@ -32,6 +32,20 @@
 #                                             health-stream
 #                                             well-formedness assertions;
 #                                             writes no artifacts)
+#        bash tools/verify_t1.sh --fleet-smoke (also run the fleet
+#                                             observability smoke: a real
+#                                             2-rank localhost CPU fleet
+#                                             with periodic collective
+#                                             window syncs, per-rank
+#                                             Chrome traces merged onto
+#                                             one skew-corrected timeline
+#                                             by fleet_trace.py, the
+#                                             all-streams fleet_monitor
+#                                             view, and a
+#                                             fleet_summary.json accepted
+#                                             by bench_gate.py; then the
+#                                             gate's own self-test;
+#                                             writes no repo artifacts)
 #        bash tools/verify_t1.sh --with-kernel-checks (also run every
 #                                             kernel variant self-check —
 #                                             fused route, fused-K
@@ -53,6 +67,10 @@ if [ "$1" = "--serve-smoke" ]; then
 fi
 if [ "$1" = "--sched-smoke" ]; then
     timeout -k 10 330 env JAX_PLATFORMS=cpu python tools/submit_jobs.py --smoke || exit 1
+fi
+if [ "$1" = "--fleet-smoke" ]; then
+    timeout -k 10 330 env JAX_PLATFORMS=cpu python tools/fleet_monitor.py --smoke || exit 1
+    python tools/bench_gate.py --self-test || exit 1
 fi
 if [ "$1" = "--with-kernel-checks" ]; then
     timeout -k 10 330 env JAX_PLATFORMS=cpu python -c 'import sys; from lightgbm_tpu.ops.pallas_histogram import run_kernel_self_checks; sys.exit(run_kernel_self_checks())' || exit 1
