@@ -303,6 +303,22 @@ _PARAMS: Dict[str, _P] = {
     # is alive; idle windows are still written so a wedged server is
     # distinguishable from an idle one
     "serve_health_window_s": _P(5.0),
+    # model-and-data drift plane (obs/drift.py, metrics v7): when on, a
+    # serve session accumulates per-(model, feature) bin-occupancy
+    # counts from the already-binned device rows plus a bounded
+    # reservoir of replied raw scores, and each serve_window close
+    # emits a serve_drift record (per-feature PSI vs the training
+    # baseline, score-shift JS).  Host-side accounting only: models
+    # stay byte-identical and replies bit-identical either way
+    "drift_detect": _P(False),
+    # PSI at or above which a model counts as drifted: serve_drift
+    # records flag it, the monitors render the DRIFT banner and
+    # DriftGate.drifted() (the refit trigger) flips.  0.2 is the
+    # classic "act" operating point (0.1 = watch)
+    "drift_psi_threshold": _P(0.2),
+    # how many of the worst-drifting features a serve_drift record
+    # names (sorted by PSI, descending)
+    "drift_topk": _P(5),
     # multi-tenant training scheduler (lightgbm_tpu/sched,
     # docs/SCHEDULING.md): path of a job spec file; a non-empty value
     # (or task=sched) runs the spec's jobs cooperatively time-sliced
@@ -354,6 +370,8 @@ RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection",
                                  "serve_queue_timeout_s",
                                  "serve_health_out",
                                  "serve_health_window_s",
+                                 "drift_detect", "drift_psi_threshold",
+                                 "drift_topk",
                                  "sched", "sched_quantum_chunks",
                                  "sched_policy", "sched_max_jobs",
                                  "sched_health_out",
@@ -573,6 +591,10 @@ class Config:
             raise ValueError("serve_queue_timeout_s must be > 0")
         if self.serve_health_window_s <= 0:
             raise ValueError("serve_health_window_s must be > 0")
+        if self.drift_psi_threshold <= 0:
+            raise ValueError("drift_psi_threshold must be > 0")
+        if self.drift_topk < 1:
+            raise ValueError("drift_topk must be >= 1")
         sp = str(self.sched_policy).strip().lower() or "round_robin"
         sp = {"rr": "round_robin", "fair_share": "fair",
               "deficit": "fair"}.get(sp, sp)

@@ -36,6 +36,29 @@ def _resolve_num_boost_round(params: Dict, num_boost_round: int) -> int:
     return num_boost_round
 
 
+def _importance_summary(booster, topk: int = 8) -> Optional[Dict]:
+    """Top-K feature importances (split + gain, gain-ranked) for the
+    health stream's summary record — model-shape observability on the
+    training side (run_monitor renders it).  Best-effort: a booster
+    that cannot report importances must not fail the summary write."""
+    try:
+        split = booster.feature_importance("split")
+        gain = booster.feature_importance("gain")
+        names = booster.feature_name()
+        order = np.argsort(-gain, kind="stable")
+        top = [{"feature": (names[i] if i < len(names)
+                            else f"Column_{i}"),
+                "split": int(split[i]),
+                "gain": round(float(gain[i]), 6)}
+               for i in (int(j) for j in order) if split[i] > 0][:topk]
+        if not top:
+            return None
+        return {"feature_importance":
+                {"top": top, "features_used": int((split > 0).sum())}}
+    except Exception:
+        return None
+
+
 def train(params: Dict[str, Any], train_set: Dataset,
           num_boost_round: int = 100,
           valid_sets: Optional[List[Dataset]] = None,
@@ -274,8 +297,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
             except Exception:
                 pass
             # summary record (aborted on the failure path) + descriptor
-            # release; the digest stays in stats()' health section
-            HEALTH.close(aborted=failed)
+            # release; the digest stays in stats()' health section.
+            # The summary carries the trained model's top-K feature
+            # importances so the stream describes the model's shape,
+            # not just the run's
+            HEALTH.close(aborted=failed,
+                         extra=_importance_summary(booster))
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.gbdt.current_iteration()
     # success path: snapshot AFTER the finalizing fetch above so the

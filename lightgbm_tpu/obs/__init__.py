@@ -1,4 +1,4 @@
-"""Fleet observability plane (metrics schema v6).
+"""Fleet and model observability planes (metrics schema v7).
 
 Per-rank telemetry (utils/telemetry.py) and per-subsystem health
 streams answer "what did THIS process do" — this package answers the
@@ -14,9 +14,14 @@ it compute or the collective?*
     wall into *wait* (skew-corrected idle before the slowest rank
     arrives) vs *work* (transfer/reduce) seconds, and name the
     straggler rank per window in the health stream.
+  * :mod:`drift` — the model-and-data drift plane (v7): per-feature
+    bin-occupancy PSI and raw-score Jensen–Shannon shift of serve
+    traffic vs each resident model's training baseline, the
+    ``serve_drift`` health records, and the pollable ``DriftGate``
+    refit trigger.
 
 Everything here is host-side timing and IO — trained models stay
-byte-identical with the plane on or off.
+byte-identical with the planes on or off.
 """
 
-from . import clockskew, fleet  # noqa: F401
+from . import clockskew, drift, fleet  # noqa: F401

@@ -1,0 +1,425 @@
+"""Model-and-data drift plane (metrics schema v7).
+
+The systems planes (training telemetry, serve windows, fleet sync)
+answer "is the process healthy" — this module answers "is the MODEL
+still the right one for the traffic it serves".  The paper's design
+makes the data half nearly free: features are pre-quantized into
+<= 255 integer bins and serve/binning.py already bins every request
+row on device against the training BinMapper bounds, so per-feature
+input drift reduces to integer bin-occupancy counting with zero extra
+binning work.
+
+Three pieces:
+
+  * :func:`extract_baseline` — at registry load time, recount the
+    training Dataset's binned matrix into a per-used-feature
+    ``[F, B]`` bin-occupancy histogram (EFB bundles are unpacked back
+    to feature bins) and digest the training predictions
+    (``gbdt.train_score``) into a fixed set of raw-score quantile
+    edges.  Pure host numpy over data the booster already holds — no
+    re-binning, no device work.
+  * :class:`DriftAccumulator` — the serve-side sink: per-model
+    cumulative ``[F, B]`` bin counts fed by the predictor's compiled
+    occupancy output plus a bounded deterministic reservoir of replied
+    raw scores.  ``compute()`` turns the accumulated counts into
+    per-feature PSI and a score-shift Jensen–Shannon divergence
+    against the baseline.
+  * :class:`DriftGate` — the pollable refit trigger:
+    ``drifted(model_id)`` is True exactly when the current
+    ``psi_max`` is at or above ``drift_psi_threshold``.
+
+Estimator notes.  PSI over raw fine bins is dominated by sampling
+noise (E[PSI] ~ bins/rows — with 255 bins and a few hundred observed
+rows that alone exceeds any sane threshold), so each feature's fine
+bins are grouped into at most :data:`PSI_BUCKETS` coarse buckets of
+roughly equal TRAINING mass and PSI is computed over the buckets:
+
+    PSI  = sum_b (q_b - p_b) * ln(q_b / p_b)
+    JS   = (KL(p||m) + KL(q||m)) / 2,  m = (p+q)/2   (<= ln 2)
+
+with additive smoothing ``p_b = (c_b + eps) / (n + eps*K)`` so empty
+buckets stay finite.  The fine ``[F, B]`` counts are retained — tests
+recount them directly against numpy over the raw rows.
+
+Everything here is host-side accounting over values the serve path
+already produced: trained models stay byte-identical with the plane
+on or off, and every reply stays bit-identical to ``Booster.predict``
+(the occupancy output rides NEXT TO the leaves, never touches them).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# coarse PSI buckets per feature (equal training mass); the noise
+# floor of a window with n distinct rows is ~PSI_BUCKETS/n
+PSI_BUCKETS = 10
+# raw-score digest resolution (quantile edges of the training scores)
+SCORE_BUCKETS = 16
+# bounded reservoir of replied raw scores per model (deterministic
+# replacement so runs and tests reproduce)
+SCORE_RESERVOIR = 4096
+# additive smoothing mass per bucket
+SMOOTH_EPS = 1e-4
+
+
+# ----------------------------------------------------------- estimators
+def _smooth(counts, eps: float = SMOOTH_EPS) -> np.ndarray:
+    c = np.asarray(counts, dtype=np.float64).ravel()
+    return (c + eps) / (c.sum() + eps * c.shape[0])
+
+
+def psi(expected_counts, actual_counts, eps: float = SMOOTH_EPS) -> float:
+    """Population Stability Index between two count vectors over the
+    same buckets.  Symmetric, >= 0, ~0 for matching distributions;
+    the classic operating points are 0.1 (watch) and 0.2 (act)."""
+    p = _smooth(expected_counts, eps)
+    q = _smooth(actual_counts, eps)
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+def js_divergence(p_counts, q_counts, eps: float = SMOOTH_EPS) -> float:
+    """Jensen–Shannon divergence (natural log, bounded by ln 2)."""
+    p = _smooth(p_counts, eps)
+    q = _smooth(q_counts, eps)
+    m = 0.5 * (p + q)
+    return float(0.5 * np.sum(p * np.log(p / m))
+                 + 0.5 * np.sum(q * np.log(q / m)))
+
+
+# ------------------------------------------------------------- baseline
+def dataset_bin_counts(ds) -> np.ndarray:
+    """``[F, B]`` int64 bin-occupancy of the training Dataset's binned
+    matrix, per USED feature, B = max num_bin across used features.
+    EFB bundles are unpacked: a bundled column stores feature f's bin
+    b as ``feat_offset[f] + b`` with the shared slot 0 (or any value
+    outside f's range) meaning "f at its default_bin"."""
+    used = ds.used_feature_indices
+    F = len(used)
+    num_bin = np.asarray([ds.bin_mappers[int(f)].num_bin for f in used],
+                         dtype=np.int64)
+    B = int(num_bin.max()) if F else 1
+    out = np.zeros((F, B), dtype=np.int64)
+    binned = ds.host_binned()
+    for j in range(F):
+        f = int(used[j])
+        default_bin = int(ds.bin_mappers[f].default_bin)
+        if ds.bundle is not None:
+            col = binned[:, int(ds.bundle.feat_group[j])].astype(np.int64)
+            off = int(ds.bundle.feat_offset[j])
+            if off:     # multi-feature group (offset 0 = single-feature)
+                inside = (col >= off) & (col < off + num_bin[j])
+                col = np.where(inside, col - off, default_bin)
+        else:
+            col = binned[:, j].astype(np.int64)
+        out[j] = np.bincount(np.clip(col, 0, num_bin[j] - 1),
+                             minlength=B)[:B]
+    return out
+
+
+def _bucketize(counts_f: np.ndarray, nbin: int,
+               k: int = PSI_BUCKETS) -> np.ndarray:
+    """Fine-bin -> coarse-bucket map for one feature: contiguous runs
+    of fine bins holding roughly 1/k of the training mass each.  For
+    categoricals the bin order is arbitrary but the map is FIXED, and
+    PSI is permutation-invariant given a fixed grouping."""
+    k = max(1, min(int(k), int(nbin)))
+    c = counts_f[:nbin].astype(np.float64)
+    total = c.sum()
+    if total <= 0:
+        return np.zeros(nbin, dtype=np.int64)
+    before = np.cumsum(c) - c        # training mass strictly before bin i
+    return np.minimum((before / (total / k)).astype(np.int64), k - 1)
+
+
+class ModelBaseline:
+    """Training-time reference distributions of one resident model."""
+
+    __slots__ = ("feature_names", "num_bin", "bin_counts", "bucket_of",
+                 "bucket_counts", "score_edges", "score_counts", "rows")
+
+    def __init__(self, feature_names, num_bin, bin_counts, bucket_of,
+                 bucket_counts, score_edges, score_counts, rows):
+        self.feature_names = feature_names    # [F] str, per used feature
+        self.num_bin = num_bin                # [F] int
+        self.bin_counts = bin_counts          # [F, B] int64 fine counts
+        self.bucket_of = bucket_of            # [F, B] int64 bin->bucket
+        self.bucket_counts = bucket_counts    # [F, K] float64
+        self.score_edges = score_edges        # [E] f64 or None
+        self.score_counts = score_counts      # [E+1] int64 or None
+        self.rows = rows
+
+    @property
+    def num_features(self) -> int:
+        return int(self.bin_counts.shape[0])
+
+
+def _score_digest(scores: np.ndarray):
+    """(edges, counts) quantile digest of the training raw scores, or
+    (None, None) when the scores are unusable (e.g. invalidated by a
+    rollback)."""
+    s = np.asarray(scores, dtype=np.float64).ravel()
+    s = s[np.isfinite(s)]
+    if s.size == 0:
+        return None, None
+    qs = np.linspace(0.0, 1.0, SCORE_BUCKETS + 1)[1:-1]
+    edges = np.unique(np.quantile(s, qs))
+    counts = np.bincount(np.searchsorted(edges, s, side="right"),
+                         minlength=edges.size + 1)
+    return edges, counts.astype(np.int64)
+
+
+def extract_baseline(booster, psi_buckets: int = PSI_BUCKETS,
+                     ) -> ModelBaseline:
+    """Training baseline of a serve-loadable booster: fine bin counts
+    from the Dataset's binned matrix, the equal-mass coarse-bucket map
+    PSI runs over, and the raw-score quantile digest."""
+    gbdt = booster.gbdt
+    ds = gbdt.train_set
+    counts = dataset_bin_counts(ds)
+    used = ds.used_feature_indices
+    all_names = list(getattr(ds, "feature_names", []) or [])
+    names = [all_names[int(f)] if int(f) < len(all_names)
+             else f"Column_{int(f)}" for f in used]
+    num_bin = np.asarray([ds.bin_mappers[int(f)].num_bin for f in used],
+                         dtype=np.int64)
+    F, B = counts.shape
+    bucket_of = np.zeros((F, B), dtype=np.int64)
+    bucket_counts = np.zeros((F, PSI_BUCKETS), dtype=np.float64)
+    for j in range(F):
+        nb = int(num_bin[j])
+        bof = _bucketize(counts[j], nb, psi_buckets)
+        bucket_of[j, :nb] = bof
+        bucket_counts[j] = np.bincount(
+            bof, weights=counts[j, :nb].astype(np.float64),
+            minlength=PSI_BUCKETS)[:PSI_BUCKETS]
+    # raw-score digest over the training predictions; train_score is a
+    # running SUM for RF-style ensembles, so mirror predict's averaging
+    scores = np.asarray(gbdt.train_score, dtype=np.float64)[0]
+    if bool(getattr(gbdt, "average_output", False)):
+        C = max(int(gbdt.num_tree_per_iteration), 1)
+        scores = scores / max(len(gbdt.models) // C, 1)
+    edges, score_counts = _score_digest(scores)
+    return ModelBaseline(names, num_bin, counts, bucket_of, bucket_counts,
+                         edges, score_counts, int(counts[0].sum())
+                         if F else 0)
+
+
+# ---------------------------------------------------------- accumulator
+class _ModelState:
+    __slots__ = ("baseline", "fine", "scores", "seen_scores", "rows",
+                 "rows_emitted", "rng")
+
+    def __init__(self, baseline: ModelBaseline, seed: int):
+        self.baseline = baseline
+        self.fine = np.zeros_like(baseline.bin_counts)
+        self.scores: List[float] = []
+        self.seen_scores = 0
+        self.rows = 0
+        self.rows_emitted = 0
+        self.rng = random.Random(seed)
+
+
+class DriftAccumulator:
+    """Per-(model, feature) serve-side occupancy counts + score
+    reservoir, compared against each model's training baseline.
+
+    Counts are CUMULATIVE for the session — every ``compute()`` sees
+    all traffic since load, so the refit signal stabilizes as rows
+    accumulate instead of resetting to the noise floor each window."""
+
+    def __init__(self, psi_threshold: float = 0.2, topk: int = 5,
+                 reservoir: int = SCORE_RESERVOIR):
+        self._lock = threading.Lock()
+        self._models: Dict[str, _ModelState] = {}
+        self.psi_threshold = float(psi_threshold)
+        self.topk = max(int(topk), 1)
+        self.reservoir = max(int(reservoir), 1)
+
+    # ------------------------------------------------------- registration
+    def register(self, model_id: str, baseline: ModelBaseline) -> None:
+        with self._lock:
+            self._models[model_id] = _ModelState(
+                baseline, seed=hash(model_id) & 0x7FFFFFFF)
+
+    def forget(self, model_id: str) -> None:
+        with self._lock:
+            self._models.pop(model_id, None)
+
+    def tracks(self, model_id: str) -> bool:
+        with self._lock:
+            return model_id in self._models
+
+    # -------------------------------------------------------------- feeds
+    def note_bins(self, model_id: str, counts: np.ndarray) -> None:
+        """Add one dispatch's per-feature occupancy counts (rows beyond
+        the model's [F, B] shape are pack padding and are dropped)."""
+        with self._lock:
+            st = self._models.get(model_id)
+            if st is None:
+                return
+            F, B = st.fine.shape
+            c = np.asarray(counts, dtype=np.int64)[:F, :B]
+            st.fine[: c.shape[0], : c.shape[1]] += c
+            st.rows += int(c[0].sum()) if c.shape[0] else 0
+
+    def note_scores(self, model_id: str, scores) -> None:
+        """Reservoir-sample one batch of replied raw scores."""
+        vals = np.asarray(scores, dtype=np.float64).ravel()
+        with self._lock:
+            st = self._models.get(model_id)
+            if st is None:
+                return
+            for v in vals:
+                st.seen_scores += 1
+                if len(st.scores) < self.reservoir:
+                    st.scores.append(float(v))
+                else:
+                    i = st.rng.randrange(st.seen_scores)
+                    if i < self.reservoir:
+                        st.scores[i] = float(v)
+
+    # ------------------------------------------------------------ compute
+    def compute(self, model_id: str) -> Optional[Dict[str, Any]]:
+        """Current drift statistics vs baseline, or None when the model
+        is untracked or has seen no rows."""
+        with self._lock:
+            st = self._models.get(model_id)
+            if st is None or st.rows <= 0:
+                return None
+            fine = st.fine.copy()
+            scores = list(st.scores)
+            rows = st.rows
+            base = st.baseline
+        per_feature = []
+        for j in range(base.num_features):
+            nb = int(base.num_bin[j])
+            actual = np.bincount(base.bucket_of[j, :nb],
+                                 weights=fine[j, :nb].astype(np.float64),
+                                 minlength=PSI_BUCKETS)[:PSI_BUCKETS]
+            per_feature.append(
+                (base.feature_names[j],
+                 psi(base.bucket_counts[j], actual)))
+        per_feature.sort(key=lambda kv: -kv[1])
+        psi_max = per_feature[0][1] if per_feature else 0.0
+        rec: Dict[str, Any] = {
+            "model": model_id,
+            "rows": int(rows),
+            "psi_max": round(float(psi_max), 6),
+            "top": [{"feature": n, "psi": round(float(v), 6)}
+                    for n, v in per_feature[: self.topk]],
+            "threshold": round(self.psi_threshold, 6),
+            "drifted": bool(psi_max >= self.psi_threshold),
+        }
+        if base.score_edges is not None and scores:
+            hist = np.bincount(
+                np.searchsorted(base.score_edges, np.asarray(scores),
+                                side="right"),
+                minlength=base.score_edges.size + 1)
+            rec["score_js"] = round(
+                js_divergence(base.score_counts, hist), 6)
+            rec["scores"] = len(scores)
+        return rec
+
+    # -------------------------------------------------------- publication
+    def window_records(self) -> List[Dict[str, Any]]:
+        """Records for one serve_window close: every tracked model with
+        NEW rows since the last emission (idle models stay silent, so a
+        quiet stream means quiet traffic, not a wedged plane)."""
+        fresh = []
+        with self._lock:
+            for mid, st in self._models.items():
+                if st.rows > st.rows_emitted:
+                    st.rows_emitted = st.rows
+                    fresh.append(mid)
+        return self._publish(fresh)
+
+    def publish_all(self) -> List[Dict[str, Any]]:
+        """Final flush (queue close without a health stream): publish
+        every model that saw traffic, regardless of emission history."""
+        with self._lock:
+            fresh = [mid for mid, st in self._models.items()
+                     if st.rows > 0]
+            for mid in fresh:
+                self._models[mid].rows_emitted = self._models[mid].rows
+        return self._publish(fresh)
+
+    def _publish(self, model_ids) -> List[Dict[str, Any]]:
+        from ..utils.telemetry import TELEMETRY
+        out = []
+        for mid in model_ids:
+            rec = self.compute(mid)
+            if rec is not None:
+                out.append(rec)
+                _section_update(self.psi_threshold, rec)
+        if out:
+            TELEMETRY.gauge_set(
+                "serve/drift_psi_max",
+                max(r["psi_max"] for r in out))
+            js = [r["score_js"] for r in out if "score_js" in r]
+            if js:
+                TELEMETRY.gauge_set("serve/score_js", max(js))
+        return out
+
+
+class DriftGate:
+    """The pollable refit trigger the continuous-learning loop and the
+    sched/serve arbiter consume: ``drifted()`` is True exactly when
+    the model's current ``psi_max`` >= the threshold."""
+
+    def __init__(self, accumulator: DriftAccumulator,
+                 psi_threshold: Optional[float] = None):
+        self._acc = accumulator
+        self.psi_threshold = (accumulator.psi_threshold
+                              if psi_threshold is None
+                              else float(psi_threshold))
+
+    def stats(self, model_id: str) -> Optional[Dict[str, Any]]:
+        return self._acc.compute(model_id)
+
+    def drifted(self, model_id: str,
+                psi_threshold: Optional[float] = None) -> bool:
+        thr = self.psi_threshold if psi_threshold is None \
+            else float(psi_threshold)
+        rec = self._acc.compute(model_id)
+        return rec is not None and rec["psi_max"] >= thr
+
+
+# --------------------------------------------------- stats-blob section
+# last published per-model drift state feeding stats()["drift"]; empty
+# until a window (or final flush) synced, so pre-drift blobs keep their
+# v6 shape exactly
+_SECTION_LOCK = threading.Lock()
+_SECTION: Dict[str, Dict[str, Any]] = {}
+_SECTION_THRESHOLD: Optional[float] = None
+
+
+def _section_update(threshold: float, rec: Dict[str, Any]) -> None:
+    global _SECTION_THRESHOLD
+    with _SECTION_LOCK:
+        _SECTION_THRESHOLD = round(float(threshold), 6)
+        _SECTION[rec["model"]] = {
+            k: v for k, v in rec.items() if k != "model"}
+
+
+def drift_section() -> Optional[Dict[str, Any]]:
+    """The metrics-blob ``drift`` section, or None when no drift window
+    has synced (keeps older blobs byte-shaped as v6)."""
+    with _SECTION_LOCK:
+        if not _SECTION:
+            return None
+        return {"psi_threshold": _SECTION_THRESHOLD,
+                "models": {mid: dict(rec)
+                           for mid, rec in _SECTION.items()}}
+
+
+def reset() -> None:
+    """Drop the published section (test/bench windows)."""
+    global _SECTION_THRESHOLD
+    with _SECTION_LOCK:
+        _SECTION.clear()
+        _SECTION_THRESHOLD = None

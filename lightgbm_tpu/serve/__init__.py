@@ -11,8 +11,16 @@ Layering (each file usable on its own):
   queue.py      request micro-batching with per-request futures and the
                 serve_max_delay_ms / serve_max_batch knob
   health.py     serve health stream: serve_start/serve_window/
-                serve_admit/serve_fault/serve_summary JSONL records
-                (serve_health_out= / LIGHTGBM_TPU_SERVE_HEALTH_JSONL)
+                serve_admit/serve_drift/serve_fault/serve_summary
+                JSONL records (serve_health_out= /
+                LIGHTGBM_TPU_SERVE_HEALTH_JSONL)
+
+``drift_detect=true`` additionally wires the model-and-data drift
+plane (obs/drift.py) through all four layers: training baselines are
+captured at load, the predictor's compiled executables return the
+per-feature bin occupancy of every replied batch, windows emit
+``serve_drift`` records, and ``session.drift_gate.drifted(model_id)``
+is the pollable refit trigger.
 
 ``ServeSession`` wires them together; ``Booster.serve()`` (basic.py)
 is the one-liner entry point returning a handle bound to that
@@ -51,7 +59,9 @@ class ServeSession:
     def __init__(self, max_batch: int = 256, max_delay_ms: float = 2.0,
                  queue_timeout_s: float = 30.0,
                  admit_fraction: float = SERVE_ADMIT_FRACTION,
-                 health_out: str = "", health_window_s: float = 5.0):
+                 health_out: str = "", health_window_s: float = 5.0,
+                 drift_detect: bool = False,
+                 drift_psi_threshold: float = 0.2, drift_topk: int = 5):
         path = resolve_serve_health_path(override=health_out)
         self.health = None
         if path:
@@ -60,25 +70,41 @@ class ServeSession:
                 meta={"pid": os.getpid(), "max_batch": int(max_batch),
                       "max_delay_ms": float(max_delay_ms)})
         TELEMETRY.gauge_set("serve/max_batch", int(max_batch))
+        # model-and-data drift plane (obs/drift.py): baseline capture
+        # at load, occupancy/score accumulation in the predictor, one
+        # serve_drift record per window, DriftGate as the refit trigger
+        self.drift = None
+        self.drift_gate = None
+        if drift_detect:
+            from ..obs.drift import DriftAccumulator, DriftGate
+            self.drift = DriftAccumulator(
+                psi_threshold=drift_psi_threshold, topk=drift_topk)
+            self.drift_gate = DriftGate(self.drift)
+        if self.health is not None:
+            self.health.drift = self.drift
         self.registry = ModelRegistry(max_batch=max_batch,
                                       admit_fraction=admit_fraction)
         self.registry.health = self.health
+        self.registry.drift = self.drift
         self.predictor = BucketedPredictor(self.registry,
                                            max_batch=max_batch)
         self.predictor.health = self.health
+        self.predictor.drift = self.drift
         self.queue = MicroBatchQueue(self.predictor,
                                      max_delay_ms=max_delay_ms,
                                      max_batch=max_batch,
                                      queue_timeout_s=queue_timeout_s,
                                      health=self.health)
+        self.queue.drift = self.drift
 
     @classmethod
     def from_config(cls, config, **overrides):
         """Knobs from a Config (serve_max_batch, serve_max_delay_ms,
         serve_queue_timeout_s, serve_health_out,
-        serve_health_window_s), keyword overrides winning.  Overrides
-        accept both the constructor names (``max_batch``) and the
-        config-parameter spellings (``serve_max_batch``)."""
+        serve_health_window_s, drift_detect, drift_psi_threshold,
+        drift_topk), keyword overrides winning.  Overrides accept both
+        the constructor names (``max_batch``) and the config-parameter
+        spellings (``serve_max_batch``)."""
         kw = {}
         if config is not None:
             kw = {"max_batch": config.serve_max_batch,
@@ -86,7 +112,13 @@ class ServeSession:
                   "queue_timeout_s": config.serve_queue_timeout_s,
                   "health_out": getattr(config, "serve_health_out", ""),
                   "health_window_s": getattr(config,
-                                             "serve_health_window_s", 5.0)}
+                                             "serve_health_window_s", 5.0),
+                  "drift_detect": bool(getattr(config, "drift_detect",
+                                               False)),
+                  "drift_psi_threshold": getattr(config,
+                                                 "drift_psi_threshold",
+                                                 0.2),
+                  "drift_topk": getattr(config, "drift_topk", 5)}
         for k, v in overrides.items():
             kw[k[6:] if k.startswith("serve_") else k] = v
         return cls(**kw)

@@ -140,3 +140,26 @@ def bin_rows(tables, X):
         tables["bounds"], tables["cat_vals"], tables["cat_bins"],
         tables["num_bin"], tables["missing_type"],
         tables["is_cat"], Xu)
+
+
+def bin_occupancy(tables, bins, n_valid, num_bin_axis: int):
+    """Jittable: per-feature occupancy counts ``[F, num_bin_axis]`` i32
+    of already-binned rows ``[B, F]`` — the drift plane's data feed.
+
+    Rows at index >= ``n_valid`` are bucket padding and are masked
+    out, so the counts describe exactly the replied rows.  Unseen
+    categoricals arrive as the -1 sentinel (see :func:`bin_rows`) and
+    are counted into the feature's LAST bin, which is where the host
+    ``value_to_bin`` puts them in the training binned matrix — serve
+    occupancy stays comparable with a baseline counted from that
+    matrix.  ``n_valid`` is a traced scalar: one executable per bucket
+    serves every partial batch in it.
+    """
+    import jax.numpy as jnp
+
+    nb = tables["num_bin"]
+    counted = jnp.where(bins < 0, nb[None, :] - 1, bins)
+    valid = jnp.arange(bins.shape[0]) < n_valid
+    hits = (counted[:, :, None] ==
+            jnp.arange(num_bin_axis)[None, None, :]) & valid[:, None, None]
+    return hits.sum(axis=0).astype(jnp.int32)

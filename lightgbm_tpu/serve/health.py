@@ -19,6 +19,12 @@ truncate) a training run's health file.  Record kinds:
     server is distinguishable from an idle one.
   * ``serve_admit`` — mirror of every registry admission decision
     (admitted / rejected / evicted, full detail string).
+  * ``serve_drift`` — one per model with new traffic at each window
+    close, when the session runs with ``drift_detect=true``
+    (obs/drift.py): cumulative rows observed, per-feature PSI of the
+    served bin occupancy vs the model's training baseline (top-K
+    drifting features by name), the raw-score Jensen–Shannon shift,
+    the gate threshold and the ``drifted`` verdict.
   * ``serve_fault`` — a dispatch error, injected fault or predictor
     exception that failed request futures.
   * ``serve_summary`` — terminal record from ``close()``: lifetime
@@ -106,6 +112,7 @@ class ServeHealth:
         # lifetime totals for the serve_summary record
         self._total = defaultdict(int)
         self._closed = False
+        self.drift = None       # obs/drift.DriftAccumulator, session-wired
         self._stream = HealthStream()
         rec: Dict[str, Any] = {"stream": "serve",
                                "window_s": round(self.window_s, 3)}
@@ -217,6 +224,12 @@ class ServeHealth:
         w, span = self._snapshot_window()
         self._stream.record("serve_window",
                             self._window_record(w, span, max_batch))
+        if self.drift is not None:
+            # drift rides the window cadence: one serve_drift record
+            # per model with new rows since the last emission, plus
+            # the serve/drift_psi_max and serve/score_js gauges
+            for rec in self.drift.window_records():
+                self._stream.record("serve_drift", rec)
 
     def _run(self) -> None:
         while not self._stop.wait(self.window_s):

@@ -55,17 +55,21 @@ class BucketedPredictor:
         self._rows = 0
         self._padded = 0
         self.health = None      # serve/health.ServeHealth, session-wired
+        self.drift = None       # obs/drift.DriftAccumulator, session-wired
 
     # ----------------------------------------------------------- compile
-    def _fn_for(self, model_id: str, bucket: int):
+    def _fn_for(self, model_id: str, bucket: int, with_drift: bool = False):
         """The jitted (CostJit-wrapped) executable for one bucket; built
         once, reused for every later batch in the bucket.  A registry
-        pack rebuild (load/evict) invalidates the whole cache."""
+        pack rebuild (load/evict) invalidates the whole cache.  The
+        ``with_drift`` variant additionally returns the per-feature
+        bin-occupancy counts of the VALID rows (obs/drift.py) — the
+        leaves output is untouched, so replies stay bit-identical."""
         with self._lock:
             if self._fns_version != self.registry.pack_version:
                 self._fns.clear()
                 self._fns_version = self.registry.pack_version
-            key = (model_id, bucket)
+            key = (model_id, bucket, with_drift)
             fn = self._fns.get(key)
             if fn is not None:
                 return fn
@@ -78,11 +82,12 @@ class BucketedPredictor:
             entry = self.registry.entry(model_id)
             m = self.registry.row_of(model_id)
             max_depth = entry.max_depth
+            num_bin_axis = int(entry.tables["num_bin"].max())
 
-            def leaves_fn(pack, X):
+            def leaves_fn(pack, X, n_valid=None):
                 import jax.numpy as jnp
 
-                from .binning import bin_rows
+                from .binning import bin_occupancy, bin_rows
                 tables = {k[4:]: v[m] for k, v in pack.items()
                           if k.startswith("tab_")}
                 bins = bin_rows(tables, X)
@@ -95,13 +100,22 @@ class BucketedPredictor:
                     jnp.zeros((pack["num_leaves"].shape[1], 1),
                               dtype=jnp.float32),
                     pack["num_leaves"][m], max_depth)
-                return predict_binned_leaves(stack, bins,
-                                             tables["num_bin"],
-                                             tables["default_bin"])
+                leaves = predict_binned_leaves(stack, bins,
+                                               tables["num_bin"],
+                                               tables["default_bin"])
+                if n_valid is None:
+                    return leaves
+                return leaves, bin_occupancy(tables, bins, n_valid,
+                                             num_bin_axis)
 
             import jax
-            fn = cost_jit(f"serve/predict[{model_id}:b{bucket}]",
-                          jax.jit(leaves_fn))
+            if with_drift:
+                jitted = jax.jit(lambda pack, X, n_valid:
+                                 leaves_fn(pack, X, n_valid))
+            else:
+                jitted = jax.jit(leaves_fn)
+            fn = cost_jit(f"serve/predict[{model_id}:b{bucket}"
+                          f"{':drift' if with_drift else ''}]", jitted)
             self._fns[key] = fn
             return fn
 
@@ -111,13 +125,23 @@ class BucketedPredictor:
         import jax.numpy as jnp
         B = X.shape[0]
         bucket = _next_bucket(B)
-        fn = self._fn_for(model_id, bucket)
+        drift = self.drift
+        if drift is not None and not drift.tracks(model_id):
+            drift = None
+        fn = self._fn_for(model_id, bucket, with_drift=drift is not None)
         pad = bucket - B
         if pad:
             X = np.concatenate(
                 [X, np.zeros((pad, X.shape[1]), dtype=X.dtype)])
         pack = self.registry.pack()
-        leaves = np.asarray(fn(pack, jnp.asarray(X)))
+        if drift is not None:
+            # n_valid is traced, so every partial batch in the bucket
+            # reuses one executable; pad rows are masked from the counts
+            leaves, occupancy = fn(pack, jnp.asarray(X), jnp.int32(B))
+            leaves = np.asarray(leaves)
+            drift.note_bins(model_id, np.asarray(occupancy))
+        else:
+            leaves = np.asarray(fn(pack, jnp.asarray(X)))
         with self._lock:
             self._rows += B
             self._padded += pad
@@ -159,6 +183,10 @@ class BucketedPredictor:
             done += chunk.shape[0]
         if entry.average_output:
             out /= max(len(entry.trees) // max(C, 1), 1)
+        if self.drift is not None:
+            # raw first-output scores (post averaging, pre link), the
+            # same scale as the training-score digest in the baseline
+            self.drift.note_scores(model_id, out[0])
         if raw_score or entry.objective is None:
             res = out
         else:

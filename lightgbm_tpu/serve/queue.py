@@ -73,6 +73,7 @@ class MicroBatchQueue:
         self.max_batch = int(max_batch)
         self.queue_timeout_s = float(queue_timeout_s)
         self.health = health            # serve/health.ServeHealth or None
+        self.drift = None               # obs/drift.DriftAccumulator or None
         self._pending = deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -135,6 +136,11 @@ class MicroBatchQueue:
         TELEMETRY.gauge_set("serve/queue_depth", 0)
         if self.health is not None:
             self.health.close(pending_failed=len(leftovers))
+        elif self.drift is not None:
+            # no health stream to flush through: publish the final
+            # drift state directly so post-close DriftGate polls and
+            # the metrics blob's drift section see all the traffic
+            self.drift.publish_all()
 
     def __enter__(self):
         return self

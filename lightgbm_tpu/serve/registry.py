@@ -48,11 +48,13 @@ class ResidentModel:
     __slots__ = ("model_id", "trees", "num_tree_per_iteration",
                  "init_scores", "objective", "max_feature_idx",
                  "average_output", "tables", "stack", "max_depth",
-                 "nbytes")
+                 "nbytes", "baseline")
 
     def __init__(self, model_id, trees, num_tree_per_iteration, init_scores,
                  objective, max_feature_idx, average_output, tables, stack,
                  max_depth, nbytes):
+        self.baseline = None          # obs/drift.ModelBaseline when the
+                                      # session runs with drift_detect
         self.model_id = model_id
         self.trees = trees
         self.num_tree_per_iteration = num_tree_per_iteration
@@ -138,6 +140,7 @@ class ModelRegistry:
         self.max_batch = int(max_batch)
         self.admit_fraction = float(admit_fraction)
         self.health = None      # serve/health.ServeHealth, session-wired
+        self.drift = None       # obs/drift.DriftAccumulator, session-wired
 
     def _admit_record(self, detail: str) -> None:
         """Every admission decision lands in the telemetry faults section
@@ -170,6 +173,14 @@ class ModelRegistry:
                                   objective, max_fi, avg_out, tables,
                                   stack[:-1], max_depth, nbytes)
             self._admit_or_raise(entry)
+            if self.drift is not None:
+                # training baseline rides next to the pack: fine bin
+                # occupancy of the Dataset's binned matrix + the
+                # raw-score quantile digest the drift windows compare
+                # against (host numpy; nothing extra uploaded)
+                from ..obs.drift import extract_baseline
+                entry.baseline = extract_baseline(booster)
+                self.drift.register(model_id, entry.baseline)
             self._models[model_id] = entry
             self._order.append(model_id)
             self._pack = None
@@ -182,6 +193,8 @@ class ModelRegistry:
                 raise ServeError(f"model_id {model_id!r} is not resident")
             del self._models[model_id]
             self._order.remove(model_id)
+            if self.drift is not None:
+                self.drift.forget(model_id)
             self._pack = None
             self.pack_version += 1
             self._admit_record(

@@ -113,8 +113,8 @@ from collections import defaultdict, deque
 from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
-METRICS_SCHEMA = "lightgbm_tpu.metrics/v6"
-METRICS_VERSION = 6
+METRICS_SCHEMA = "lightgbm_tpu.metrics/v7"
+METRICS_VERSION = 7
 HEALTH_SCHEMA = "lightgbm_tpu.health/v1"
 HEALTH_ENV = "LIGHTGBM_TPU_HEALTH_JSONL"
 TIMING_ENV = "LIGHTGBM_TPU_DEVICE_TIMING"
@@ -291,10 +291,13 @@ class HealthStream:
         for _, rec in kept:
             self._ingest(rec)
 
-    def close(self, summary: bool = True, aborted: bool = False) -> None:
+    def close(self, summary: bool = True, aborted: bool = False,
+              extra: Optional[Dict[str, Any]] = None) -> None:
         """Write the ``summary`` record (unless suppressed) and release
-        the descriptor.  The digest state stays readable afterwards so
-        a post-run ``stats()`` still carries the ``health`` section."""
+        the descriptor.  ``extra`` fields are merged into the summary
+        (e.g. the trainer's top-K feature importances).  The digest
+        state stays readable afterwards so a post-run ``stats()`` still
+        carries the ``health`` section."""
         with self._lock:
             if self._fd is None:
                 return
@@ -310,6 +313,8 @@ class HealthStream:
                     rec["iterations"] = int(self._last_iter["iter"]) + 1
                 if self._nonfinite_total:
                     rec["nonfinite_total"] = self._nonfinite_total
+                if extra:
+                    rec.update(extra)
                 self._ingest(rec)
                 self._write(rec)
             fd, self._fd = self._fd, None
@@ -1067,7 +1072,11 @@ class TelemetryRegistry:
         ``fleet`` section — cross-rank collective wait-vs-work
         attribution (per-rank wait seconds, slowest-rank histogram,
         clock-offset table) — present only when the fleet observability
-        plane synced at least one window."""
+        plane synced at least one window.  v7 adds the ``drift``
+        section — per-model serve-traffic drift vs training baseline
+        (per-feature PSI, score-shift JS, the gate threshold) — present
+        only when a drift window synced, so earlier blobs keep their
+        v6 shape."""
         import sys
         from .phase import GLOBAL_TIMER, _sync_enabled
         with self._lock:
@@ -1120,6 +1129,11 @@ class TelemetryRegistry:
             fleet = fleet_mod.fleet_section()
             if fleet is not None:
                 out["fleet"] = fleet
+        drift_mod = sys.modules.get("lightgbm_tpu.obs.drift")
+        if drift_mod is not None and hasattr(drift_mod, "drift_section"):
+            drift = drift_mod.drift_section()
+            if drift is not None:
+                out["drift"] = drift
         return out
 
     def chrome_trace(self) -> Dict[str, Any]:
@@ -1248,6 +1262,9 @@ class TelemetryRegistry:
         net = sys.modules.get("lightgbm_tpu.parallel.network")
         if net is not None and hasattr(net, "reset_collective_stats"):
             net.reset_collective_stats()
+        drift_mod = sys.modules.get("lightgbm_tpu.obs.drift")
+        if drift_mod is not None and hasattr(drift_mod, "reset"):
+            drift_mod.reset()
         HEALTH.reset()
         self.refresh_level()
 

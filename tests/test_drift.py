@@ -1,0 +1,379 @@
+"""Drift plane tests (metrics schema v7).
+
+What must hold: the PSI/JS estimators are finite, symmetric and ~0 on
+matching distributions; ``extract_baseline`` recounts the training
+Dataset's binned matrix exactly (numpy recount per raw column, EFB
+bundles unpacked) and digests the training scores over quantile edges;
+the serve-side accumulator's cumulative row accounting survives real
+coalesced batches through the queue; a shifted column in live traffic
+is detected AND named through the real queue path while every reply
+stays bit-identical to ``Booster.predict``; the ``DriftGate`` flips
+exactly at ``psi_max >= threshold``; training stays byte-identical
+with the ``drift_*`` knobs in params (runtime-only); a blob from a
+session that never synced a drift window keeps the v6 shape (no
+``drift`` key); and the monitors render the loud ``!! DRIFT`` banner.
+"""
+
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import RUNTIME_ONLY_PARAMS, Config
+from lightgbm_tpu.obs import drift
+from lightgbm_tpu.serve import ServeSession
+from lightgbm_tpu.utils.faults import FAULTS
+from lightgbm_tpu.utils.telemetry import TELEMETRY
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import fleet_monitor  # noqa: E402
+import serve_monitor  # noqa: E402
+import trace_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    TELEMETRY.reset()
+    TELEMETRY.set_config_level(1)
+    TELEMETRY.install_jax_listeners()
+    yield
+    FAULTS.configure()
+
+
+def _train(rng, rounds=8):
+    X = rng.normal(size=(400, 8))
+    X[:, 3] = rng.randint(0, 6, size=400)
+    y = (np.nan_to_num(X[:, 0] + X[:, 1]) > 0.3).astype(np.float64)
+    ds = lgb.Dataset(X, y, categorical_feature=[3])
+    return lgb.train({"objective": "binary", "verbose": -1,
+                      "num_leaves": 15}, ds,
+                     num_boost_round=rounds), X
+
+
+def _records(path):
+    out = []
+    with open(path, "rb") as fh:
+        for raw in fh.read().split(b"\n"):
+            if raw.strip():
+                out.append(json.loads(raw))
+    return out
+
+
+# ----------------------------------------------------------- estimators
+def test_psi_js_units():
+    same = [100, 200, 300]
+    assert drift.psi(same, same) == pytest.approx(0.0, abs=1e-9)
+    assert drift.js_divergence(same, same) == pytest.approx(0.0,
+                                                            abs=1e-9)
+    # proportional counts are the same distribution
+    assert drift.psi([1, 2, 3], [10, 20, 30]) == pytest.approx(
+        0.0, abs=1e-3)
+    # empty buckets stay finite (additive smoothing), disjoint mass
+    # is loud, and both estimators are symmetric
+    a, b = [100, 0, 0], [0, 0, 100]
+    assert math.isfinite(drift.psi(a, b))
+    assert drift.psi(a, b) > 1.0
+    assert drift.psi(a, b) == pytest.approx(drift.psi(b, a))
+    js = drift.js_divergence(a, b)
+    assert 0.0 < js <= math.log(2.0) + 1e-9        # JS bounded by ln 2
+    assert js == pytest.approx(drift.js_divergence(b, a))
+
+
+# ------------------------------------------------------------- baseline
+def test_baseline_matches_numpy_recount(rng):
+    bst, X = _train(rng)
+    base = drift.extract_baseline(bst)
+    ds = bst.gbdt.train_set
+    used = [int(f) for f in ds.used_feature_indices]
+    assert base.num_features == len(used)
+    assert base.rows == X.shape[0]
+    B = base.bin_counts.shape[1]
+    for j, f in enumerate(used):
+        m = ds.bin_mappers[f]
+        nb = int(m.num_bin)
+        # independent recount: raw column -> value_to_bin -> bincount.
+        # Exact equality proves the EFB unpack in dataset_bin_counts.
+        ref = np.bincount(
+            m.value_to_bin(np.asarray(X[:, f], dtype=np.float64)),
+            minlength=B)[:nb]
+        assert np.array_equal(base.bin_counts[j, :nb], ref), \
+            f"fine counts diverge from numpy recount on feature {f}"
+        assert base.bin_counts[j].sum() == X.shape[0]
+        # coarse buckets are exactly the fine counts folded through the
+        # published bin->bucket map
+        fold = np.bincount(
+            base.bucket_of[j, :nb],
+            weights=base.bin_counts[j, :nb].astype(np.float64),
+            minlength=drift.PSI_BUCKETS)[:drift.PSI_BUCKETS]
+        assert np.allclose(base.bucket_counts[j], fold)
+        # PSI of the baseline against itself is the fixed point
+        assert drift.psi(base.bucket_counts[j],
+                         base.bucket_counts[j]) == pytest.approx(
+            0.0, abs=1e-9)
+    # score digest: its source really is the training predictions — an
+    # independent raw predict reproduces them up to summation order
+    # (exact edge identity can't hold: quantile ties collapse
+    # differently under np.unique when the last ulp moves)
+    raw = bst.predict(X, raw_score=True)
+    scores = np.asarray(bst.gbdt.train_score, dtype=np.float64)[0]
+    assert np.allclose(scores, raw, rtol=1e-6, atol=1e-6)
+    assert base.score_edges is not None
+    assert np.all(np.diff(base.score_edges) > 0)
+    assert 1 <= base.score_edges.size <= drift.SCORE_BUCKETS - 1
+    assert base.score_counts.sum() == X.shape[0]
+    # and the histogram is a numpy searchsorted recount of the scores
+    ref = np.bincount(
+        np.searchsorted(base.score_edges, scores, side="right"),
+        minlength=base.score_edges.size + 1)
+    assert np.array_equal(base.score_counts, ref)
+
+
+def test_baseline_feature_names(rng):
+    bst, _ = _train(rng)
+    base = drift.extract_baseline(bst)
+    names = bst.feature_name()
+    assert all(n in names for n in base.feature_names)
+
+
+# ------------------------------------------- accumulator + gate (unit)
+def _uniform_baseline(nbin=10, count=100):
+    counts = np.full((1, nbin), count, dtype=np.int64)
+    bucket_of = np.arange(nbin, dtype=np.int64).reshape(1, nbin)
+    bucket_counts = counts.astype(np.float64)
+    return drift.ModelBaseline(["f0"], np.asarray([nbin]), counts,
+                               bucket_of, bucket_counts, None, None,
+                               nbin * count)
+
+
+def test_gate_flips_exactly_at_threshold():
+    acc = drift.DriftAccumulator(psi_threshold=0.2, topk=3)
+    base = _uniform_baseline()
+    acc.register("m", base)
+    gate = drift.DriftGate(acc)
+    # untracked / no-rows models never read as drifted
+    assert acc.compute("m") is None
+    assert not gate.drifted("m")
+    assert not gate.drifted("ghost")
+    # all mass into one bin: a loud shift
+    skew = np.zeros((1, 10), dtype=np.int64)
+    skew[0, 0] = 500
+    acc.note_bins("m", skew)
+    rec = acc.compute("m")
+    assert rec["rows"] == 500
+    assert rec["top"][0]["feature"] == "f0"
+    assert rec["drifted"] is (rec["psi_max"] >= 0.2)
+    # the flip is exact: >= at equality, False one epsilon above
+    assert gate.drifted("m", psi_threshold=rec["psi_max"])
+    assert not gate.drifted("m", psi_threshold=rec["psi_max"] + 1e-9)
+    assert gate.drifted("m") == (rec["psi_max"] >= 0.2)
+    # matching traffic computes ~0 and never trips
+    acc2 = drift.DriftAccumulator(psi_threshold=0.2)
+    acc2.register("m", _uniform_baseline())
+    acc2.note_bins("m", np.full((1, 10), 50, dtype=np.int64))
+    assert acc2.compute("m")["psi_max"] == pytest.approx(0.0, abs=1e-3)
+    assert not drift.DriftGate(acc2).drifted("m")
+    # forget() drops the model entirely
+    acc.forget("m")
+    assert not acc.tracks("m")
+    assert not gate.drifted("m")
+
+
+# --------------------------------------------------- real queue path
+def test_shifted_column_detected_through_queue(rng, tmp_path):
+    path = str(tmp_path / "drift.serve.health.jsonl")
+    bst, X = _train(rng)
+    shifted = X[:200].copy()
+    shifted[:, 1] += 5.0                 # far outside the N(0,1) range
+    refs = bst.predict(shifted)
+    with ServeSession(max_batch=32, max_delay_ms=2.0, health_out=path,
+                      health_window_s=0.3, drift_detect=True,
+                      drift_psi_threshold=0.2) as sess:
+        mid = sess.load(bst)
+        futs = [sess.submit(mid, shifted[i:i + 1]) for i in range(200)]
+        for i, f in enumerate(futs):
+            res = np.asarray(f.result(timeout=30)).ravel()
+            # the drift tap must not perturb a single bit
+            assert np.array_equal(res, refs[i:i + 1])
+        assert sess.drift_gate.drifted(mid)
+        live = sess.drift_gate.stats(mid)
+        assert live["rows"] == 200
+        assert live["top"][0]["feature"] == "Column_1"
+        assert live["psi_max"] >= 0.2
+    drecs = [r for r in _records(path) if r["kind"] == "serve_drift"]
+    assert drecs, "no serve_drift record in the health stream"
+    last = drecs[-1]
+    assert last["model"] == mid
+    assert last["drifted"] is True
+    assert last["top"][0]["feature"] == "Column_1"
+    assert last["threshold"] == 0.2
+    assert "score_js" in last and math.isfinite(last["score_js"])
+    # gauges published with the records
+    gauges = TELEMETRY.stats()["gauges"]
+    assert gauges["serve/drift_psi_max"] >= 0.2
+    assert 0.0 <= gauges["serve/score_js"] <= math.log(2.0)
+
+
+def test_unshifted_traffic_stays_quiet(rng, tmp_path):
+    path = str(tmp_path / "quiet.serve.health.jsonl")
+    bst, X = _train(rng)
+    with ServeSession(max_batch=32, max_delay_ms=2.0, health_out=path,
+                      health_window_s=0.3, drift_detect=True,
+                      drift_psi_threshold=0.2) as sess:
+        mid = sess.load(bst)
+        futs = [sess.submit(mid, X[i:i + 1]) for i in range(300)]
+        for f in futs:
+            f.result(timeout=30)
+        assert not sess.drift_gate.drifted(mid)
+    drecs = [r for r in _records(path) if r["kind"] == "serve_drift"]
+    assert drecs
+    assert all(not r["drifted"] for r in drecs)
+    assert all(r["psi_max"] < 0.2 for r in drecs)
+
+
+def test_window_accounting_across_coalesced_batches(rng, tmp_path):
+    """Cumulative row accounting: mixed-size requests coalesced by the
+    queue into padded device batches must count exactly the submitted
+    rows — pad rows masked, nothing double-counted across windows."""
+    path = str(tmp_path / "acct.serve.health.jsonl")
+    bst, X = _train(rng)
+    sizes = [1, 3, 7, 16, 2, 5] * 4
+    total = sum(sizes)
+    with ServeSession(max_batch=32, max_delay_ms=2.0, health_out=path,
+                      health_window_s=0.2, drift_detect=True) as sess:
+        mid = sess.load(bst)
+        futs, at = [], 0
+        for n in sizes:
+            futs.append(sess.submit(mid, X[at:at + n]))
+            at = (at + n) % (X.shape[0] - 16)
+        for f in futs:
+            f.result(timeout=30)
+        assert sess.drift_gate.stats(mid)["rows"] == total
+    drecs = [r for r in _records(path) if r["kind"] == "serve_drift"]
+    assert drecs
+    # records carry the CUMULATIVE count: monotone, ending at total
+    rows = [r["rows"] for r in drecs]
+    assert rows == sorted(rows)
+    assert rows[-1] == total
+    assert drecs[-1]["scores"] == total
+
+
+# --------------------------------------------------------- invariants
+def test_training_byte_identical_with_drift_knobs(rng):
+    X = rng.normal(size=(300, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    base_params = {"objective": "binary", "verbose": -1,
+                   "num_leaves": 7, "deterministic": True}
+
+    def fit(params):
+        ds = lgb.Dataset(X.copy(), y.copy())
+        return lgb.train(params, ds,
+                         num_boost_round=6).model_to_string()
+
+    base = fit(base_params)
+    with_drift = fit(dict(base_params, drift_detect=True,
+                          drift_psi_threshold=0.5, drift_topk=3))
+    assert with_drift == base
+    # runtime-only by construction: never serialized into models
+    assert {"drift_detect", "drift_psi_threshold",
+            "drift_topk"} <= RUNTIME_ONLY_PARAMS
+
+
+def test_config_knob_validation():
+    assert Config(drift_detect=True).drift_psi_threshold == 0.2
+    with pytest.raises(ValueError):
+        Config(drift_psi_threshold=0.0)
+    with pytest.raises(ValueError):
+        Config(drift_psi_threshold=-1.0)
+    with pytest.raises(ValueError):
+        Config(drift_topk=0)
+
+
+def test_blob_v6_shaped_without_synced_window(rng):
+    bst, X = _train(rng, rounds=4)
+    # drift off: v7 blob, no drift key
+    with ServeSession(max_batch=16) as sess:
+        mid = sess.load(bst)
+        sess.predict(mid, X[:4])
+    stats = TELEMETRY.stats()
+    assert stats["version"] == 7
+    assert "drift" not in stats
+    # drift on, no health stream: nothing published until close
+    TELEMETRY.reset()
+    with ServeSession(max_batch=16, drift_detect=True,
+                      drift_psi_threshold=0.2) as sess:
+        mid = sess.load(bst)
+        sess.predict(mid, X[:8])
+        assert "drift" not in TELEMETRY.stats()     # no window synced
+    stats = TELEMETRY.stats()                        # close flushed
+    assert stats["drift"]["psi_threshold"] == 0.2
+    entry = stats["drift"]["models"][mid]
+    assert entry["rows"] == 8
+    assert "model" not in entry                      # keyed by id
+    # reset clears the section: the next blob is v6-shaped again
+    TELEMETRY.reset()
+    assert "drift" not in TELEMETRY.stats()
+
+
+# ------------------------------------------------------------ monitors
+def _drift_rec(drifted, model="m"):
+    return {"kind": "serve_drift", "model": model, "rows": 512,
+            "psi_max": 0.75 if drifted else 0.03,
+            "top": [{"feature": "Column_1",
+                     "psi": 0.75 if drifted else 0.03}],
+            "threshold": 0.2, "drifted": drifted, "score_js": 0.01,
+            "scores": 512, "t": 1.0}
+
+
+def test_serve_monitor_drift_banner():
+    state = serve_monitor.ServeStreamState()
+    start = {"kind": "serve_start", "schema": "lightgbm_tpu.health/v1",
+             "pid": 1, "max_batch": 16, "window_s": 0.5}
+    for rec in (start, _drift_rec(True)):
+        state.feed((json.dumps(rec) + "\n").encode())
+    out = serve_monitor.render(state, "x.serve.health.jsonl")
+    assert "!! DRIFT" in out
+    assert "Column_1" in out
+    assert "refit trigger" in out
+    # a clean record renders the drift line but not the banner
+    quiet = serve_monitor.ServeStreamState()
+    for rec in (start, _drift_rec(False)):
+        quiet.feed((json.dumps(rec) + "\n").encode())
+    out = serve_monitor.render(quiet, "x.serve.health.jsonl")
+    assert "drift m:" in out
+    assert "!! DRIFT" not in out
+
+
+def test_fleet_monitor_drift_banner(tmp_path):
+    path = tmp_path / "svc.serve.health.jsonl"
+    recs = [{"kind": "serve_start", "stream": "serve", "pid": 1,
+             "mono_ts": 1.0}, _drift_rec(True, model="churn")]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    state = fleet_monitor.FleetStream()
+    state.feed(path.read_bytes())
+    out = fleet_monitor.render({str(path): state}, str(tmp_path))
+    assert "!! DRIFT" in out
+    assert "churn" in out
+    assert "refit trigger armed" in out
+
+
+def test_trace_report_drift_section():
+    v6ish = {"version": 6, "phases": {}, "counters": {}, "gauges": {}}
+    out = trace_report.summarize(v6ish)
+    assert "drift: n/a" in out
+    blob = dict(v6ish, version=7, drift={
+        "psi_threshold": 0.2,
+        "models": {"m": {"rows": 512, "psi_max": 0.75,
+                         "top": [{"feature": "Column_1", "psi": 0.75}],
+                         "threshold": 0.2, "drifted": True,
+                         "score_js": 0.01}}})
+    out = trace_report.summarize(blob)
+    assert "psi_max=0.750" in out
+    assert "Column_1" in out
+    assert "!! DRIFT" in out
+    d = trace_report.diff(v6ish, blob)
+    assert "m.psi_max" in d

@@ -111,7 +111,7 @@ def test_stats_v3_surface(tmp_path, rng):
     bst, _, path = _train_stream(tmp_path, rng, chunk=2)
     stats = bst.get_stats()
     assert stats["schema"] == METRICS_SCHEMA
-    assert stats["version"] == 6
+    assert stats["version"] == 7
     assert stats["telemetry_level"] == stats["level"]
     health = stats["health"]
     assert health["schema"] == HEALTH_SCHEMA
@@ -342,7 +342,7 @@ def test_sigterm_flushes_health_and_metrics(tmp_path, rng):
     assert recs[-1]["kind"] == "summary"      # stream flushed on the way
     assert recs[-1]["aborted"] is True        # out, not torn mid-record
     blob = json.loads((tmp_path / "metrics.json").read_text())
-    assert blob["version"] == 6
+    assert blob["version"] == 7
     assert (tmp_path / "model.txt.partial").exists()
 
 

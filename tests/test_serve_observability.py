@@ -111,8 +111,8 @@ def test_sliding_window_serve_stats(rng):
             if stats.get("serve", {}).get("requests", 0) >= 12:
                 break
             time.sleep(0.01)
-    assert stats["version"] == 6
-    assert stats["schema"] == "lightgbm_tpu.metrics/v6"
+    assert stats["version"] == 7
+    assert stats["schema"] == "lightgbm_tpu.metrics/v7"
     win = stats["serve"]
     assert win["requests"] == 12
     assert win["qps"] > 0

@@ -89,7 +89,7 @@ def test_trace_export_schema(rng, tmp_path, monkeypatch):
 
     # the same data is reachable through the stats API
     stats = bst.get_stats()
-    assert stats["version"] == 6
+    assert stats["version"] == 7
     assert stats["level"] >= 2
     assert stats["spans"]["recorded"] > 0
     assert stats["spans"]["dropped"] == 0
@@ -278,7 +278,7 @@ def test_cli_metrics_out(tmp_path, rng):
     assert metrics.exists()
     blob = json.loads(metrics.read_text())
     assert blob["schema"] == METRICS_SCHEMA
-    assert blob["version"] == 6
+    assert blob["version"] == 7
     assert blob["phases"], "the CLI run must have recorded phases"
     assert blob["cost"]["labels"], "CLI train must harvest seam costs"
     assert blob["counters"]["transfer/fetch_calls"] >= 1
@@ -322,7 +322,7 @@ def test_cost_section_populated_on_cpu(rng):
     X, y = make_binary(rng)
     bst = lgb.train(_params(), lgb.Dataset(X, y), num_boost_round=3)
     stats = bst.get_stats()
-    assert stats["version"] == 6
+    assert stats["version"] == 7
     cost = stats["cost"]
     labels = cost["labels"]
     assert "boost/gradients" in labels

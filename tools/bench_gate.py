@@ -14,7 +14,13 @@ previous ``--window`` records, and the gate fails (exit 1) when
     with ``device_timing=`` on) regresses more than ``--latency-tol``
     (default +20%), or
   * serve tail latency (``p99_s``, recorded by bench_serve.py) regresses
-    more than ``--latency-tol`` over the trailing median.
+    more than ``--latency-tol`` over the trailing median,
+  * the drift gate flips — ``drift_ok`` (recorded by loadgen --shift
+    runs, true when the drift plane's verdict matched expectation)
+    goes from held to failed — or ``psi_max`` regresses more than
+    ``--psi-tol`` over the trailing median while sitting above the
+    absolute noise floor (0.1 PSI; below it, sampling jitter dominates
+    and the ratio gate stays silent).
 
 Serve records (bench_serve.py) carry ``qps``/``p50_s``/``p99_s`` and no
 training ``value``/``unit``/``peak_hbm_bytes`` — every gate skips fields
@@ -79,8 +85,11 @@ def _config_of(rec):
     return rec.get("config") or rec.get("metric") or "?"
 
 
+PSI_NOISE_FLOOR = 0.1
+
+
 def evaluate(records, window=5, wall_tol=0.15, hbm_tol=0.20,
-             latency_tol=0.20):
+             latency_tol=0.20, psi_tol=0.50):
     """(failures, notes) over the trajectory.  The newest record of each
     config is judged against the median of up to ``window`` prior
     records of the same config; everything older informs, never gates."""
@@ -190,13 +199,40 @@ def evaluate(records, window=5, wall_tol=0.15, hbm_tol=0.20,
             else:
                 notes.append(f"{config}: serve p99 {p99 * 1e3:.3f}ms vs "
                              f"median {p99_base * 1e3:.3f}ms — ok")
+        # drift gate (loadgen --shift records): drift_ok carries the
+        # end-to-end verdict (shifted sweep detected, control clean,
+        # replies bit-identical) — a flip from held is a failure like a
+        # quality flip.  psi_max additionally ratio-gates against its
+        # trailing median, but only above an absolute noise floor:
+        # small-PSI windows move multiplicatively with sampling jitter
+        # and would flap the gate.
+        drift_held = any(r.get("drift_ok") for r in history)
+        if drift_held and newest.get("drift_ok") is False:
+            failures.append(f"{config}: drift gate flipped to FAILED "
+                            f"(held in trailing history)")
+        psi = newest.get("psi_max")
+        psi_base = _median([r["psi_max"] for r in history
+                            if isinstance(r.get("psi_max"), (int, float))
+                            and r["psi_max"] > 0])
+        if (isinstance(psi, (int, float)) and psi > 0
+                and psi_base is not None):
+            if (psi > PSI_NOISE_FLOOR
+                    and psi / psi_base > 1.0 + psi_tol):
+                failures.append(
+                    f"{config}: psi_max {psi:.3f} regressed "
+                    f"{psi / psi_base - 1.0:+.1%} over median "
+                    f"{psi_base:.3f} (tol +{psi_tol:.0%}, floor "
+                    f"{PSI_NOISE_FLOOR:g})")
+            else:
+                notes.append(f"{config}: psi_max {psi:.3f} vs median "
+                             f"{psi_base:.3f} — ok")
     return failures, notes
 
 
 def gate(path, window=5, wall_tol=0.15, hbm_tol=0.20, latency_tol=0.20,
-         out=sys.stdout):
+         psi_tol=0.50, out=sys.stdout):
     failures, notes = evaluate(load(path), window, wall_tol, hbm_tol,
-                               latency_tol)
+                               latency_tol, psi_tol)
     for note in notes:
         out.write(f"bench_gate: {note}\n")
     for failure in failures:
@@ -449,6 +485,41 @@ def self_test():
             [{"config": "sched-rr-2job", "value": 3.0, "unit": "s",
               "fairness_index": 0.99}])[0]),
     ]
+    # drift-plane records (tools/loadgen.py --shift cells): drift_ok is
+    # a quality-style flip gate; psi_max ratio-gates only above the
+    # absolute noise floor so small-sample jitter never flaps it
+    dhist = [{"config": "loadgen-shift-control", "qps": 200.0,
+              "p99_s": 0.010, "quality_ok": True, "drift_ok": True,
+              "psi_max": 0.040 + 0.002 * i} for i in range(4)]
+
+    def dverdict(newest):
+        failures, _ = evaluate(dhist + [newest])
+        return bool(failures)
+
+    checks += [
+        ("steady drift record passes", not dverdict(
+            {"config": "loadgen-shift-control", "qps": 200.0,
+             "p99_s": 0.010, "quality_ok": True, "drift_ok": True,
+             "psi_max": 0.045})),
+        ("drift_ok flip fails", dverdict(
+            {"config": "loadgen-shift-control", "qps": 200.0,
+             "p99_s": 0.010, "quality_ok": True, "drift_ok": False,
+             "psi_max": 0.045})),
+        ("psi_max below noise floor never ratio-gates", not dverdict(
+            {"config": "loadgen-shift-control", "qps": 200.0,
+             "p99_s": 0.010, "quality_ok": True, "drift_ok": True,
+             "psi_max": 0.09})),
+        ("psi_max regression over floor fails", dverdict(
+            {"config": "loadgen-shift-control", "qps": 200.0,
+             "p99_s": 0.010, "quality_ok": True, "drift_ok": True,
+             "psi_max": 0.40})),
+        ("drift-field-free record passes drift gate", not dverdict(
+            {"config": "loadgen-shift-control", "qps": 200.0,
+             "p99_s": 0.010, "quality_ok": True})),
+        ("drift first record passes", not evaluate(
+            [{"config": "loadgen-shift-new", "drift_ok": True,
+              "psi_max": 1.2}])[0]),
+    ]
     # fleet-summary structural gate (tools/fleet_monitor.py output)
     good_fleet = {
         "schema": FLEET_SUMMARY_SCHEMA,
@@ -504,6 +575,10 @@ def main(argv=None):
     ap.add_argument("--latency-tol", type=float, default=0.20,
                     help="allowed measured dispatch-latency regression "
                          "(default 0.20; only gates device_timing runs)")
+    ap.add_argument("--psi-tol", type=float, default=0.50,
+                    help="allowed psi_max regression over the trailing "
+                         "median (default 0.50; only gates above the "
+                         f"{PSI_NOISE_FLOOR:g} PSI noise floor)")
     ap.add_argument("--self-test", action="store_true",
                     help="run the built-in smoke checks and exit")
     ap.add_argument("--fleet-summary", default=None,
@@ -516,7 +591,7 @@ def main(argv=None):
     if args.fleet_summary:
         return gate_fleet_summary(args.fleet_summary)
     return gate(args.path, args.window, args.wall_tol, args.hbm_tol,
-                args.latency_tol)
+                args.latency_tol, args.psi_tol)
 
 
 if __name__ == "__main__":

@@ -1,4 +1,4 @@
-"""One fleet view over every health-stream kind (metrics v6 plane).
+"""One fleet view over every health-stream kind (metrics v6/v7 planes).
 
 run_monitor / serve_monitor / sched_monitor each tail ONE stream kind.
 This tool tails a directory holding ALL of them at once — the per-rank
@@ -14,7 +14,9 @@ scheduler's stream — and folds them into one time-ordered view:
     each window;
   * stall/straggler/fault rollups across every subsystem, with the
     pace-relative staleness detector (tools/streamtail.py) flagging any
-    stream that has gone quiet mid-run;
+    stream that has gone quiet mid-run, and the v7 ``serve_drift``
+    records' model-drift verdicts (a drifted resident model renders
+    the loud ``!! DRIFT`` banner next to STALL/STALE);
   * a merged tail of the newest records across all streams, ordered by
     the monotonic ``mono_ts`` stamps (corrected by the ``dist_clock``
     offsets when present) — never by wall clocks.
@@ -75,6 +77,7 @@ class FleetStream(streamtail.JsonlFolder):
         self.wait_s = 0.0               # this stream's own rank totals
         self.work_s = 0.0
         self.clock = None               # newest dist_clock offset table
+        self.drifts = {}                # model_id -> newest serve_drift
 
     def on_record(self, rec: dict) -> None:
         kind = rec.get("kind")
@@ -101,6 +104,8 @@ class FleetStream(streamtail.JsonlFolder):
                 self.rank = rec.get("rank")
         elif kind == "dist_clock":
             self.clock = rec.get("offsets")
+        elif kind == "serve_drift":
+            self.drifts[rec.get("model", "?")] = rec
         elif kind in _SUMMARY_KINDS:
             self.summary = rec
 
@@ -249,6 +254,15 @@ def render(states, dirpath, tail=14):
                 f"  !! WAIT-BOUND rank{rank}: {slot['wait_fraction']:.0%}"
                 f" of its collective wall spent waiting for slower "
                 f"ranks")
+    for path, state in sorted(states.items(),
+                              key=lambda kv: kv[1].label()):
+        for mid, d in sorted(state.drifts.items()):
+            if d.get("drifted"):
+                lines.append(
+                    f"  !! DRIFT {state.label()}: model {mid} "
+                    f"psi_max={d.get('psi_max', 0):.3f} at/over "
+                    f"threshold {d.get('threshold', '?')} "
+                    f"({d.get('rows', '?')} rows) — refit trigger armed")
     for path, state in states.items():
         hit = streamtail.stream_stale(state,
                                       streamtail.stream_age_s(path))

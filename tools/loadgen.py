@@ -17,10 +17,20 @@ BENCH_SERVE.json next to the closed-loop grid (config names
 ``loadgen-<size>-r<rate>-d<delay>``) and append trajectory digests that
 tools/bench_gate.py gates on p99 like any other serve record.
 
+``--shift`` exercises the drift plane instead of the queue: one session
+with ``drift_detect`` armed replays a fixed sweep of training rows
+untouched, then replays the same rows with one numerical column
+displaced — a population shift the plane must flag (and a control sweep
+with no displacement it must NOT flag).  Replies stay bit-checked
+against Booster.predict throughout: the drift tap must never perturb
+the scores it observes.  ``--smoke`` runs both and asserts the shifted
+sweep's ``serve_drift`` record names the shifted column first.
+
 Usage:
   python tools/loadgen.py                 # full sweep -> BENCH_SERVE.json
   python tools/loadgen.py --smoke         # ~2s burst, assertions, no artifacts
   python tools/loadgen.py --rate 200 --delay-ms 5 --duration 3
+  python tools/loadgen.py --shift         # drift cells -> trajectory
 """
 
 import argparse
@@ -185,6 +195,74 @@ def run_cell(bst, X, size, rate, delay_ms, duration_s, max_batch=64,
     return rec
 
 
+SHIFT_COL = 2          # numerical column displaced by the shift sweep
+SHIFT_OFFSET = 6.0     # far outside the N(0,1) training range
+
+
+def run_shift_cell(bst, X, name, shift_col=SHIFT_COL, offset=SHIFT_OFFSET,
+                   health_path="", threshold=0.2, n_rows=256, seed=0):
+    """One drift cell: a fixed sweep of distinct training rows through
+    the real queue path with ``drift_detect`` armed, replayed untouched
+    and then with ``shift_col`` displaced by ``offset`` (``offset=0``
+    is the control: same traffic, no shift, no drift expected).  Every
+    reply is bit-checked against Booster.predict — the drift tap rides
+    the serve path but must never perturb it.  Returns the result
+    record; the DriftGate verdict is read live before close, and the
+    health stream (when requested) carries the ``serve_drift``
+    records."""
+    import jax
+    import numpy as np
+
+    from lightgbm_tpu.serve import ServeSession
+    from lightgbm_tpu.utils.telemetry import TELEMETRY
+
+    rng = np.random.RandomState(seed)
+    idx = rng.choice(X.shape[0], size=min(n_rows, X.shape[0]),
+                     replace=False)
+    base = np.ascontiguousarray(X[idx])
+    shifted = base.copy()
+    shifted[:, shift_col] = np.nan_to_num(
+        shifted[:, shift_col]) + offset
+    reqs = [np.ascontiguousarray(r.reshape(1, -1))
+            for phase in (base, shifted) for r in phase]
+    allref = bst.predict(np.concatenate(reqs))
+    errors = mismatches = completed = 0
+    TELEMETRY.reset()
+    with ServeSession(max_batch=32, max_delay_ms=2.0,
+                      health_out=health_path, health_window_s=0.5,
+                      drift_detect=True,
+                      drift_psi_threshold=threshold) as sess:
+        mid = sess.load(bst, model_id=name)
+        futs = [sess.submit(mid, r) for r in reqs]
+        for i, fut in enumerate(futs):
+            try:
+                res = fut.result(timeout=60)
+            except Exception:
+                errors += 1
+                continue
+            completed += 1
+            if not np.array_equal(np.asarray(res).ravel(),
+                                  allref[i:i + 1]):
+                mismatches += 1
+        live = sess.drift_gate.stats(mid) or {}
+        drifted = sess.drift_gate.drifted(mid)
+    top = (live.get("top") or [{}])[0]
+    return {
+        "config": f"loadgen-shift-{name}",
+        "mode": "drift-shift", "backend": jax.default_backend(),
+        "shift_col": shift_col, "offset": offset,
+        "threshold": threshold,
+        "requests": len(reqs), "completed": completed,
+        "errors": errors,
+        "quality_ok": mismatches == 0,
+        "psi_max": live.get("psi_max"),
+        "score_js": live.get("score_js"),
+        "drift_rows": live.get("rows"),
+        "drifted": drifted,
+        "top_feature": top.get("feature"),
+    }
+
+
 def merge_bench_serve(records, path=None):
     """Fold new cells into BENCH_SERVE.json next to the closed-loop
     grid: same-config records are replaced, everything else kept."""
@@ -218,6 +296,10 @@ def append_trajectory(records, path=None):
                 "p50_s": r.get("p50_s"),
                 "p99_s": r.get("p99_s"),
                 "quality_ok": r.get("quality_ok"),
+                # drift cells only; absent keys keep older gate
+                # versions and mixed trajectories shape-stable
+                **{k: r[k] for k in ("psi_max", "drift_ok")
+                   if r.get(k) is not None},
             }) + "\n")
 
 
@@ -276,6 +358,81 @@ def _check_health_stream(path, completed):
     return problems
 
 
+def _stream_drift_records(path):
+    """serve_drift records from a health stream, oldest first."""
+    out = []
+    with open(path, "rb") as fh:
+        for raw in fh.read().split(b"\n"):
+            if not raw.strip():
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if rec.get("kind") == "serve_drift":
+                out.append(rec)
+    return out
+
+
+def shift_sweep(bst, X, tmpdir=None, threshold=0.2):
+    """Shifted + control drift cells.  Judges each cell's verdict via
+    the HEALTH STREAM (the interface monitors and the refit loop
+    consume), sets ``drift_ok`` on the records, and returns
+    (records, problems)."""
+    tmp = tmpdir or tempfile.mkdtemp(prefix="loadgen_shift_")
+    feat = bst.feature_name()[SHIFT_COL]
+    shift = run_shift_cell(
+        bst, X, "shift", threshold=threshold, seed=11,
+        health_path=os.path.join(tmp, "shift.serve.health.jsonl"))
+    control = run_shift_cell(
+        bst, X, "control", offset=0.0, threshold=threshold, seed=12,
+        health_path=os.path.join(tmp, "control.serve.health.jsonl"))
+    problems = []
+    for rec in (shift, control):
+        if rec["errors"] or rec["completed"] != rec["requests"]:
+            problems.append(f"{rec['config']}: {rec['errors']} errors, "
+                            f"{rec['completed']}/{rec['requests']} done")
+        if not rec["quality_ok"]:
+            problems.append(f"{rec['config']}: replies diverged from "
+                            f"Booster.predict with the drift tap on")
+    sdrift = _stream_drift_records(
+        os.path.join(tmp, "shift.serve.health.jsonl"))
+    shift_ok = True
+    if not sdrift:
+        shift_ok = False
+        problems.append("shift stream: no serve_drift record emitted")
+    else:
+        last = sdrift[-1]
+        if not last.get("drifted"):
+            shift_ok = False
+            problems.append(
+                f"shift stream: shifted sweep not flagged "
+                f"(psi_max={last.get('psi_max')} < {threshold})")
+        top = (last.get("top") or [{}])[0].get("feature")
+        if top != feat:
+            shift_ok = False
+            problems.append(f"shift stream: top drifting feature "
+                            f"{top!r}, expected {feat!r}")
+    cdrift = _stream_drift_records(
+        os.path.join(tmp, "control.serve.health.jsonl"))
+    control_ok = True
+    if any(r.get("drifted") for r in cdrift):
+        control_ok = False
+        problems.append("control stream: unshifted sweep flagged as "
+                        "drifted (false positive)")
+    if cdrift and not all(
+            isinstance(r.get("psi_max"), (int, float))
+            and r["psi_max"] < threshold for r in cdrift):
+        control_ok = False
+        problems.append(
+            f"control stream: psi_max "
+            f"{[r.get('psi_max') for r in cdrift]} not under "
+            f"threshold {threshold}")
+    shift["drift_ok"] = shift_ok and shift["quality_ok"]
+    control["drift_ok"] = control_ok and control["quality_ok"]
+    return [shift, control], problems
+
+
 def smoke():
     """~2s burst with assertions; exit 1 on any violated contract.
     The CI leg behind tools/verify_t1.sh --serve-smoke."""
@@ -318,11 +475,20 @@ def smoke():
     problems += [f"trickle stream: {p}" for p in _check_health_stream(
         os.path.join(tmp, "trickle.serve.health.jsonl"),
         trickle["completed"])]
+    # drift cells: the shifted sweep must be flagged with the shifted
+    # column named first, the control sweep must stay quiet, and
+    # replies stay bit-identical with the drift tap armed
+    drift_recs, drift_problems = shift_sweep(bst, X, tmpdir=tmp)
+    for rec in drift_recs:
+        print("LOADGEN_RESULT_JSON:" + json.dumps(rec), flush=True)
+    problems += drift_problems
     for p in problems:
         sys.stderr.write(f"loadgen smoke: FAIL {p}\n")
     print(f"loadgen smoke: {'FAIL' if problems else 'ok'} "
           f"(hot {hot['rows_per_batch']} rows/batch at "
-          f"{hot['qps']} qps, trickle {trickle['rows_per_batch']})")
+          f"{hot['qps']} qps, trickle {trickle['rows_per_batch']}, "
+          f"shift psi_max {drift_recs[0]['psi_max']} vs control "
+          f"{drift_recs[1]['psi_max']})")
     return 1 if problems else 0
 
 
@@ -331,8 +497,11 @@ def main(argv=None):
         description="open-loop Poisson serve load sweep "
                     "-> BENCH_SERVE.json")
     ap.add_argument("--smoke", action="store_true",
-                    help="~2s burst with coalescing + health-stream "
-                         "assertions, no artifacts")
+                    help="~2s burst with coalescing + health-stream + "
+                         "drift assertions, no artifacts")
+    ap.add_argument("--shift", action="store_true",
+                    help="drift cells only: shifted + control sweeps "
+                         "with drift_detect armed -> trajectory")
     ap.add_argument("--rate", type=float, default=0.0,
                     help="single-cell mode: arrival rate req/s")
     ap.add_argument("--delay-ms", type=float, default=0.0,
@@ -353,6 +522,21 @@ def main(argv=None):
     import numpy as np
 
     import lightgbm_tpu as lgb
+
+    if args.shift:
+        bst, X = _train(np, lgb, dict(rows=1_500, feats=8, iters=8,
+                                      leaves=15))
+        records, problems = shift_sweep(bst, X)
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        for p in problems:
+            sys.stderr.write(f"loadgen shift: FAIL {p}\n")
+        if not args.no_artifacts:
+            merge_bench_serve(records)
+            append_trajectory(records)
+            print(f"loadgen: merged {len(records)} drift cell(s) into "
+                  f"BENCH_SERVE.json")
+        return 1 if problems else 0
 
     size, spec = MODEL
     bst, X = _train(np, lgb, spec)

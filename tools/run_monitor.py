@@ -221,6 +221,14 @@ def render(state: StreamState, path: str) -> str:
         lines.append(f"  summary: {s.get('records', '?')} records, "
                      f"{s.get('iterations', '?')} iterations, "
                      f"aborted={bool(s.get('aborted'))}")
+        imp = (s.get("feature_importance") or {}).get("top") or []
+        if imp:
+            parts = [f"{e.get('feature', '?')}="
+                     f"{e.get('gain', 0):g}g/{e.get('split', 0)}s"
+                     for e in imp[:6]]
+            used = (s.get("feature_importance") or {}).get("features_used")
+            lines.append("  importance (gain/splits): " + " ".join(parts)
+                         + (f"  ({used} features used)" if used else ""))
     return "\n".join(lines)
 
 

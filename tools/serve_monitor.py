@@ -5,7 +5,10 @@ The stream is the append-only file a ServeSession writes for
 lightgbm_tpu/serve/health.py, schema ``lightgbm_tpu.health/v1``):
 ``serve_start``, periodic ``serve_window`` records (QPS, stage and
 end-to-end p50/p99, coalesce fill ratio, pad ratio, queue depth),
-``serve_admit`` decisions, ``serve_fault`` events, and a terminal
+``serve_admit`` decisions, ``serve_drift`` records (per-model PSI /
+score-JS vs the training baseline when the session runs with
+``drift_detect=true`` — a model at or over the gate threshold renders
+the loud ``!! DRIFT`` banner), ``serve_fault`` events, and a terminal
 ``serve_summary``.
 
 One-shot mode renders the stream as it stands — serving OR closed.
@@ -40,6 +43,7 @@ class ServeStreamState(streamtail.JsonlFolder):
         self.windows = []               # newest WINDOW_KEEP kept
         self.admits = []
         self.faults = []
+        self.drifts = {}                # model_id -> newest serve_drift
         self.total_requests = 0
         self.total_rows = 0
 
@@ -52,6 +56,8 @@ class ServeStreamState(streamtail.JsonlFolder):
             self.total_rows += rec.get("rows", 0)
             self.windows.append(rec)
             del self.windows[: -self.WINDOW_KEEP]
+        elif kind == "serve_drift":
+            self.drifts[rec.get("model", "?")] = rec
         elif kind == "serve_admit":
             self.admits.append(rec)
         elif kind == "serve_fault":
@@ -113,6 +119,20 @@ def render(state: ServeStreamState, path: str) -> str:
                      f"served no requests")
     else:
         lines.append("  no windows yet")
+    for mid, d in sorted(state.drifts.items()):
+        top = " ".join(f"{e.get('feature', '?')}={e.get('psi', 0):.3f}"
+                       for e in (d.get("top") or [])[:3])
+        js = d.get("score_js")
+        lines.append(f"  drift {mid}: psi_max={d.get('psi_max', 0):.3f}"
+                     + (f" score_js={js:.3f}" if js is not None else "")
+                     + f" rows={d.get('rows', '?')}"
+                     + (f"  [{top}]" if top else ""))
+    drifted = sorted(m for m, d in state.drifts.items() if d.get("drifted"))
+    if drifted:
+        d = state.drifts[drifted[0]]
+        lines.append(f"  !! DRIFT: {', '.join(drifted)} at/over "
+                     f"psi threshold {d.get('threshold', '?')} — "
+                     f"refit trigger armed (DriftGate.drifted)")
     if state.total_requests:
         lines.append(f"  lifetime: {state.total_requests} requests / "
                      f"{state.total_rows} rows across the stream")

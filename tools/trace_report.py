@@ -4,9 +4,9 @@ Input: a metrics dict as produced by ``TELEMETRY.metrics_blob()`` /
 ``Booster.get_stats()`` — the blob the CLI writes for ``metrics_out=``,
 ``bench.py`` / ``bench_suite.py`` embed under ``"metrics"``, and
 ``engine.train`` attaches as ``booster.train_stats``.  The current
-``lightgbm_tpu.metrics/v6`` schema and the older v5/v4/v3/v2/v1 blobs are
-all accepted: every section is optional and renders as ``n/a`` when
-absent.
+``lightgbm_tpu.metrics/v7`` schema and the older v6/v5/v4/v3/v2/v1
+blobs are all accepted: every section is optional and renders as
+``n/a`` when absent.
 
 Usage:
   python tools/trace_report.py metrics.json          # a raw blob
@@ -20,10 +20,10 @@ Prints top phases, transfer bytes, compile counters/seconds, network
 collective counters, the iteration count, (v2) the HBM memory envelope
 and XLA cost-analysis utilization digest, (v3) the run-health stream
 digest, (v4) the measured dispatch-timing table with
-measured-vs-estimated utilization, and (v6) the fleet plane's
-collective wait-vs-work split with the straggler histogram — the
-digest VERDICT / PERF_NOTES rounds quote instead of regex-parsing
-stderr tails.
+measured-vs-estimated utilization, (v6) the fleet plane's collective
+wait-vs-work split with the straggler histogram, and (v7) the drift
+plane's per-model PSI / score-JS verdicts — the digest VERDICT /
+PERF_NOTES rounds quote instead of regex-parsing stderr tails.
 """
 
 import json
@@ -144,6 +144,7 @@ def summarize(stats: dict, top: int = 6) -> str:
     lines.extend(_fault_lines(stats))
     lines.extend(_health_lines(stats))
     lines.extend(_fleet_lines(stats))
+    lines.extend(_drift_lines(stats))
     return "\n".join(lines)
 
 
@@ -268,6 +269,28 @@ def _fleet_lines(stats: dict) -> list:
     return out
 
 
+def _drift_lines(stats: dict) -> list:
+    drift = stats.get("drift")
+    if not drift:
+        return ["  drift: n/a (drift_detect off, no synced window,"
+                " or pre-v7 blob)"]
+    models = drift.get("models") or {}
+    out = [f"  drift: {len(models)} model(s) vs training baseline,"
+           f" psi threshold {drift.get('psi_threshold', '?')}"]
+    for mid, rec in sorted(models.items()):
+        js = rec.get("score_js")
+        top = " ".join(f"{e.get('feature', '?')}={e.get('psi', 0):.3f}"
+                       for e in (rec.get("top") or [])[:3])
+        out.append(
+            f"    {mid}: psi_max={rec.get('psi_max', 0):.3f}"
+            + (f" score_js={js:.3f}" if isinstance(js, (int, float))
+               else "")
+            + f" over {rec.get('rows', '?')} row(s)"
+            + (f"  [{top}]" if top else "")
+            + ("  !! DRIFT" if rec.get("drifted") else ""))
+    return out
+
+
 def _utilization_lines(stats: dict) -> list:
     cost = stats.get("cost") or {}
     fps = cost.get("est_flops_per_s")
@@ -378,6 +401,17 @@ def _timing_scalars(stats: dict) -> dict:
     return out
 
 
+def _drift_scalars(stats: dict) -> dict:
+    out = {}
+    for mid, rec in ((stats.get("drift") or {}).get("models")
+                     or {}).items():
+        out[f"{mid}.psi_max"] = rec.get("psi_max", 0.0)
+        if rec.get("score_js") is not None:
+            out[f"{mid}.score_js"] = rec["score_js"]
+        out[f"{mid}.rows"] = float(rec.get("rows", 0))
+    return out
+
+
 def _diff_section(title: str, a: dict, b: dict, fmt) -> list:
     keys = sorted(set(a) | set(b))
     if not keys:
@@ -418,6 +452,8 @@ def diff(a: dict, b: dict) -> str:
                                _cost_scalars(b), num))
     lines.extend(_diff_section("timing (measured)", _timing_scalars(a),
                                _timing_scalars(b), num))
+    lines.extend(_diff_section("drift", _drift_scalars(a),
+                               _drift_scalars(b), num))
     return "\n".join(lines)
 
 
