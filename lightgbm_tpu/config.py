@@ -292,6 +292,21 @@ _PARAMS: Dict[str, _P] = {
     # give-up budget for one queued serve request; a stuck dispatch
     # surfaces as a named ServeError instead of a hang
     "serve_queue_timeout_s": _P(30.0),
+    # load-shedding bound on the micro-batch queue: total rows allowed
+    # to sit pending; a submit that would exceed it is rejected with a
+    # named ServeOverloadError (counted and health-streamed) instead of
+    # growing the queue without bound.  0 = unbounded (pre-v20 behavior)
+    "serve_max_queue_rows": _P(65536),
+    # quality gate on hot model swap (ServeSession.swap / the refit
+    # loop): the candidate is shadow-scored on a deterministic holdout
+    # and rejected when its holdout metric is more than this fraction
+    # worse than the incumbent's (or any output is non-finite); the old
+    # model keeps serving and a swap_rejected record is emitted
+    "swap_quality_threshold": _P(0.1),
+    # seconds between DriftGate polls in the background refit loop
+    # (serve/refit_loop.py): each drifted poll refits the booster on
+    # fresh labeled data and pushes it through the gated swap
+    "refit_poll_s": _P(30.0),
     # streaming serve-health JSONL (serve/health.py): the session
     # appends serve_start/serve_window/serve_admit/serve_fault/
     # serve_summary records through the same never-torn O_APPEND writer
@@ -368,6 +383,9 @@ RUNTIME_ONLY_PARAMS = frozenset(["resume", "fault_injection",
                                  "predict_device", "serve_max_batch",
                                  "serve_max_delay_ms",
                                  "serve_queue_timeout_s",
+                                 "serve_max_queue_rows",
+                                 "swap_quality_threshold",
+                                 "refit_poll_s",
                                  "serve_health_out",
                                  "serve_health_window_s",
                                  "drift_detect", "drift_psi_threshold",
@@ -589,6 +607,13 @@ class Config:
             raise ValueError("serve_max_delay_ms must be >= 0")
         if self.serve_queue_timeout_s <= 0:
             raise ValueError("serve_queue_timeout_s must be > 0")
+        if self.serve_max_queue_rows < 0:
+            raise ValueError("serve_max_queue_rows must be >= 0 "
+                             "(0 = unbounded)")
+        if self.swap_quality_threshold <= 0:
+            raise ValueError("swap_quality_threshold must be > 0")
+        if self.refit_poll_s <= 0:
+            raise ValueError("refit_poll_s must be > 0")
         if self.serve_health_window_s <= 0:
             raise ValueError("serve_health_window_s must be > 0")
         if self.drift_psi_threshold <= 0:

@@ -15,6 +15,24 @@ from ..objective import create_objective
 from ..ops.split import K_EPSILON
 
 
+def snapshot_leaf_values(gbdt):
+    """Per-tree float64 copies of every leaf value — taken before a
+    speculative refit so a rejected refit→swap can be undone exactly
+    (refit mutates ``tree.leaf_value`` in place)."""
+    return [np.array(t.leaf_value, dtype=np.float64) for t in gbdt.models]
+
+
+def restore_leaf_values(gbdt, snapshot) -> None:
+    """Undo an in-place refit: restore the leaf values captured by
+    :func:`snapshot_leaf_values` (bit-exact; structure untouched)."""
+    if len(snapshot) != len(gbdt.models):
+        raise ValueError(
+            f"leaf-value snapshot holds {len(snapshot)} trees but the "
+            f"model has {len(gbdt.models)}")
+    for tree, vals in zip(gbdt.models, snapshot):
+        tree.leaf_value = np.array(vals, dtype=np.float64)
+
+
 def refit_model(gbdt, metadata, leaf_preds: np.ndarray, config) -> None:
     """``metadata`` carries label/weights/query boundaries — pass the full
     training Metadata where available so weighted and ranking objectives

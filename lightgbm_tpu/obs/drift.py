@@ -212,9 +212,10 @@ def extract_baseline(booster, psi_buckets: int = PSI_BUCKETS,
 # ---------------------------------------------------------- accumulator
 class _ModelState:
     __slots__ = ("baseline", "fine", "scores", "seen_scores", "rows",
-                 "rows_emitted", "rng")
+                 "rows_emitted", "rng", "generation")
 
-    def __init__(self, baseline: ModelBaseline, seed: int):
+    def __init__(self, baseline: ModelBaseline, seed: int,
+                 generation: int = 0):
         self.baseline = baseline
         self.fine = np.zeros_like(baseline.bin_counts)
         self.scores: List[float] = []
@@ -222,6 +223,7 @@ class _ModelState:
         self.rows = 0
         self.rows_emitted = 0
         self.rng = random.Random(seed)
+        self.generation = int(generation)
 
 
 class DriftAccumulator:
@@ -241,10 +243,17 @@ class DriftAccumulator:
         self.reservoir = max(int(reservoir), 1)
 
     # ------------------------------------------------------- registration
-    def register(self, model_id: str, baseline: ModelBaseline) -> None:
+    def register(self, model_id: str, baseline: ModelBaseline,
+                 generation: int = 0) -> None:
+        """(Re)register a model's training baseline.  A re-registration
+        RESETS the accumulated counts — a hot swap passes the new pack
+        epoch as ``generation`` so drift restarts against the new
+        model's baseline and the refit trigger does not immediately
+        re-fire on the pre-swap traffic."""
         with self._lock:
             self._models[model_id] = _ModelState(
-                baseline, seed=hash(model_id) & 0x7FFFFFFF)
+                baseline, seed=hash(model_id) & 0x7FFFFFFF,
+                generation=generation)
 
     def forget(self, model_id: str) -> None:
         with self._lock:
@@ -295,6 +304,7 @@ class DriftAccumulator:
             scores = list(st.scores)
             rows = st.rows
             base = st.baseline
+            generation = st.generation
         per_feature = []
         for j in range(base.num_features):
             nb = int(base.num_bin[j])
@@ -315,6 +325,10 @@ class DriftAccumulator:
             "threshold": round(self.psi_threshold, 6),
             "drifted": bool(psi_max >= self.psi_threshold),
         }
+        if generation:
+            # which swap generation this drift state accumulates for
+            # (0 = the originally loaded model, omitted for v7 shape)
+            rec["generation"] = int(generation)
         if base.score_edges is not None and scores:
             hist = np.bincount(
                 np.searchsorted(base.score_edges, np.asarray(scores),

@@ -3,16 +3,22 @@
 Layering (each file usable on its own):
 
   registry.py   multi-model residency: shared [M, T, ...] device pack
-                under the HBM budget, admission control, eviction
+                under the HBM budget, admission control, eviction,
+                zero-downtime hot swap (per-model pack epochs, quality
+                gate, retained-generation rollback)
   binning.py    on-device binning of raw float requests (tables built
                 from the training BinMappers, uploaded once per model)
-  predictor.py  executable cache keyed (model_id, batch bucket);
-                pow2 shape bucketing, CostJit-compiled, host f64 gather
-  queue.py      request micro-batching with per-request futures and the
-                serve_max_delay_ms / serve_max_batch knob
+  predictor.py  executable cache keyed (model_id, epoch, batch bucket);
+                pow2 shape bucketing, CostJit-compiled, host f64 gather,
+                snapshot-pinned dispatch, OOM-halving retry ladder
+  queue.py      request micro-batching with per-request futures, the
+                serve_max_delay_ms / serve_max_batch knobs and
+                serve_max_queue_rows load shedding
+  refit_loop.py the closed trainer→server loop: DriftGate poll →
+                Booster.refit on fresh labels → quality-gated swap
   health.py     serve health stream: serve_start/serve_window/
-                serve_admit/serve_drift/serve_fault/serve_summary
-                JSONL records (serve_health_out= /
+                serve_admit/serve_drift/serve_fault/swap_*/serve_refit/
+                serve_summary JSONL records (serve_health_out= /
                 LIGHTGBM_TPU_SERVE_HEALTH_JSONL)
 
 ``drift_detect=true`` additionally wires the model-and-data drift
@@ -20,7 +26,7 @@ plane (obs/drift.py) through all four layers: training baselines are
 captured at load, the predictor's compiled executables return the
 per-feature bin occupancy of every replied batch, windows emit
 ``serve_drift`` records, and ``session.drift_gate.drifted(model_id)``
-is the pollable refit trigger.
+is the pollable refit trigger — consumed by ``start_refit_loop()``.
 
 ``ServeSession`` wires them together; ``Booster.serve()`` (basic.py)
 is the one-liner entry point returning a handle bound to that
@@ -32,19 +38,36 @@ from __future__ import annotations
 import os
 from concurrent.futures import Future
 
+import numpy as np
+
 from ..utils.telemetry import TELEMETRY
 from .health import SERVE_HEALTH_ENV, ServeHealth, resolve_serve_health_path
 from .predictor import MIN_BUCKET, BucketedPredictor
 from .queue import MicroBatchQueue
+from .refit_loop import RefitLoop
 from .registry import (ModelRegistry, ServeAdmissionError, ServeError,
+                       ServeOverloadError, SwapRejectedError,
                        SERVE_ADMIT_FRACTION)
 
 __all__ = [
     "ModelRegistry", "BucketedPredictor", "MicroBatchQueue",
     "ServeSession", "ServeHandle", "ServeHealth", "ServeError",
-    "ServeAdmissionError", "SERVE_ADMIT_FRACTION", "MIN_BUCKET",
+    "ServeAdmissionError", "ServeOverloadError", "SwapRejectedError",
+    "RefitLoop", "SERVE_ADMIT_FRACTION", "MIN_BUCKET",
     "SERVE_HEALTH_ENV", "resolve_serve_health_path",
 ]
+
+
+def _gate_metric(pred: np.ndarray, label: np.ndarray) -> float:
+    """Holdout metric for the swap quality gate: error rate for
+    multiclass probability outputs, mean squared error otherwise
+    (objective-agnostic; only RELATIVE candidate-vs-incumbent movement
+    is gated, so the unit does not matter)."""
+    y = np.asarray(label, dtype=np.float64).ravel()
+    p = np.asarray(pred, dtype=np.float64)
+    if p.ndim == 2 and p.shape[1] > 1:
+        return float(np.mean(np.argmax(p, axis=1) != y))
+    return float(np.mean((p.ravel() - y) ** 2))
 
 
 class ServeSession:
@@ -58,10 +81,13 @@ class ServeSession:
 
     def __init__(self, max_batch: int = 256, max_delay_ms: float = 2.0,
                  queue_timeout_s: float = 30.0,
+                 max_queue_rows: int = 65536,
                  admit_fraction: float = SERVE_ADMIT_FRACTION,
                  health_out: str = "", health_window_s: float = 5.0,
                  drift_detect: bool = False,
-                 drift_psi_threshold: float = 0.2, drift_topk: int = 5):
+                 drift_psi_threshold: float = 0.2, drift_topk: int = 5,
+                 swap_quality_threshold: float = 0.1,
+                 refit_poll_s: float = 30.0):
         path = resolve_serve_health_path(override=health_out)
         self.health = None
         if path:
@@ -70,6 +96,8 @@ class ServeSession:
                 meta={"pid": os.getpid(), "max_batch": int(max_batch),
                       "max_delay_ms": float(max_delay_ms)})
         TELEMETRY.gauge_set("serve/max_batch", int(max_batch))
+        self.swap_quality_threshold = float(swap_quality_threshold)
+        self.refit_poll_s = float(refit_poll_s)
         # model-and-data drift plane (obs/drift.py): baseline capture
         # at load, occupancy/score accumulation in the predictor, one
         # serve_drift record per window, DriftGate as the refit trigger
@@ -94,22 +122,27 @@ class ServeSession:
                                      max_delay_ms=max_delay_ms,
                                      max_batch=max_batch,
                                      queue_timeout_s=queue_timeout_s,
-                                     health=self.health)
+                                     health=self.health,
+                                     max_queue_rows=max_queue_rows)
         self.queue.drift = self.drift
+        self._refit_loops = []
 
     @classmethod
     def from_config(cls, config, **overrides):
         """Knobs from a Config (serve_max_batch, serve_max_delay_ms,
-        serve_queue_timeout_s, serve_health_out,
+        serve_queue_timeout_s, serve_max_queue_rows, serve_health_out,
         serve_health_window_s, drift_detect, drift_psi_threshold,
-        drift_topk), keyword overrides winning.  Overrides accept both
-        the constructor names (``max_batch``) and the config-parameter
-        spellings (``serve_max_batch``)."""
+        drift_topk, swap_quality_threshold, refit_poll_s), keyword
+        overrides winning.  Overrides accept both the constructor names
+        (``max_batch``) and the config-parameter spellings
+        (``serve_max_batch``)."""
         kw = {}
         if config is not None:
             kw = {"max_batch": config.serve_max_batch,
                   "max_delay_ms": config.serve_max_delay_ms,
                   "queue_timeout_s": config.serve_queue_timeout_s,
+                  "max_queue_rows": getattr(config,
+                                            "serve_max_queue_rows", 65536),
                   "health_out": getattr(config, "serve_health_out", ""),
                   "health_window_s": getattr(config,
                                              "serve_health_window_s", 5.0),
@@ -118,7 +151,10 @@ class ServeSession:
                   "drift_psi_threshold": getattr(config,
                                                  "drift_psi_threshold",
                                                  0.2),
-                  "drift_topk": getattr(config, "drift_topk", 5)}
+                  "drift_topk": getattr(config, "drift_topk", 5),
+                  "swap_quality_threshold": getattr(
+                      config, "swap_quality_threshold", 0.1),
+                  "refit_poll_s": getattr(config, "refit_poll_s", 30.0)}
         for k, v in overrides.items():
             kw[k[6:] if k.startswith("serve_") else k] = v
         return cls(**kw)
@@ -129,7 +165,90 @@ class ServeSession:
                                   num_iteration=num_iteration)
 
     def evict(self, model_id: str) -> None:
+        # fail still-queued requests for the id FIRST (named error, no
+        # pack-shape surprise at dispatch), then drop the residency
+        self.queue.evict_pending(model_id)
         self.registry.evict(model_id)
+
+    # ------------------------------------------------------------ hot swap
+    def swap(self, model_id: str, booster, num_iteration: int = -1,
+             holdout=None, label=None, quality_threshold: float = None,
+             gated: bool = True) -> float:
+        """Zero-downtime replacement of a resident model.
+
+        The default quality gate shadow-scores the candidate on
+        ``holdout`` (or, when omitted, on the deterministic reservoir
+        of recently served rows) and rejects on non-finite outputs or
+        — when ``label`` is provided — on a holdout metric more than
+        ``swap_quality_threshold`` worse than the incumbent's
+        (:class:`SwapRejectedError`; the old model keeps serving).
+        ``gated=False`` skips the gate for candidates already validated
+        offline.  Returns the flip pause in seconds."""
+        gate = None
+        if gated:
+            thr = self.swap_quality_threshold \
+                if quality_threshold is None else float(quality_threshold)
+
+            def gate(candidate_entry):
+                return self._quality_gate(model_id, booster,
+                                          candidate_entry, holdout,
+                                          label, thr, num_iteration)
+        return self.registry.swap(model_id, booster,
+                                  num_iteration=num_iteration, gate=gate)
+
+    def rollback(self, model_id: str) -> float:
+        """Restore the generation the last swap replaced (one call,
+        same atomic flip)."""
+        return self.registry.rollback(model_id)
+
+    def _quality_gate(self, model_id, booster, candidate_entry, holdout,
+                      label, threshold, num_iteration):
+        """(ok, detail) for one swap candidate: finiteness always;
+        metric regression vs the incumbent when labels are available.
+        Incumbent scores come through the serve path itself
+        (bit-identical to Booster.predict of the live generation)."""
+        X = holdout if holdout is not None \
+            else self.registry.replay_rows(model_id)
+        if X is None or len(X) == 0:
+            return True, ("no holdout rows available yet; "
+                          "finiteness gate skipped")
+        X = np.ascontiguousarray(np.atleast_2d(np.asarray(X)),
+                                 dtype=np.float32)
+        cand = np.asarray(booster.predict(X, num_iteration=num_iteration))
+        if not np.all(np.isfinite(cand)):
+            return False, (f"candidate produced non-finite outputs on "
+                           f"{X.shape[0]} holdout rows")
+        inc = np.asarray(self.predict_direct(model_id, X))
+        if cand.shape != inc.shape:
+            return False, (f"candidate output shape {cand.shape} does "
+                           f"not match the incumbent's {inc.shape}")
+        if label is None:
+            return True, (f"finite on {X.shape[0]} holdout rows "
+                          f"(no labels; metric gate skipped)")
+        cand_m = _gate_metric(cand, label)
+        inc_m = _gate_metric(inc, label)
+        if cand_m > inc_m * (1.0 + threshold) + 1e-12:
+            return False, (
+                f"holdout metric regressed: candidate {cand_m:.6g} vs "
+                f"incumbent {inc_m:.6g} on {X.shape[0]} rows (more than "
+                f"{threshold:.0%} worse; swap_quality_threshold)")
+        return True, (f"holdout metric {cand_m:.6g} vs incumbent "
+                      f"{inc_m:.6g} on {X.shape[0]} rows (within "
+                      f"{threshold:.0%})")
+
+    def start_refit_loop(self, model_id: str, booster, data_source,
+                         **kwargs) -> RefitLoop:
+        """Start the background drift→refit→swap loop for one model
+        (see serve/refit_loop.py).  Defaults: ``poll_s`` from
+        ``refit_poll_s``, the gate threshold from
+        ``swap_quality_threshold``.  The loop is stopped by
+        ``close()``."""
+        kwargs.setdefault("poll_s", self.refit_poll_s)
+        kwargs.setdefault("quality_threshold",
+                          self.swap_quality_threshold)
+        loop = RefitLoop(self, model_id, booster, data_source, **kwargs)
+        self._refit_loops.append(loop)
+        return loop.start()
 
     def submit(self, model_id: str, X, raw_score: bool = False) -> Future:
         return self.queue.submit(model_id, X, raw_score=raw_score)
@@ -145,6 +264,9 @@ class ServeSession:
         return self.predictor.predict(model_id, X, raw_score=raw_score)
 
     def close(self):
+        for loop in self._refit_loops:
+            loop.stop()
+        self._refit_loops = []
         self.queue.close()
 
     def __enter__(self):
@@ -177,6 +299,13 @@ class ServeHandle:
 
     def submit(self, X, raw_score: bool = False) -> Future:
         return self.session.submit(self.model_id, X, raw_score=raw_score)
+
+    def swap(self, booster, **kwargs) -> float:
+        """Hot-swap this handle's model (``ServeSession.swap``)."""
+        return self.session.swap(self.model_id, booster, **kwargs)
+
+    def rollback(self) -> float:
+        return self.session.rollback(self.model_id)
 
     def close(self):
         if self._owns:

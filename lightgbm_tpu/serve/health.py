@@ -26,10 +26,24 @@ truncate) a training run's health file.  Record kinds:
     drifting features by name), the raw-score Jensen–Shannon shift,
     the gate threshold and the ``drifted`` verdict.
   * ``serve_fault`` — a dispatch error, injected fault or predictor
-    exception that failed request futures.
+    exception that failed request futures (including the OOM-ladder
+    retries, queued requests failed by an evict, and a worker found
+    wedged at close).
+  * ``swap_begin`` / ``swap_rejected`` / ``swap_flip`` / ``swap_done``
+    — the hot-swap lifecycle (serve/registry.py): candidate built off
+    to the side, quality-gate verdict, the atomic flip with its
+    measured pause, completion (``rollback: true`` variants for
+    ``ModelRegistry.rollback``).
+  * ``serve_refit`` — one refit-loop attempt (serve/refit_loop.py):
+    status swapped / rejected / fault.
   * ``serve_summary`` — terminal record from ``close()``: lifetime
-    totals, pending futures failed at close.  Its presence is what
-    separates an aborted-but-orderly server from a wedged one.
+    totals, shed submits, pending futures failed at close.  Its
+    presence is what separates an aborted-but-orderly server from a
+    wedged one.
+
+``serve_window`` records additionally carry ``shed_requests`` /
+``shed_rows`` for any window in which the bounded queue
+(``serve_max_queue_rows``) shed load.
 
 Consume live with ``tools/serve_monitor.py`` (mirrors run_monitor).
 """
@@ -84,6 +98,8 @@ class _Window:
         self.batches = 0
         self.padded = 0
         self.dispatch_rows = 0      # rows through the compiled path
+        self.shed_requests = 0      # submits rejected by load shedding
+        self.shed_rows = 0
         self.e2e: List[float] = []
         self.stages: Dict[str, List[float]] = {s: [] for s in STAGES}
         self.model_rows: Dict[str, int] = defaultdict(int)
@@ -157,6 +173,16 @@ class ServeHealth:
             w.padded += int(padded)
             self._total["batches"] += 1
 
+    def note_shed(self, rows: int) -> None:
+        """One submit shed by the bounded queue (overload or an armed
+        ``serve/shed`` fault); counted into the current window and the
+        lifetime totals."""
+        with self._lock:
+            self._win.shed_requests += 1
+            self._win.shed_rows += int(rows)
+            self._total["shed_requests"] += 1
+            self._total["shed_rows"] += int(rows)
+
     def event(self, kind: str, fields: Optional[Dict[str, Any]] = None,
               ) -> None:
         """A serve_admit / serve_fault record, written immediately."""
@@ -195,6 +221,9 @@ class ServeHealth:
                 # window's average dispatch ran vs the coalescing cap
                 rec["fill_ratio"] = round(
                     w.dispatch_rows / w.batches / float(cap), 6)
+        if w.shed_requests:
+            rec["shed_requests"] = w.shed_requests
+            rec["shed_rows"] = w.shed_rows
         if w.e2e:
             lat = sorted(w.e2e)
             rec["p50_s"] = round(_quantile(lat, 0.50), 9)
@@ -259,6 +288,7 @@ class ServeHealth:
                 "rows": self._total["rows"],
                 "batches": self._total["batches"],
                 "faults": self._total["faults"],
+                "shed_requests": self._total["shed_requests"],
                 "pending_failed": int(pending_failed),
             }
         if extra:

@@ -1,8 +1,8 @@
 """Compiled, shape-bucketed prediction over the shared model pack.
 
-One executable per ``(model_id, batch bucket)``: request batches are
-padded up to the next power-of-two bucket (floor ``MIN_BUCKET``) so a
-steady request stream hits a handful of compiled programs instead of
+One executable per ``(model_id, epoch, batch bucket)``: request batches
+are padded up to the next power-of-two bucket (floor ``MIN_BUCKET``) so
+a steady request stream hits a handful of compiled programs instead of
 one retrace per batch size.  Each executable fuses on-device binning
 (serve/binning.py) with the stacked tree routing
 (models/device_predict.predict_binned_leaves) and is AOT-compiled
@@ -10,12 +10,25 @@ through the existing ``CostJit`` wrapper — the telemetry ``cost``
 section gets FLOPs/bytes per bucket for free, and ``device_timing=``
 runs get measured per-dispatch p50/p99 under the same labels.
 
+Every request dispatches against one registry ``snapshot()`` — the
+entry, pack row, epoch and device arrays pinned together — so a hot
+swap that flips mid-request cannot mix generations: in-flight batches
+complete against the arrays they were built with.  A swap bumps only
+the swapped id's epoch, which retires exactly that model's cached
+executables; a load/evict bumps the global ``pack_version`` and clears
+everything (the pack shapes changed under every model).
+
 Padded rows are provably inert: routing is a pure per-row map with no
 cross-row reduction, so a pad row can only change its OWN (discarded)
 output slot.  The executable returns per-tree leaf INDICES; the float64
 leaf values are gathered and accumulated on the host in the exact order
 of the host tree walk (``GBDT._raw_predict``), which is what makes
 serve output bit-identical to ``Booster.predict``.
+
+OOM resilience mirrors the training-side ``_chunk_cap`` ladder: a
+RESOURCE_EXHAUSTED-shaped dispatch failure halves the sticky batch cap
+(floor 1) and retries — replies are bit-identical across splits because
+the host f64 gather is a per-row accumulation in fixed order.
 """
 
 from __future__ import annotations
@@ -26,10 +39,11 @@ from typing import Dict, Tuple
 import numpy as np
 
 from ..models.device_predict import TreeStack, predict_binned_leaves
-from ..utils.faults import FAULTS
+from ..models.gbdt import _is_oom_error
+from ..utils.faults import FAULTS, oom_error
 from ..utils.jitcost import cost_jit
 from ..utils.telemetry import TELEMETRY
-from .registry import ModelRegistry, ServeError
+from .registry import ModelRegistry, PackSnapshot, ServeError
 
 # smallest compiled batch shape: buckets below this add executables
 # without meaningfully shrinking the padded-dispatch cost
@@ -44,32 +58,37 @@ def _next_bucket(n: int) -> int:
 
 
 class BucketedPredictor:
-    """Executable cache keyed on ``(model_id, batch_bucket)``."""
+    """Executable cache keyed on ``(model_id, epoch, batch_bucket)``."""
 
     def __init__(self, registry: ModelRegistry, max_batch: int = 256):
         self.registry = registry
         self.max_batch = int(max_batch)
         self._lock = threading.RLock()
-        self._fns: Dict[Tuple[str, int], object] = {}
+        self._fns: Dict[Tuple, object] = {}
         self._fns_version = -1
+        self._batch_cap = None  # sticky OOM ladder cap (None = max_batch)
         self._rows = 0
         self._padded = 0
         self.health = None      # serve/health.ServeHealth, session-wired
         self.drift = None       # obs/drift.DriftAccumulator, session-wired
 
     # ----------------------------------------------------------- compile
-    def _fn_for(self, model_id: str, bucket: int, with_drift: bool = False):
+    def _fn_for(self, snap: PackSnapshot, bucket: int,
+                with_drift: bool = False):
         """The jitted (CostJit-wrapped) executable for one bucket; built
         once, reused for every later batch in the bucket.  A registry
-        pack rebuild (load/evict) invalidates the whole cache.  The
+        pack rebuild (load/evict) invalidates the whole cache; a hot
+        swap retires only the swapped model's entries (epoch key).  The
         ``with_drift`` variant additionally returns the per-feature
         bin-occupancy counts of the VALID rows (obs/drift.py) — the
         leaves output is untouched, so replies stay bit-identical."""
+        model_id = snap.model_id
         with self._lock:
-            if self._fns_version != self.registry.pack_version:
+            if snap.pack_version > self._fns_version:
                 self._fns.clear()
-                self._fns_version = self.registry.pack_version
-            key = (model_id, bucket, with_drift)
+                self._fns_version = snap.pack_version
+            key = (model_id, snap.pack_version, snap.epoch, bucket,
+                   with_drift)
             fn = self._fns.get(key)
             if fn is not None:
                 return fn
@@ -79,8 +98,8 @@ class BucketedPredictor:
                 lambda site: ServeError(
                     f"injected fault at {site}: giving up on compiling "
                     f"the {model_id}:b{bucket} serve executable"))
-            entry = self.registry.entry(model_id)
-            m = self.registry.row_of(model_id)
+            entry = snap.entry
+            m = snap.row
             max_depth = entry.max_depth
             num_bin_axis = int(entry.tables["num_bin"].max())
 
@@ -116,24 +135,32 @@ class BucketedPredictor:
                 jitted = jax.jit(leaves_fn)
             fn = cost_jit(f"serve/predict[{model_id}:b{bucket}"
                           f"{':drift' if with_drift else ''}]", jitted)
+            # retire this model's previous-epoch executables: they can
+            # never be handed out again (snapshots carry the new epoch)
+            stale = [k for k in self._fns
+                     if k[0] == model_id and k[2] != snap.epoch]
+            for k in stale:
+                del self._fns[k]
             self._fns[key] = fn
             return fn
 
     # ---------------------------------------------------------- dispatch
-    def _leaves(self, model_id: str, X: np.ndarray) -> np.ndarray:
+    def _leaves(self, snap: PackSnapshot, X: np.ndarray) -> np.ndarray:
         """Per-tree leaves [T, B] for one chunk (B <= max_batch)."""
         import jax.numpy as jnp
+        FAULTS.maybe_raise("serve/oom", oom_error)
         B = X.shape[0]
         bucket = _next_bucket(B)
+        model_id = snap.model_id
         drift = self.drift
         if drift is not None and not drift.tracks(model_id):
             drift = None
-        fn = self._fn_for(model_id, bucket, with_drift=drift is not None)
+        fn = self._fn_for(snap, bucket, with_drift=drift is not None)
         pad = bucket - B
         if pad:
             X = np.concatenate(
                 [X, np.zeros((pad, X.shape[1]), dtype=X.dtype)])
-        pack = self.registry.pack()
+        pack = snap.pack
         if drift is not None:
             # n_valid is traced, so every partial batch in the bucket
             # reuses one executable; pad rows are masked from the counts
@@ -155,10 +182,37 @@ class BucketedPredictor:
             self.health.note_dispatch(model_id, B, pad, bucket)
         return leaves[:, :B]
 
+    def _dispatch_cap(self) -> int:
+        with self._lock:
+            cap = self.max_batch if self._batch_cap is None \
+                else min(self._batch_cap, self.max_batch)
+        return max(int(cap), 1)
+
+    def _halve_cap(self, failed_rows: int, exc: BaseException) -> int:
+        """One rung down the OOM ladder: sticky, mirroring the training
+        side's ``_chunk_cap`` (a batch that OOMed once will OOM again)."""
+        new_cap = max(failed_rows // 2, 1)
+        with self._lock:
+            if self._batch_cap is not None:
+                new_cap = min(new_cap, self._batch_cap)
+            self._batch_cap = new_cap
+        TELEMETRY.counter_add("serve/oom_halvings")
+        TELEMETRY.fault_event(
+            "serve_oom", site="serve/oom",
+            detail=f"dispatch of {failed_rows} rows hit "
+                   f"{type(exc).__name__}; retrying at batch {new_cap}")
+        if self.health is not None:
+            self.health.event("serve_fault", {
+                "error": f"{type(exc).__name__}: {exc}",
+                "action": f"OOM ladder: retrying at batch {new_cap}",
+                "recovered": True})
+        return new_cap
+
     def predict(self, model_id: str, X, raw_score: bool = False):
         """Predictions for raw float rows, exactly as ``Booster.predict``
         shapes them: [B] for single-output models, [B, C] multiclass."""
-        entry = self.registry.entry(model_id)
+        snap = self.registry.snapshot(model_id)
+        entry = snap.entry
         X = np.ascontiguousarray(np.atleast_2d(np.asarray(X)),
                                  dtype=np.float32)
         n_feat = entry.max_feature_idx + 1
@@ -166,6 +220,7 @@ class BucketedPredictor:
             raise ServeError(
                 f"request matrix has {X.shape[1] if X.ndim == 2 else '?'} "
                 f"features but {model_id} was trained with {n_feat}")
+        self.registry.note_rows(model_id, X)
         B = X.shape[0]
         C = entry.num_tree_per_iteration
         out = np.zeros((C, B), dtype=np.float64)
@@ -173,13 +228,24 @@ class BucketedPredictor:
             out[k] += entry.init_scores[k]
         done = 0
         while done < B:
-            chunk = X[done: done + self.max_batch]
-            leaves = self._leaves(model_id, chunk)
+            chunk = X[done: done + self._dispatch_cap()]
+            try:
+                leaves = self._leaves(snap, chunk)
+            except Exception as exc:
+                if not _is_oom_error(exc) or chunk.shape[0] <= 1:
+                    raise
+                # RESOURCE_EXHAUSTED at this size: halve and re-dispatch
+                # the same rows — bit-identical by construction (per-row
+                # f64 gather, fixed accumulation order)
+                self._halve_cap(chunk.shape[0], exc)
+                continue
             # same accumulation order (and float64 precision) as the
-            # host walk in GBDT._raw_predict -> bit-identical output
-            for t, tree in enumerate(entry.trees):
+            # host walk in GBDT._raw_predict -> bit-identical output;
+            # values come from the entry's leaf snapshot so an in-place
+            # refit of the source booster cannot perturb live replies
+            for t in range(len(entry.trees)):
                 out[t % C, done: done + chunk.shape[0]] += \
-                    tree.leaf_value[leaves[t]]
+                    entry.leaf_values[t][leaves[t]]
             done += chunk.shape[0]
         if entry.average_output:
             out /= max(len(entry.trees) // max(C, 1), 1)

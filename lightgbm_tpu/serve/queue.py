@@ -23,14 +23,25 @@ delay knob.  A session-scoped serve health stream (serve/health.py)
 additionally gets per-request stage walls and per-batch fill for its
 periodic ``serve_window`` records.
 
+Overload degrades instead of dying: the queue is bounded by
+``serve_max_queue_rows`` total pending rows (0 = unbounded) and a
+submit that would exceed the bound is SHED — a named
+:class:`ServeOverloadError` immediately, a ``serve/shed_requests``
+counter bump and a shed count in the next health window — while every
+already-admitted request completes normally.  An armed ``serve/shed``
+fault site sheds deterministically regardless of depth.
+
 Failure behavior is explicit: an injected ``serve/enqueue`` fault or a
 predictor error becomes a named exception on the affected futures
 (never a hang, and a ``serve_fault`` health record), and ``predict``
 applies ``queue_timeout_s`` so a stuck dispatch surfaces as a give-up
-that names the site.  ``close()`` fails pending futures, bumps the
-``serve/closed`` counter and writes the ``serve_summary`` terminal
-health record — an aborted server is distinguishable from a wedged one
-in the stream.
+that names the site.  ``evict_pending()`` eagerly fails requests still
+queued for a model being evicted ("evicted while queued", never a
+pack-shape surprise at dispatch).  ``close()`` fails pending futures,
+bumps the ``serve/closed`` counter and writes the ``serve_summary``
+terminal health record — and when the worker does not join within
+``join_timeout_s`` it fails the wedged in-flight batch with a named
+error plus a ``serve_fault`` record instead of returning silently.
 """
 
 from __future__ import annotations
@@ -46,7 +57,7 @@ import numpy as np
 from ..utils.faults import FAULTS
 from ..utils.telemetry import TELEMETRY
 from .predictor import BucketedPredictor
-from .registry import ServeError
+from .registry import ServeError, ServeOverloadError
 
 
 class _Request:
@@ -62,19 +73,34 @@ class _Request:
         self.t_coalesce = None          # stamped when its batch closes
 
 
+def _fail(future: Future, exc: BaseException) -> bool:
+    """Fail a future that may already be resolved (close/evict races
+    the worker); returns True when this call set the exception."""
+    try:
+        future.set_exception(exc)
+        return True
+    except Exception:
+        return False
+
+
 class MicroBatchQueue:
     """Single-worker micro-batching front of a :class:`BucketedPredictor`."""
 
     def __init__(self, predictor: BucketedPredictor,
                  max_delay_ms: float = 2.0, max_batch: int = 256,
-                 queue_timeout_s: float = 30.0, health=None):
+                 queue_timeout_s: float = 30.0, health=None,
+                 max_queue_rows: int = 0):
         self.predictor = predictor
         self.max_delay_s = max(float(max_delay_ms), 0.0) / 1000.0
         self.max_batch = int(max_batch)
         self.queue_timeout_s = float(queue_timeout_s)
+        self.max_queue_rows = max(int(max_queue_rows), 0)
+        self.join_timeout_s = 5.0       # close() worker-join budget
         self.health = health            # serve/health.ServeHealth or None
         self.drift = None               # obs/drift.DriftAccumulator or None
         self._pending = deque()
+        self._queued_rows = 0
+        self._current = None            # batch the worker is dispatching
         self._cond = threading.Condition()
         self._closed = False
         self._inflight = 0
@@ -84,7 +110,9 @@ class MicroBatchQueue:
 
     # ----------------------------------------------------------- clients
     def submit(self, model_id: str, X, raw_score: bool = False) -> Future:
-        """Enqueue one request; resolves to Booster.predict-shaped rows."""
+        """Enqueue one request; resolves to Booster.predict-shaped rows.
+        Raises :class:`ServeOverloadError` (load shedding) when the
+        pending rows would exceed ``serve_max_queue_rows``."""
         if self._closed:
             raise ServeError("serve queue is closed")
         FAULTS.maybe_raise(
@@ -94,16 +122,39 @@ class MicroBatchQueue:
                 f"rejected at enqueue"))
         X = np.ascontiguousarray(np.atleast_2d(np.asarray(X)),
                                  dtype=np.float32)
+        rows = int(X.shape[0])
         req = _Request(model_id, bool(raw_score), X)
         with self._cond:
             if self._closed:
                 raise ServeError("serve queue is closed")
+            forced = FAULTS.check("serve/shed")
+            if forced or (self.max_queue_rows
+                          and self._queued_rows + rows
+                          > self.max_queue_rows):
+                self._shed(model_id, rows, self._queued_rows, forced)
             self._pending.append(req)
+            self._queued_rows += rows
             depth = len(self._pending)
             self._cond.notify()
         TELEMETRY.counter_add("serve/requests")
         TELEMETRY.gauge_set("serve/queue_depth", depth)
         return req.future
+
+    def _shed(self, model_id: str, rows: int, queued: int,
+              forced: bool) -> None:
+        """Reject one submit at the door (called under ``_cond``)."""
+        TELEMETRY.counter_add("serve/shed_requests")
+        TELEMETRY.counter_add("serve/shed_rows", rows)
+        if self.health is not None:
+            self.health.note_shed(rows)
+        if forced:
+            raise ServeOverloadError(
+                f"injected fault at serve/shed: request for {model_id} "
+                f"({rows} rows) shed")
+        raise ServeOverloadError(
+            f"serve queue at capacity: {queued} rows pending + {rows} "
+            f"requested exceeds serve_max_queue_rows="
+            f"{self.max_queue_rows}; request for {model_id} shed")
 
     def predict(self, model_id: str, X, raw_score: bool = False,
                 timeout: float = None):
@@ -116,26 +167,73 @@ class MicroBatchQueue:
                 f"serve request for {model_id} gave up after {budget:.1f}s "
                 f"waiting on the batch queue (serve_queue_timeout_s)")
 
+    def evict_pending(self, model_id: str) -> int:
+        """Eagerly fail every still-queued request for a model being
+        evicted — a named error NOW instead of a pack-shape surprise
+        when the worker would have dispatched them."""
+        with self._cond:
+            keep, dropped = deque(), []
+            for r in self._pending:
+                (dropped if r.model_id == model_id else keep).append(r)
+            self._pending = keep
+            self._queued_rows -= sum(r.X.shape[0] for r in dropped)
+            depth = len(keep)
+        for r in dropped:
+            _fail(r.future, ServeError(
+                f"model {model_id!r} evicted while queued; request "
+                f"failed before dispatch"))
+        if dropped:
+            TELEMETRY.counter_add("serve/evicted_queued", len(dropped))
+            TELEMETRY.gauge_set("serve/queue_depth", depth)
+            if self.health is not None:
+                self.health.event("serve_fault", {
+                    "model": model_id, "requests": len(dropped),
+                    "error": "model evicted while queued"})
+        return len(dropped)
+
     def close(self):
         """Stop the worker; pending futures fail with a named error.
         Terminal telemetry makes the abort legible: the ``serve/closed``
-        counter and the stream's ``serve_summary`` record."""
+        counter and the stream's ``serve_summary`` record.  A worker
+        that does not join within ``join_timeout_s`` is reported as
+        wedged: its in-flight batch is failed with a named error and a
+        ``serve_fault`` record instead of being silently abandoned."""
         with self._cond:
             already = self._closed
             self._closed = True
             leftovers = list(self._pending)
             self._pending.clear()
+            self._queued_rows = 0
             self._cond.notify_all()
         for req in leftovers:
-            req.future.set_exception(ServeError("serve queue closed "
-                                                "before dispatch"))
-        self._worker.join(timeout=5.0)
+            _fail(req.future, ServeError("serve queue closed "
+                                         "before dispatch"))
+        self._worker.join(timeout=self.join_timeout_s)
+        wedged_failed = 0
+        if self._worker.is_alive():
+            with self._cond:
+                stuck = list(self._current or ())
+            for req in stuck:
+                if _fail(req.future, ServeError(
+                        f"serve worker wedged at close: dispatch for "
+                        f"{req.model_id} did not complete within "
+                        f"{self.join_timeout_s:.1f}s; request failed")):
+                    wedged_failed += 1
+            TELEMETRY.counter_add("serve/wedged_close")
+            if self.health is not None:
+                self.health.event("serve_fault", {
+                    "error": f"serve worker still alive "
+                             f"{self.join_timeout_s:.1f}s after close; "
+                             f"in-flight batch abandoned",
+                    "requests": wedged_failed,
+                    "wedged": True})
         if already:
             return
         TELEMETRY.counter_add("serve/closed")
         TELEMETRY.gauge_set("serve/queue_depth", 0)
         if self.health is not None:
-            self.health.close(pending_failed=len(leftovers))
+            self.health.close(
+                pending_failed=len(leftovers) + wedged_failed)
         elif self.drift is not None:
             # no health stream to flush through: publish the final
             # drift state directly so post-close DriftGate polls and
@@ -179,6 +277,8 @@ class MicroBatchQueue:
                 else:
                     keep.append(r)
             self._pending = keep
+            self._queued_rows -= rows
+            self._current = batch
             depth = len(keep)
         # coalesce-close: the window just ended for every batched
         # request; the slack is how much of the delay budget the batch
@@ -223,7 +323,9 @@ class MicroBatchQueue:
                     done += n
             except Exception as exc:
                 for r in batch:
-                    r.future.set_exception(exc)
+                    _fail(r.future, exc)
+                with self._cond:
+                    self._current = None
                 TELEMETRY.counter_add("serve/errors")
                 if self.health is not None:
                     self.health.event("serve_fault", {
@@ -241,7 +343,12 @@ class MicroBatchQueue:
             # host f64 gather; what remains is slicing + future wakeups
             t_device = time.perf_counter()
             for r, out in zip(batch, slices):
-                r.future.set_result(out)
+                try:
+                    r.future.set_result(out)
+                except Exception:
+                    pass    # failed at close/evict while we dispatched
+            with self._cond:
+                self._current = None
             t_reply = time.perf_counter()
             self._record_lifecycle(batch, t_close, t_dispatch, t_device,
                                    t_reply, X.shape[0])

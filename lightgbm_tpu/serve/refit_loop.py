@@ -1,0 +1,175 @@
+"""The closed trainer→server loop: drift-triggered refit → gated swap.
+
+PR 18's drift plane produces the refit trigger (``DriftGate.drifted``)
+but nothing consumed it; :class:`RefitLoop` closes the loop.  A
+background daemon thread polls the gate every ``refit_poll_s`` seconds
+and, when the served traffic has drifted past the PSI threshold:
+
+  1. pulls fresh labeled data from the caller's ``data_source()``
+     (returns ``(X, y)`` or ``(X, y, weight)``, or None to skip),
+  2. runs ``Booster.refit`` on it — leaf values re-estimated in place,
+     tree structure untouched.  Serving is unaffected while this runs:
+     the registry's resident entries gather from their OWN leaf-value
+     snapshots, never from the live tree objects,
+  3. pushes the refitted booster through the quality-gated hot swap
+     (``ServeSession.swap``) with the SAME fresh labeled data as the
+     shadow-scoring holdout — a candidate that regressed the holdout
+     metric (or went non-finite) is rejected, the in-place refit is
+     rolled back on the booster (``restore_leaf_values``), and the old
+     model keeps serving.
+
+Every attempt lands as a ``serve_refit`` health record (status
+``swapped`` / ``rejected`` / ``fault``) plus ``serve/refits`` /
+``serve/refit_faults`` counters.  An armed ``serve/refit`` fault site
+fails one attempt (the loop survives and keeps polling), and the swap
+itself is fault-injectable at the flip via ``serve/swap`` — the full
+lifecycle degrades, it never dies.
+
+``run_once()`` is the synchronous single-poll entry point (what the
+thread calls; also the deterministic hook for tests and operators
+driving the loop from their own scheduler).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..models.refit import restore_leaf_values, snapshot_leaf_values
+from ..utils.faults import FAULTS, InjectedFault
+from ..utils.telemetry import TELEMETRY
+from .registry import ServeError, SwapRejectedError
+
+
+class RefitLoop:
+    """Background drift-poll → ``Booster.refit`` → gated-swap loop for
+    one served model.  Start with :meth:`start` (or let
+    ``ServeSession.start_refit_loop`` do it); ``stop()`` joins the
+    thread.  Counters: ``swaps`` / ``rejected`` / ``faults``."""
+
+    def __init__(self, session, model_id: str, booster,
+                 data_source: Callable,
+                 poll_s: float = 30.0,
+                 decay_rate: Optional[float] = None,
+                 quality_threshold: Optional[float] = None,
+                 psi_threshold: Optional[float] = None,
+                 min_rows: int = 1,
+                 max_refits: Optional[int] = None):
+        if session.drift_gate is None:
+            raise ServeError(
+                "the refit loop consumes DriftGate.drifted() as its "
+                "trigger; open the session with drift_detect=true")
+        self.session = session
+        self.model_id = model_id
+        self.booster = booster
+        self.data_source = data_source
+        self.poll_s = max(float(poll_s), 0.01)
+        self.decay_rate = decay_rate
+        self.quality_threshold = quality_threshold
+        self.psi_threshold = psi_threshold
+        self.min_rows = max(int(min_rows), 0)
+        self.max_refits = max_refits
+        self.swaps = 0
+        self.rejected = 0
+        self.faults = 0
+        self.polls = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- polling
+    def run_once(self) -> str:
+        """One poll of the trigger: returns ``"idle"`` (not drifted /
+        no data), ``"swapped"``, ``"rejected"`` or ``"fault"``."""
+        self.polls += 1
+        gate = self.session.drift_gate
+        stats = gate.stats(self.model_id)
+        if stats is None or stats["rows"] < self.min_rows \
+                or not gate.drifted(self.model_id, self.psi_threshold):
+            return "idle"
+        leaf_snapshot = None
+        try:
+            FAULTS.maybe_raise(
+                "serve/refit",
+                lambda site: InjectedFault(
+                    site, f"injected fault at {site}: refit attempt "
+                          f"for {self.model_id} failed"))
+            data = self.data_source()
+            if data is None:
+                return "idle"
+            X, y = data[0], data[1]
+            weight = data[2] if len(data) > 2 else None
+            leaf_snapshot = snapshot_leaf_values(self.booster.gbdt)
+            self.booster.refit(X, y, weight=weight,
+                               decay_rate=self.decay_rate)
+        except Exception as exc:
+            # a failed attempt must not take the loop (or serving) down:
+            # the old model is still live and untouched
+            if leaf_snapshot is not None:
+                restore_leaf_values(self.booster.gbdt, leaf_snapshot)
+            self.faults += 1
+            TELEMETRY.counter_add("serve/refit_faults")
+            self._note("fault", drift=stats,
+                       error=f"{type(exc).__name__}: {exc}")
+            return "fault"
+        try:
+            self.session.swap(self.model_id, self.booster,
+                              holdout=X, label=y,
+                              quality_threshold=self.quality_threshold)
+        except SwapRejectedError as exc:
+            # gate said no: undo the in-place refit so the loop's
+            # booster stays in sync with the model that kept serving
+            restore_leaf_values(self.booster.gbdt, leaf_snapshot)
+            self.rejected += 1
+            self._note("rejected", drift=stats, error=str(exc))
+            return "rejected"
+        self.swaps += 1
+        TELEMETRY.counter_add("serve/refits")
+        self._note("swapped", drift=stats)
+        return "swapped"
+
+    def _note(self, status: str, drift=None, error: str = "") -> None:
+        health = getattr(self.session, "health", None)
+        if health is None:
+            return
+        rec = {"model": self.model_id, "status": status,
+               "swaps": self.swaps, "rejected": self.rejected,
+               "faults": self.faults}
+        if drift is not None:
+            rec["psi_max"] = drift.get("psi_max")
+            rec["rows"] = drift.get("rows")
+        if error:
+            rec["error"] = error
+        health.event("serve_refit", rec)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "RefitLoop":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._run,
+                                        name="serve-refit", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.run_once()
+            except Exception:
+                # an unexpected poll error (e.g. the session closed
+                # under us) ends the loop; serving is unaffected
+                return
+            if self.max_refits is not None \
+                    and self.swaps >= self.max_refits:
+                return
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

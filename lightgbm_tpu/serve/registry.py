@@ -15,22 +15,50 @@ current residents so the operator knows exactly what to evict.
 
 Backends without allocator stats (CPU) admit everything, same as the
 training check.
+
+Hot swap (``swap()``) replaces one resident model with ZERO downtime:
+the replacement stack and tables are built off to the side while the
+old pack keeps serving, an optional quality gate shadow-scores the
+candidate, and the flip is one pointer exchange under the lock.  Two
+versioning planes make that cheap:
+
+  * ``pack_version`` — global; bumped on load/evict (and on a swap
+    whose candidate does not fit the current pack padding), which
+    rebuilds the pack and invalidates EVERY compiled serve executable.
+  * per-model ``epoch`` — bumped only for the swapped id; when the
+    candidate fits the current pack maxima the swapped row is updated
+    functionally (same shapes, new arrays) so untouched residents'
+    executables stay valid and are never retraced.
+
+In-flight requests are version-pinned: ``snapshot()`` hands the
+predictor one consistent ``(entry, row, epoch, pack)`` view, and the
+old device arrays stay alive (functional update) until the last
+dispatched batch against them completes — there is no reject window.
+The previous generation is retained for a one-call ``rollback()``,
+and the whole lifecycle lands as ``swap_begin``/``swap_rejected``/
+``swap_flip``/``swap_done`` health records with the measured pause.
 """
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..models.device_predict import stack_trees_host
+from ..utils.faults import FAULTS, InjectedFault
 from ..utils.log import LightGBMError
 from ..utils.telemetry import TELEMETRY
 from .binning import _CAT_PAD, build_tables, tables_nbytes
 
 # same headroom fraction as the training admission check (models/gbdt.py)
 SERVE_ADMIT_FRACTION = 0.9
+# bounded deterministic reservoir of recently served request rows per
+# model — the default shadow-scoring holdout for the swap quality gate
+REPLAY_RESERVOIR = 512
 
 
 class ServeError(LightGBMError):
@@ -41,6 +69,15 @@ class ServeAdmissionError(ServeError):
     """A model load would not fit under the device HBM budget."""
 
 
+class ServeOverloadError(ServeError):
+    """A submit was shed because the queue is at serve_max_queue_rows."""
+
+
+class SwapRejectedError(ServeError):
+    """A hot swap was rejected (quality gate, admission or injected
+    fault at the flip); the previous model keeps serving."""
+
+
 class ResidentModel:
     """Host-side state of one admitted model (device state lives in the
     shared pack)."""
@@ -48,7 +85,7 @@ class ResidentModel:
     __slots__ = ("model_id", "trees", "num_tree_per_iteration",
                  "init_scores", "objective", "max_feature_idx",
                  "average_output", "tables", "stack", "max_depth",
-                 "nbytes", "baseline")
+                 "nbytes", "baseline", "leaf_values")
 
     def __init__(self, model_id, trees, num_tree_per_iteration, init_scores,
                  objective, max_feature_idx, average_output, tables, stack,
@@ -66,6 +103,67 @@ class ResidentModel:
         self.stack = stack            # host numpy tree-stack fields
         self.max_depth = max_depth
         self.nbytes = nbytes          # unpadded host bytes (reporting)
+        # per-tree float64 leaf values SNAPSHOT at load/swap time: the
+        # predictor gathers from these, never from the live tree
+        # objects, so an in-place ``Booster.refit`` of the source
+        # booster cannot perturb serving mid-flight — the refitted
+        # values only go live through the atomic swap
+        self.leaf_values = [np.array(t.leaf_value, dtype=np.float64)
+                            for t in trees]
+
+    def dims(self):
+        """(T, maxnodes, F, bounds_len, cat_len) this entry needs in
+        the shared pack."""
+        return (self.stack[0].shape[0], self.stack[0].shape[1],
+                self.tables["src_col"].shape[0],
+                self.tables["bounds"].shape[1],
+                self.tables["cat_vals"].shape[1])
+
+
+class PackSnapshot:
+    """One consistent view of a model for the whole lifetime of a
+    dispatched request: the entry, its pack row, its epoch and the
+    device pack it was built against.  A swap that flips mid-request
+    cannot mix generations — the old arrays stay alive until the last
+    snapshot holding them is dropped."""
+
+    __slots__ = ("model_id", "entry", "row", "epoch", "pack",
+                 "pack_version")
+
+    def __init__(self, model_id, entry, row, epoch, pack, pack_version):
+        self.model_id = model_id
+        self.entry = entry
+        self.row = row
+        self.epoch = epoch
+        self.pack = pack
+        self.pack_version = pack_version
+
+
+class _ReplayReservoir:
+    """Deterministic bounded reservoir of served request rows."""
+
+    __slots__ = ("rows", "seen", "rng", "cap")
+
+    def __init__(self, cap: int, seed: int):
+        self.rows: List[np.ndarray] = []
+        self.seen = 0
+        self.rng = random.Random(seed)
+        self.cap = int(cap)
+
+    def note(self, X: np.ndarray) -> None:
+        for i in range(X.shape[0]):
+            self.seen += 1
+            if len(self.rows) < self.cap:
+                self.rows.append(np.array(X[i]))
+            else:
+                j = self.rng.randrange(self.seen)
+                if j < self.cap:
+                    self.rows[j] = np.array(X[i])
+
+    def sample(self) -> Optional[np.ndarray]:
+        if not self.rows:
+            return None
+        return np.stack(self.rows)
 
 
 def _extract(booster, num_iteration: int = -1) -> tuple:
@@ -116,9 +214,29 @@ _STACK_FIELDS = (
     ("num_leaves", np.int32, 1),
 )
 
+_STACK_SLOT = {"split_feature": 0, "threshold_bin": 1, "decision_type": 2,
+               "left_child": 3, "right_child": 4, "cat_bitset": 5,
+               "num_leaves": 7}
+
 _TABLE_PADS = {"src_col": 0, "bounds": np.inf, "num_bin": 1,
                "default_bin": 0, "missing_type": 0, "is_cat": False,
                "cat_vals": _CAT_PAD, "cat_bins": 0}
+
+
+def _build_entry(booster, model_id: str, num_iteration: int
+                 ) -> ResidentModel:
+    """Host-side ResidentModel for one booster — the expensive part of
+    load/swap, deliberately lock-free."""
+    (trees, mappers, used, C, init_scores, objective, max_fi,
+     avg_out) = _extract(booster, num_iteration)
+    tables = build_tables(mappers, used)
+    stack = stack_trees_host(trees, len(used))
+    max_depth = stack[-1]
+    nbytes = (sum(int(np.asarray(a).nbytes) for a in stack[:-1])
+              + tables_nbytes(tables))
+    return ResidentModel(model_id, trees, C, init_scores, objective,
+                         max_fi, avg_out, tables, stack[:-1], max_depth,
+                         nbytes)
 
 
 class ModelRegistry:
@@ -127,7 +245,9 @@ class ModelRegistry:
     ``pack()`` returns the current device arrays; ``pack_version``
     changes whenever they are rebuilt (load/evict), which invalidates
     every compiled serve executable that closed over the previous
-    shapes (serve/predictor.py re-keys on the version).
+    shapes.  ``epoch_of()`` changes only for a hot-swapped id — the
+    predictor re-keys on (version, epoch), so a swap invalidates the
+    swapped model's executables and nobody else's.
     """
 
     def __init__(self, max_batch: int = 256,
@@ -137,6 +257,10 @@ class ModelRegistry:
         self._order: List[str] = []          # pack row per model_id
         self._pack = None                    # device arrays, lazily built
         self.pack_version = 0
+        self._epochs: Dict[str, int] = {}    # per-model swap generation
+        self._retained: Dict[str, ResidentModel] = {}   # rollback target
+        self._replay: Dict[str, _ReplayReservoir] = {}
+        self.swap_pauses: List[float] = []   # flip lock-hold seconds
         self.max_batch = int(max_batch)
         self.admit_fraction = float(admit_fraction)
         self.health = None      # serve/health.ServeHealth, session-wired
@@ -150,28 +274,33 @@ class ModelRegistry:
         if self.health is not None:
             self.health.event("serve_admit", {"detail": detail})
 
+    def _swap_event(self, kind: str, model_id: str, fields: dict) -> None:
+        """Swap lifecycle records ride the same two channels as
+        admission decisions: the telemetry faults section and the serve
+        health stream."""
+        detail = ", ".join(f"{k}={v}" for k, v in fields.items())
+        TELEMETRY.fault_event(kind, site="serve/swap",
+                              detail=f"{model_id}: {detail}")
+        if self.health is not None:
+            self.health.event(kind, {"model": model_id, **fields})
+
     # ------------------------------------------------------------ loading
     def load(self, booster, model_id: Optional[str] = None,
              num_iteration: int = -1) -> str:
         """Admit one Booster; returns its model_id.  Raises
         :class:`ServeAdmissionError` when the packed working set would
         exceed the HBM budget."""
-        (trees, mappers, used, C, init_scores, objective, max_fi,
-         avg_out) = _extract(booster, num_iteration)
         with self._lock:
             if model_id is None:
                 model_id = f"model{len(self._order)}"
             if model_id in self._models:
                 raise ServeError(f"model_id {model_id!r} is already "
                                  f"resident; evict it first")
-            tables = build_tables(mappers, used)
-            stack = stack_trees_host(trees, len(used))
-            max_depth = stack[-1]
-            nbytes = (sum(int(np.asarray(a).nbytes) for a in stack[:-1])
-                      + tables_nbytes(tables))
-            entry = ResidentModel(model_id, trees, C, init_scores,
-                                  objective, max_fi, avg_out, tables,
-                                  stack[:-1], max_depth, nbytes)
+        entry = _build_entry(booster, model_id, num_iteration)
+        with self._lock:
+            if model_id in self._models:
+                raise ServeError(f"model_id {model_id!r} is already "
+                                 f"resident; evict it first")
             self._admit_or_raise(entry)
             if self.drift is not None:
                 # training baseline rides next to the pack: fine bin
@@ -183,6 +312,10 @@ class ModelRegistry:
                 self.drift.register(model_id, entry.baseline)
             self._models[model_id] = entry
             self._order.append(model_id)
+            self._epochs.setdefault(model_id, 0)
+            self._replay.setdefault(
+                model_id, _ReplayReservoir(
+                    REPLAY_RESERVOIR, seed=hash(model_id) & 0x7FFFFFFF))
             self._pack = None
             self.pack_version += 1
             return model_id
@@ -193,6 +326,9 @@ class ModelRegistry:
                 raise ServeError(f"model_id {model_id!r} is not resident")
             del self._models[model_id]
             self._order.remove(model_id)
+            self._retained.pop(model_id, None)
+            self._replay.pop(model_id, None)
+            self._epochs.pop(model_id, None)
             if self.drift is not None:
                 self.drift.forget(model_id)
             self._pack = None
@@ -200,6 +336,186 @@ class ModelRegistry:
             self._admit_record(
                 f"evicted {model_id}; residents="
                 f"{','.join(self._order) or '<none>'}")
+
+    # ----------------------------------------------------------- hot swap
+    def swap(self, model_id: str, booster, num_iteration: int = -1,
+             gate=None) -> float:
+        """Atomically replace a resident model with ``booster``.
+
+        The replacement pack row and binning tables are built while the
+        old model keeps serving; ``gate(candidate_entry)`` (optional)
+        then shadow-scores the candidate and returns ``(ok, detail)``
+        — a failing gate, a failing admission check or an armed
+        ``serve/swap`` fault raises :class:`SwapRejectedError` with the
+        old model untouched.  On success the previous generation is
+        retained for :meth:`rollback` and the flip pause (lock-hold
+        seconds) is returned.  When the candidate fits the current pack
+        padding only the swapped id's epoch changes, so untouched
+        residents' compiled executables survive.
+        """
+        with self._lock:
+            if model_id not in self._models:
+                raise ServeError(
+                    f"model_id {model_id!r} is not resident; loaded: "
+                    f"{', '.join(self._order) or '<none>'}")
+        entry = _build_entry(booster, model_id, num_iteration)
+        self._swap_event("swap_begin", model_id, {
+            "trees": len(entry.trees), "nbytes": entry.nbytes})
+        with self._lock:
+            others = [self._models[m] for m in self._order
+                      if m != model_id]
+        try:
+            self._admit_or_raise(entry, others=others, verb="swap")
+        except ServeAdmissionError as exc:
+            self._reject_swap(model_id, f"admission failed: {exc}")
+        if gate is not None:
+            ok, detail = gate(entry)
+            if not ok:
+                self._reject_swap(model_id, detail)
+        try:
+            FAULTS.maybe_raise(
+                "serve/swap",
+                lambda site: InjectedFault(
+                    site, f"injected fault at {site}: hot-swap flip "
+                          f"for {model_id} aborted"))
+        except InjectedFault as exc:
+            self._reject_swap(model_id, str(exc))
+        baseline = None
+        if self.drift is not None:
+            from ..obs.drift import extract_baseline
+            baseline = extract_baseline(booster)
+        pause, rebuilt, epoch = self._flip(model_id, entry, baseline)
+        self._swap_event("swap_flip", model_id, {
+            "pause_ms": round(pause * 1e3, 3), "epoch": epoch,
+            "pack_rebuild": rebuilt})
+        TELEMETRY.counter_add("serve/swaps")
+        self._swap_event("swap_done", model_id, {
+            "epoch": epoch, "trees": len(entry.trees),
+            "pause_ms": round(pause * 1e3, 3)})
+        return pause
+
+    def rollback(self, model_id: str) -> float:
+        """Restore the generation retained by the last successful
+        ``swap()`` — the same atomic flip, in reverse.  Returns the
+        flip pause; raises :class:`ServeError` when there is nothing
+        retained to roll back to."""
+        with self._lock:
+            if model_id not in self._models:
+                raise ServeError(f"model_id {model_id!r} is not resident")
+            prev = self._retained.get(model_id)
+        if prev is None:
+            raise ServeError(
+                f"no retained previous generation for {model_id!r}; "
+                f"rollback is available after a successful swap")
+        pause, rebuilt, epoch = self._flip(model_id, prev, prev.baseline)
+        self._swap_event("swap_flip", model_id, {
+            "pause_ms": round(pause * 1e3, 3), "epoch": epoch,
+            "pack_rebuild": rebuilt, "rollback": True})
+        TELEMETRY.counter_add("serve/rollbacks")
+        self._swap_event("swap_done", model_id, {
+            "epoch": epoch, "rollback": True,
+            "pause_ms": round(pause * 1e3, 3)})
+        return pause
+
+    def _reject_swap(self, model_id: str, reason: str) -> None:
+        TELEMETRY.counter_add("serve/swap_rejected")
+        self._swap_event("swap_rejected", model_id, {"reason": reason})
+        raise SwapRejectedError(
+            f"hot swap of {model_id!r} rejected: {reason}; the previous "
+            f"model keeps serving")
+
+    def _flip(self, model_id: str, entry: ResidentModel,
+              baseline) -> tuple:
+        """The one-step pointer exchange: swap ``entry`` in for the
+        current generation of ``model_id``.  Returns (pause_seconds,
+        pack_rebuilt, new_epoch)."""
+        row_update = None
+        with self._lock:
+            pack_ref = self._pack
+            if pack_ref is not None:
+                dims = (pack_ref["split_feature"].shape[1],
+                        pack_ref["split_feature"].shape[2],
+                        pack_ref["tab_bounds"].shape[1],
+                        pack_ref["tab_bounds"].shape[2],
+                        pack_ref["tab_cat_vals"].shape[2])
+        if pack_ref is not None and \
+                all(n <= d for n, d in zip(entry.dims(), dims)):
+            # candidate fits the live padding: build the padded host
+            # row off-lock, update functionally under the lock — same
+            # shapes, so untouched executables are never invalidated
+            row_update = self._pack_row(entry, dims)
+        t0 = time.perf_counter()
+        with self._lock:
+            old = self._models[model_id]
+            m = self._order.index(model_id)
+            if row_update is not None and self._pack is pack_ref:
+                import jax.numpy as jnp
+                new_pack = dict(pack_ref)
+                for name, buf in row_update.items():
+                    new_pack[name] = new_pack[name].at[m].set(
+                        jnp.asarray(buf))
+                self._pack = new_pack
+                rebuilt = False
+            else:
+                # shapes change (or the pack raced a rebuild): fall
+                # back to the global invalidation plane
+                self._pack = None
+                self.pack_version += 1
+                rebuilt = True
+            self._models[model_id] = entry
+            self._retained[model_id] = old
+            self._epochs[model_id] = epoch = \
+                self._epochs.get(model_id, 0) + 1
+            if self.drift is not None and baseline is not None:
+                entry.baseline = baseline
+                self.drift.register(model_id, baseline, generation=epoch)
+        pause = time.perf_counter() - t0
+        self.swap_pauses.append(pause)
+        TELEMETRY.record_dispatch("serve/swap_pause", t0, t0 + pause)
+        return pause, rebuilt, epoch
+
+    def _pack_row(self, entry: ResidentModel, dims) -> Dict[str, np.ndarray]:
+        """One model's padded host buffers shaped like a single row of
+        each pack field (pure numpy; nothing uploaded)."""
+        T, Mn, F, B, Cc = dims
+        out = {}
+        for name, dtype, fill in _STACK_FIELDS:
+            if name == "cat_bitset":
+                shape = (T, Mn, 8)
+            elif name == "num_leaves":
+                shape = (T,)
+            else:
+                shape = (T, Mn)
+            buf = np.full(shape, fill, dtype=dtype)
+            a = entry.stack[_STACK_SLOT[name]]
+            buf[tuple(slice(0, s) for s in a.shape)] = a
+            out[name] = buf
+        for key in entry.tables:
+            shape = {"bounds": (F, B), "cat_vals": (F, Cc),
+                     "cat_bins": (F, Cc)}.get(key, (F,))
+            buf = np.full(shape, _TABLE_PADS[key],
+                          dtype=entry.tables[key].dtype)
+            a = entry.tables[key]
+            buf[tuple(slice(0, s) for s in a.shape)] = a
+            out["tab_" + key] = buf
+        return out
+
+    # ------------------------------------------------ replay reservoir
+    def note_rows(self, model_id: str, X: np.ndarray) -> None:
+        """Reservoir-sample served request rows (the predictor feeds
+        every request through here) — the deterministic holdout the
+        swap quality gate shadow-scores candidates on."""
+        with self._lock:
+            res = self._replay.get(model_id)
+            if res is not None:
+                res.note(X)
+
+    def replay_rows(self, model_id: str) -> Optional[np.ndarray]:
+        """The current holdout sample of recently served rows, or None
+        before any traffic."""
+        with self._lock:
+            res = self._replay.get(model_id)
+            return res.sample() if res is not None else None
 
     # ---------------------------------------------------------- admission
     def _packed_nbytes(self, entries) -> int:
@@ -221,13 +537,16 @@ class ModelRegistry:
         total += M * F * (4 * 4 + 1)    # src_col/num_bin/default_bin/
         return total                    # missing_type i32 + is_cat bool
 
-    def _admit_or_raise(self, entry: ResidentModel) -> None:
-        hypothetical = list(self._models.values()) + [entry]
+    def _admit_or_raise(self, entry: ResidentModel, others=None,
+                        verb: str = "load") -> None:
+        if others is None:
+            others = list(self._models.values())
+        hypothetical = others + [entry]
         pack_bytes = self._packed_nbytes(hypothetical)
         budget = TELEMETRY.device_memory_budget()
         if budget is None:
             self._admit_record(
-                f"admitted {entry.model_id} (~{entry.nbytes} B, "
+                f"admitted {entry.model_id} ({verb}, ~{entry.nbytes} B, "
                 f"pack ~{pack_bytes} B); no allocator stats on "
                 f"this backend — budget check skipped")
             return
@@ -241,15 +560,14 @@ class ModelRegistry:
         limit = int(self.admit_fraction * budget)
         if need <= limit:
             self._admit_record(
-                f"admitted {entry.model_id}: working set "
+                f"admitted {entry.model_id} ({verb}): working set "
                 f"~{need} B within {limit} B "
                 f"({self.admit_fraction:.0%} of {budget} B HBM)")
             return
         residents = ", ".join(
-            f"{m.model_id}(~{m.nbytes}B)" for m in self._models.values()) \
-            or "<none>"
-        detail = (f"rejected {entry.model_id}: estimated working set "
-                  f"~{need} B exceeds {limit} B "
+            f"{m.model_id}(~{m.nbytes}B)" for m in others) or "<none>"
+        detail = (f"rejected {entry.model_id} ({verb}): estimated working "
+                  f"set ~{need} B exceeds {limit} B "
                   f"({self.admit_fraction:.0%} of the {budget} B reported "
                   f"HBM budget); residents: {residents}")
         self._admit_record(detail)
@@ -271,9 +589,24 @@ class ModelRegistry:
         with self._lock:
             return self._order.index(model_id)
 
+    def epoch_of(self, model_id: str) -> int:
+        with self._lock:
+            return self._epochs.get(model_id, 0)
+
     def residents(self) -> Dict[str, int]:
         with self._lock:
             return {mid: self._models[mid].nbytes for mid in self._order}
+
+    def snapshot(self, model_id: str) -> PackSnapshot:
+        """The version-pinned view one request dispatches against:
+        entry, pack row, epoch and the pack arrays, taken atomically so
+        a concurrent swap cannot mix generations mid-request."""
+        with self._lock:
+            entry = self.entry(model_id)
+            return PackSnapshot(model_id, entry,
+                               self._order.index(model_id),
+                               self._epochs.get(model_id, 0),
+                               self.pack(), self.pack_version)
 
     def pack(self) -> Dict[str, "object"]:
         """The shared device buffers, (re)built on demand after a
@@ -300,10 +633,7 @@ class ModelRegistry:
                     shape = (M, T, Mn)
                 buf = np.full(shape, fill, dtype=dtype)
                 for m, e in enumerate(entries):
-                    a = e.stack[{"split_feature": 0, "threshold_bin": 1,
-                                 "decision_type": 2, "left_child": 3,
-                                 "right_child": 4, "cat_bitset": 5,
-                                 "num_leaves": 7}[name]]
+                    a = e.stack[_STACK_SLOT[name]]
                     buf[m][tuple(slice(0, s) for s in a.shape)] = a
                 out[name] = jnp.asarray(buf)
             F = max(e.tables["src_col"].shape[0] for e in entries)

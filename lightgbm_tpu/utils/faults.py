@@ -15,7 +15,8 @@ Spec grammar (env ``LIGHTGBM_TPU_FAULTS`` or config
 ``SITE`` is a registered site name (``chunk/oom``, ``grad/nonfinite``,
 ``snapshot/io``, ``train/kill``, ``collective/allgather``,
 ``collective/reduce_scatter``, ``collective/barrier``, ``dist/init``,
-``dist/preempt``, ``oocore/h2d``, ``oocore/admit``).  ``@START``
+``dist/preempt``, ``oocore/h2d``, ``oocore/admit``, ``serve/swap``,
+``serve/shed``, ``serve/refit``, ``serve/oom``).  ``@START``
 is the 0-based occurrence (or explicit index, e.g. iteration) at which
 the fault starts firing; default 0.  ``xCOUNT`` is how many
 occurrences fire; default 1, ``x*`` means every occurrence from START
@@ -64,6 +65,10 @@ KNOWN_SITES = frozenset([
     "oocore/admit",      # admission check decides the matrix won't fit
     "serve/compile",     # serve executable build fails (named give-up)
     "serve/enqueue",     # serve request rejected at enqueue
+    "serve/swap",        # hot-swap flip aborts; the old model keeps serving
+    "serve/shed",        # submit is force-shed as if the queue were full
+    "serve/refit",       # one refit-loop attempt fails (loop continues)
+    "serve/oom",         # serve dispatch raises RESOURCE_EXHAUSTED
     "sched/slice",       # one scheduler time slice fails before dispatch
     "sched/snapshot",    # preemption snapshot write fails
 ])

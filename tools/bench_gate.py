@@ -20,7 +20,12 @@ previous ``--window`` records, and the gate fails (exit 1) when
     goes from held to failed — or ``psi_max`` regresses more than
     ``--psi-tol`` over the trailing median while sitting above the
     absolute noise floor (0.1 PSI; below it, sampling jitter dominates
-    and the ratio gate stays silent).
+    and the ratio gate stays silent),
+  * the hot-swap flip pause (``swap_pause_p99_s``, recorded by loadgen
+    --swap cells) regresses more than ``--latency-tol`` over the
+    trailing median, or the shed rate (``shed_rate``) regresses more
+    than ``--latency-tol`` — including shedding APPEARING where the
+    trailing history shed nothing.
 
 Serve records (bench_serve.py) carry ``qps``/``p50_s``/``p99_s`` and no
 training ``value``/``unit``/``peak_hbm_bytes`` — every gate skips fields
@@ -226,6 +231,45 @@ def evaluate(records, window=5, wall_tol=0.15, hbm_tol=0.20,
             else:
                 notes.append(f"{config}: psi_max {psi:.3f} vs median "
                              f"{psi_base:.3f} — ok")
+        # hot-swap cells (loadgen --swap): the flip pause p99 is the
+        # zero-downtime promise in seconds — it gates like a latency
+        pause = newest.get("swap_pause_p99_s")
+        pause_base = _median([r["swap_pause_p99_s"] for r in history
+                              if isinstance(r.get("swap_pause_p99_s"),
+                                            (int, float))
+                              and r["swap_pause_p99_s"] > 0])
+        if (isinstance(pause, (int, float)) and pause > 0
+                and pause_base is not None):
+            if pause / pause_base > 1.0 + latency_tol:
+                failures.append(
+                    f"{config}: swap pause p99 {pause * 1e3:.3f}ms "
+                    f"regressed {pause / pause_base - 1.0:+.1%} over "
+                    f"median {pause_base * 1e3:.3f}ms "
+                    f"(tol +{latency_tol:.0%})")
+            else:
+                notes.append(f"{config}: swap pause p99 "
+                             f"{pause * 1e3:.3f}ms vs median "
+                             f"{pause_base * 1e3:.3f}ms — ok")
+        # shed rate: a ratio gate where the cell historically shed, and
+        # an appearance gate where it never did — a queue that starts
+        # shedding at an unchanged arrival rate is a capacity regression
+        shed = newest.get("shed_rate")
+        shed_hist = [r["shed_rate"] for r in history
+                     if isinstance(r.get("shed_rate"), (int, float))]
+        if isinstance(shed, (int, float)) and shed_hist:
+            shed_base = _median(shed_hist)
+            if shed_base > 0 and shed / shed_base > 1.0 + latency_tol:
+                failures.append(
+                    f"{config}: shed rate {shed:.4f} regressed "
+                    f"{shed / shed_base - 1.0:+.1%} over median "
+                    f"{shed_base:.4f} (tol +{latency_tol:.0%})")
+            elif shed_base == 0 and shed > 0:
+                failures.append(
+                    f"{config}: shedding appeared (rate {shed:.4f}) "
+                    f"where the trailing history shed nothing")
+            else:
+                notes.append(f"{config}: shed rate {shed:.4f} vs "
+                             f"median {shed_base:.4f} — ok")
     return failures, notes
 
 
@@ -519,6 +563,57 @@ def self_test():
         ("drift first record passes", not evaluate(
             [{"config": "loadgen-shift-new", "drift_ok": True,
               "psi_max": 1.2}])[0]),
+    ]
+    # hot-swap cells (tools/loadgen.py --swap): swap_pause_p99_s gates
+    # like a latency, shed_rate gates on ratio AND on appearing where
+    # the trailing history shed nothing
+    whist = [{"config": "loadgen-swap-smoke", "qps": 200.0,
+              "p99_s": 0.010, "quality_ok": True, "swaps": 3,
+              "swap_pause_p99_s": 0.004 + 0.0001 * i, "shed_rate": 0.0}
+             for i in range(4)]
+
+    def wverdict(newest):
+        failures, _ = evaluate(whist + [newest])
+        return bool(failures)
+
+    checks += [
+        ("steady swap pause passes", not wverdict(
+            {"config": "loadgen-swap-smoke", "qps": 200.0,
+             "p99_s": 0.010, "quality_ok": True, "swaps": 3,
+             "swap_pause_p99_s": 0.0042, "shed_rate": 0.0})),
+        ("swap pause regression fails", wverdict(
+            {"config": "loadgen-swap-smoke", "qps": 200.0,
+             "p99_s": 0.010, "quality_ok": True, "swaps": 3,
+             "swap_pause_p99_s": 0.02, "shed_rate": 0.0})),
+        ("shedding appearing from zero fails", wverdict(
+            {"config": "loadgen-swap-smoke", "qps": 200.0,
+             "p99_s": 0.010, "quality_ok": True, "swaps": 3,
+             "swap_pause_p99_s": 0.0042, "shed_rate": 0.05})),
+        ("swap quality flip fails", wverdict(
+            {"config": "loadgen-swap-smoke", "qps": 200.0,
+             "p99_s": 0.010, "quality_ok": False, "swaps": 3,
+             "swap_pause_p99_s": 0.0042, "shed_rate": 0.0})),
+        ("swap-field-free record passes swap gates", not wverdict(
+            {"config": "loadgen-swap-smoke", "qps": 200.0,
+             "p99_s": 0.010, "quality_ok": True})),
+        ("swap first record passes", not evaluate(
+            [{"config": "loadgen-swap-new", "swap_pause_p99_s": 0.5,
+              "shed_rate": 0.5}])[0]),
+    ]
+    shed_hist = [{"config": "loadgen-swap-shed", "quality_ok": True,
+                  "swap_pause_p99_s": 0.004, "shed_rate": 0.010}
+                 for _ in range(4)]
+    checks += [
+        ("steady nonzero shed rate passes", not evaluate(
+            shed_hist + [{"config": "loadgen-swap-shed",
+                          "quality_ok": True,
+                          "swap_pause_p99_s": 0.004,
+                          "shed_rate": 0.011}])[0]),
+        ("shed rate ratio regression fails", bool(evaluate(
+            shed_hist + [{"config": "loadgen-swap-shed",
+                          "quality_ok": True,
+                          "swap_pause_p99_s": 0.004,
+                          "shed_rate": 0.10}])[0])),
     ]
     # fleet-summary structural gate (tools/fleet_monitor.py output)
     good_fleet = {

@@ -22,6 +22,14 @@
 # NAMED give-up on the affected request futures — never a hang — and the
 # queue must keep serving afterwards.
 #
+# A serve-swap pass runs the hot-swap/overload suite
+# (tests/test_serve_swap.py) over the serve/swap, serve/shed, serve/oom
+# and serve/refit sites: an armed flip fault must reject the swap and
+# leave the OLD model serving bit-identically, a forced shed must
+# surface as a named ServeOverloadError, an injected RESOURCE_EXHAUSTED
+# must be retried at half batch with bit-identical replies, and a
+# faulted refit attempt must leave the refit loop alive.
+#
 # A fifth pass runs the scheduler suite (tests/test_sched.py) over the
 # sched/slice and sched/snapshot sites: a fault in one tenant's slice or
 # preemption snapshot must retry once then fail THAT JOB ONLY — the
@@ -69,6 +77,13 @@ echo "=== fault matrix: serve sites=serve/compile,serve/enqueue ==="
 if ! JAX_PLATFORMS=cpu \
     python -m pytest tests/test_serve.py -q -p no:cacheprovider \
     -k "fault" "$@"; then
+  status=1
+fi
+
+echo "=== fault matrix: serve-swap sites=serve/swap,serve/shed,serve/oom,serve/refit ==="
+if ! JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_serve_swap.py -q -p no:cacheprovider \
+    -k "fault or shed or oom or wedged" "$@"; then
   status=1
 fi
 

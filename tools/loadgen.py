@@ -26,11 +26,18 @@ against Booster.predict throughout: the drift tap must never perturb
 the scores it observes.  ``--smoke`` runs both and asserts the shifted
 sweep's ``serve_drift`` record names the shifted column first.
 
+``--swap`` drives open-loop traffic while a background thread refits
+and hot-swaps the SAME model N times mid-flight: zero replies may
+fail, every reply must be bit-identical to a generation that was live,
+and the measured flip pauses (``swap_pause_p99_s``) land in the record
+for tools/bench_gate.py to gate alongside ``shed_rate``.
+
 Usage:
   python tools/loadgen.py                 # full sweep -> BENCH_SERVE.json
   python tools/loadgen.py --smoke         # ~2s burst, assertions, no artifacts
   python tools/loadgen.py --rate 200 --delay-ms 5 --duration 3
   python tools/loadgen.py --shift         # drift cells -> trajectory
+  python tools/loadgen.py --swap          # hot-swap-under-load cell
 """
 
 import argparse
@@ -195,6 +202,137 @@ def run_cell(bst, X, size, rate, delay_ms, duration_s, max_batch=64,
     return rec
 
 
+def run_swap_cell(bst, X, name, n_swaps=3, rate=250.0, delay_ms=2.0,
+                  duration_s=2.0, max_batch=64, health_path="", seed=0):
+    """One hot-swap-under-load cell: open-loop Poisson traffic against
+    model ``name`` while a background thread refits the booster and
+    pushes ``n_swaps`` atomic hot swaps through the live session.
+
+    Contracts asserted downstream (``--smoke``): zero failed replies
+    across every flip, every reply bit-identical to a generation that
+    was live during the run, and a bounded flip pause
+    (``swap_pause_p99_s``, read from ``registry.swap_pauses``)."""
+    import jax
+    import numpy as np
+
+    from lightgbm_tpu.serve import ServeSession
+    from lightgbm_tpu.utils.telemetry import TELEMETRY
+
+    reqs = [np.ascontiguousarray(X[i % X.shape[0]].reshape(1, -1))
+            for i in range(64)]
+    allreq = np.concatenate(reqs)
+    rng = np.random.RandomState(seed)
+    # generation 0's per-request references; the swapper appends each
+    # new generation's BEFORE flipping it live, so the membership check
+    # below never races the flip
+    gens = [bst.predict(allreq)]
+    gens_lock = threading.Lock()
+    replies = []
+    errors = [0]
+    rep_lock = threading.Lock()
+    TELEMETRY.reset()
+    with ServeSession(max_batch=max_batch, max_delay_ms=delay_ms,
+                      health_out=health_path,
+                      health_window_s=0.5) as sess:
+        mid = sess.load(bst, model_id=name)
+        sess.predict_direct(mid, allreq[:1])         # compile
+        # warm the flip path too (first .at[row].set compiles); an
+        # identity swap, so generation-0 references stay valid
+        sess.swap(mid, bst, gated=False)
+        warm_pauses = len(sess.registry.swap_pauses)
+        swaps_done = [0]
+        stop = threading.Event()
+
+        def swapper():
+            # pace swaps across the traffic window but always complete
+            # all n_swaps — the tail ones land during the drain, still
+            # under load.  stop's only job is the pacing wait.
+            gap = duration_s / (n_swaps + 1)
+            for _ in range(n_swaps):
+                stop.wait(gap)
+                Xr = X[rng.choice(X.shape[0], 400, replace=False)]
+                yr = ((np.nan_to_num(Xr[:, 0]) + Xr[:, 1]) > 0.5
+                      ).astype(np.float64)
+                bst.refit(Xr, yr, decay_rate=0.4)
+                with gens_lock:
+                    gens.append(bst.predict(allreq))
+                sess.swap(mid, bst, gated=False)
+                swaps_done[0] += 1
+
+        def _done(fut, t_submit, idx):
+            try:
+                res = fut.result()
+            except Exception:
+                with rep_lock:
+                    errors[0] += 1
+                return
+            dt = time.perf_counter() - t_submit
+            with rep_lock:
+                replies.append((idx, np.asarray(res).ravel(), dt))
+
+        sw = threading.Thread(target=swapper, name="loadgen-swapper")
+        sw.start()
+        arr = random.Random(seed)
+        t_start = time.perf_counter()
+        t_end = t_start + duration_s
+        next_t, sent, pending = t_start, 0, []
+        try:
+            while True:
+                now = time.perf_counter()
+                if now >= t_end:
+                    break
+                if now < next_t:
+                    time.sleep(min(next_t - now, 0.002))
+                    continue
+                idx = sent % len(reqs)
+                t_submit = time.perf_counter()
+                fut = sess.submit(mid, reqs[idx])
+                fut.add_done_callback(
+                    lambda f, t=t_submit, i=idx: _done(f, t, i))
+                pending.append(fut)
+                sent += 1
+                next_t += arr.expovariate(rate)
+        finally:
+            stop.set()
+            sw.join(timeout=30)
+        wall = time.perf_counter() - t_start
+        deadline = time.monotonic() + 15.0
+        for fut in pending:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                fut.result(timeout=remaining)
+            except Exception:
+                pass              # already counted by the callback
+        pauses = sorted(sess.registry.swap_pauses[warm_pauses:])
+    counters = TELEMETRY.stats().get("counters", {})
+    mismatches = 0
+    with rep_lock, gens_lock:
+        lat = sorted(dt for _, _, dt in replies)
+        for idx, res, _ in replies:
+            if not any(np.array_equal(res, g[idx:idx + 1])
+                       for g in gens):
+                mismatches += 1
+    shed = counters.get("serve/shed_requests", 0)
+    return {
+        "config": f"loadgen-swap-{name}",
+        "mode": "hot-swap", "backend": jax.default_backend(),
+        "rate_target": rate, "delay_ms": delay_ms,
+        "duration_s": round(wall, 3),
+        "requests": sent, "completed": len(lat), "errors": errors[0],
+        "qps": round(len(lat) / max(wall, 1e-9), 2),
+        "swaps": swaps_done[0],
+        "swap_pause_p99_s": (round(_percentile(pauses, 0.99), 6)
+                             if pauses else None),
+        "swap_pause_max_s": (round(pauses[-1], 6) if pauses else None),
+        "shed_rate": round(shed / max(sent, 1), 6),
+        "p50_s": (round(_percentile(lat, 0.50), 6) if lat else None),
+        "p99_s": (round(_percentile(lat, 0.99), 6) if lat else None),
+        "quality_ok": mismatches == 0,
+    }
+
+
 SHIFT_COL = 2          # numerical column displaced by the shift sweep
 SHIFT_OFFSET = 6.0     # far outside the N(0,1) training range
 
@@ -296,9 +434,10 @@ def append_trajectory(records, path=None):
                 "p50_s": r.get("p50_s"),
                 "p99_s": r.get("p99_s"),
                 "quality_ok": r.get("quality_ok"),
-                # drift cells only; absent keys keep older gate
+                # drift/swap cells only; absent keys keep older gate
                 # versions and mixed trajectories shape-stable
-                **{k: r[k] for k in ("psi_max", "drift_ok")
+                **{k: r[k] for k in ("psi_max", "drift_ok",
+                                     "swap_pause_p99_s", "shed_rate")
                    if r.get(k) is not None},
             }) + "\n")
 
@@ -482,14 +621,43 @@ def smoke():
     for rec in drift_recs:
         print("LOADGEN_RESULT_JSON:" + json.dumps(rec), flush=True)
     problems += drift_problems
+    # hot-swap cell: traffic + 3 background swaps, zero failed replies,
+    # every reply bit-identical to a live generation, flip pause bounded
+    swap_rec = run_swap_cell(
+        bst, X, "smoke", n_swaps=3, rate=200.0, duration_s=1.6,
+        health_path=os.path.join(tmp, "swap.serve.health.jsonl"))
+    print("LOADGEN_RESULT_JSON:" + json.dumps(swap_rec), flush=True)
+    problems += swap_problems(swap_rec, n_swaps=3)
     for p in problems:
         sys.stderr.write(f"loadgen smoke: FAIL {p}\n")
     print(f"loadgen smoke: {'FAIL' if problems else 'ok'} "
           f"(hot {hot['rows_per_batch']} rows/batch at "
           f"{hot['qps']} qps, trickle {trickle['rows_per_batch']}, "
           f"shift psi_max {drift_recs[0]['psi_max']} vs control "
-          f"{drift_recs[1]['psi_max']})")
+          f"{drift_recs[1]['psi_max']}, {swap_rec['swaps']} swaps with "
+          f"pause p99 {swap_rec['swap_pause_p99_s']}s)")
     return 1 if problems else 0
+
+
+def swap_problems(rec, n_swaps, pause_bound_s=1.0):
+    """The hot-swap cell's contracts, as gate-able problem strings."""
+    problems = []
+    if rec["errors"] or rec["completed"] != rec["requests"]:
+        problems.append(f"{rec['config']}: {rec['errors']} failed "
+                        f"replies, {rec['completed']}/{rec['requests']} "
+                        f"done (hot swap must be zero-downtime)")
+    if not rec["quality_ok"]:
+        problems.append(f"{rec['config']}: a reply matched NO live "
+                        f"generation (snapshot pinning broke)")
+    if rec["swaps"] != n_swaps:
+        problems.append(f"{rec['config']}: {rec['swaps']}/{n_swaps} "
+                        f"swaps completed")
+    if rec["swap_pause_p99_s"] is None \
+            or rec["swap_pause_p99_s"] > pause_bound_s:
+        problems.append(f"{rec['config']}: flip pause p99 "
+                        f"{rec['swap_pause_p99_s']}s exceeds "
+                        f"{pause_bound_s}s")
+    return problems
 
 
 def main(argv=None):
@@ -502,6 +670,11 @@ def main(argv=None):
     ap.add_argument("--shift", action="store_true",
                     help="drift cells only: shifted + control sweeps "
                          "with drift_detect armed -> trajectory")
+    ap.add_argument("--swap", action="store_true",
+                    help="hot-swap cell: open-loop traffic while the "
+                         "model is refitted and swapped mid-flight")
+    ap.add_argument("--swaps", type=int, default=3,
+                    help="--swap mode: background hot swaps per cell")
     ap.add_argument("--rate", type=float, default=0.0,
                     help="single-cell mode: arrival rate req/s")
     ap.add_argument("--delay-ms", type=float, default=0.0,
@@ -522,6 +695,21 @@ def main(argv=None):
     import numpy as np
 
     import lightgbm_tpu as lgb
+
+    if args.swap:
+        bst, X = _train(np, lgb, dict(rows=1_500, feats=8, iters=8,
+                                      leaves=15))
+        rec = run_swap_cell(bst, X, "small", n_swaps=args.swaps,
+                            duration_s=max(args.duration, 1.5))
+        print(json.dumps(rec), flush=True)
+        problems = swap_problems(rec, n_swaps=args.swaps)
+        for p in problems:
+            sys.stderr.write(f"loadgen swap: FAIL {p}\n")
+        if not args.no_artifacts:
+            merge_bench_serve([rec])
+            append_trajectory([rec])
+            print("loadgen: merged 1 swap cell into BENCH_SERVE.json")
+        return 1 if problems else 0
 
     if args.shift:
         bst, X = _train(np, lgb, dict(rows=1_500, feats=8, iters=8,

@@ -21,7 +21,13 @@
 #                                             health stream parses, the
 #                                             coalescing window engages
 #                                             under load, and every reply
-#                                             stays bit-identical;
+#                                             stays bit-identical — plus
+#                                             a hot-swap cell: 3 atomic
+#                                             swaps under live traffic
+#                                             with zero failed replies,
+#                                             every reply bit-identical
+#                                             to a live generation and
+#                                             the flip pause p99 bounded;
 #                                             writes no artifacts)
 #        bash tools/verify_t1.sh --sched-smoke (also run the
 #                                             multi-tenant scheduler
